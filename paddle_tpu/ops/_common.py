@@ -1,0 +1,26 @@
+"""Shared helpers for the kernel library."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..flags import flag_value
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def use_pallas() -> bool:
+    return on_tpu() and flag_value("use_pallas_kernels")
+
+
+def next_multiple(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

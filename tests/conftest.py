@@ -1,0 +1,23 @@
+"""Test config: force the CPU jax backend with 8 virtual devices.
+
+This is the rebuild's Gloo-equivalent (SURVEY.md §4 takeaway (c)): multi-device
+logic runs on a fake 8-device CPU mesh, no TPU needed.
+
+The container's axon sitecustomize programmatically sets
+``jax_platforms='axon,cpu'`` (TPU tunnel) at interpreter start, overriding the
+JAX_PLATFORMS env var — so we must override back via jax.config *before* any
+backend initialisation. XLA_FLAGS is read at backend-init time, so setting it
+here (before the first jax.devices()) still works.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

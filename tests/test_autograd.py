@@ -1,0 +1,154 @@
+"""Autograd tape tests — parity with the reference's eager backward semantics
+(check_grad-style numeric oracles, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 3).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * np.exp([1.0, 2.0]), rtol=1e-6)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    np.testing.assert_allclose(d.numpy(), [6.0])
+
+
+def test_matmul_grad_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(4, 5).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    # analytic: d(sum(AB))/dA = 1 @ B^T
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a_np.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y1 = x * 3
+    y2 = x * 4
+    z = (y1 + y2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.autograd.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 4.0]), rtol=1e-6)
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_double_backward_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_does_not_pollute_other_leaves():
+    w = paddle.to_tensor([3.0], stop_gradient=False)
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    out = (w * x).sum()
+    (gx,) = paddle.autograd.grad(out, x, retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    assert w.grad is None and x.grad is None
+
+
+def test_grad_of_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3
+    y = (h * h).sum()
+    (gh,) = paddle.autograd.grad(y, h)
+    np.testing.assert_allclose(gh.numpy(), [12.0])
+
+
+def test_backward_through_int_output_op():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    # int output idx participates in the node; backward must not crash
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 1]])

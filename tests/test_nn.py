@@ -1,0 +1,198 @@
+"""nn.Layer + layers/functional tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = lin(x)
+    assert y.shape == [2, 3]
+    loss = y.sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.shape == [4, 3]
+    assert lin.bias.grad.shape == [3]
+
+
+def test_layer_registry_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    net2 = Net()
+    net2.set_state_dict(sd)
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    x = paddle.randn([2, 4])
+    assert seq(x).shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    y.sum().backward()
+    assert conv.weight.grad is not None
+
+
+def test_conv2d_matches_numpy():
+    # 1x1 conv == per-pixel matmul
+    conv = nn.Conv2D(2, 3, 1, bias_attr=False)
+    x = paddle.randn([1, 2, 4, 4])
+    y = conv(x).numpy()
+    w = conv.weight.numpy().reshape(3, 2)
+    ref = np.einsum("oc,bchw->bohw", w, x.numpy())
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 2, 2])
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 2, 2]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_rms_norm_matches_formula():
+    rn = nn.RMSNorm(16)
+    x = paddle.randn([3, 16])
+    y = rn(x).numpy()
+    xn = x.numpy()
+    ref = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+    rn.weight._grad_value = None
+    out = rn(x).sum()
+    out.backward()
+    assert rn.weight.grad is not None
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    d = nn.Dropout(0.5)
+    d.train()
+    y = d(x)
+    frac = float((y.numpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], dtype=np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_cross_entropy_matches_numpy():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3], dtype=np.int64))
+    loss = F.cross_entropy(logits, labels)
+    ln = logits.numpy().astype(np.float64)
+    p = np.exp(ln - ln.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels.numpy()]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_softmax_axis():
+    x = paddle.randn([2, 3, 4])
+    y = F.softmax(x, axis=1)
+    np.testing.assert_allclose(y.numpy().sum(1), np.ones((2, 4)), rtol=1e-5)
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert F.max_pool2d(x, 2).shape == [1, 2, 4, 4]
+    assert F.avg_pool2d(x, 2, stride=2).shape == [1, 2, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [1, 2, 1, 1]
+
+
+def test_sdpa_matches_reference():
+    paddle.seed(1)
+    q = paddle.randn([2, 8, 2, 16])
+    k = paddle.randn([2, 8, 2, 16])
+    v = paddle.randn([2, 8, 2, 16])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    qn, kn, vn = (t.numpy().astype(np.float64) for t in (q, k, v))
+    s = np.einsum("bqhd,bkhd->bhqk", qn, kn) / np.sqrt(16)
+    mask = np.tril(np.ones((8, 8), dtype=bool))
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, vn)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sdpa_grads_flow():
+    q = paddle.randn([1, 4, 1, 8])
+    q.stop_gradient = False
+    k = paddle.randn([1, 4, 1, 8])
+    v = paddle.randn([1, 4, 1, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    out.sum().backward()
+    assert q.grad is not None and q.grad.shape == [1, 4, 1, 8]
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, -100, 2, -100], dtype=np.int64))
+    loss = F.cross_entropy(logits, labels)
+    ln = logits.numpy().astype(np.float64)
+    p = np.exp(ln - ln.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    ref = -(np.log(p[0, 0]) + np.log(p[2, 2])) / 2
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_pad_partial_convention():
+    x = paddle.ones([1, 1, 2, 3])
+    y = F.pad(x, [1, 1, 0, 0])  # left/right on W only
+    assert y.shape == [1, 1, 2, 5]
+    y2 = F.pad(x, [0, 0, 2, 0])  # top pad on H
+    assert y2.shape == [1, 1, 4, 3]
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([10])
+    y = F.dropout(x, p=0.4, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(y.numpy(), np.full(10, 0.6), rtol=1e-6)

@@ -1,0 +1,147 @@
+"""Optimizer tests: update-rule oracles + convergence smoke."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _quadratic_param():
+    p = paddle.creation.create_parameter((2,), dtype="float32",
+                                         default_initializer=paddle.nn.initializer.Assign(
+                                             np.array([5.0, -3.0], np.float32)))
+    return p
+
+
+def test_sgd_rule():
+    p = _quadratic_param()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [5 - 0.1 * 10, -3 + 0.1 * 6], rtol=1e-6)
+
+
+def test_momentum_velocity():
+    p = _quadratic_param()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    for _ in range(2):
+        (p * p).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # hand computation
+    w = np.array([5.0, -3.0])
+    vel = np.zeros(2)
+    for _ in range(2):
+        g = 2 * w
+        vel = 0.9 * vel + g
+        w = w - 0.1 * vel
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_adam_rule_matches_numpy():
+    p = _quadratic_param()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    w = np.array([5.0, -3.0])
+    m = np.zeros(2)
+    v = np.zeros(2)
+    for t in range(1, 4):
+        (p * p).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        g = 2 * w
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        w = w - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _quadratic_param()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, weight_decay=0.1,
+                                 parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    w = np.array([5.0, -3.0])
+    g = 2 * w
+    mh = (0.1 * g) / (1 - 0.9)
+    vh = (0.001 * g * g) / (1 - 0.999)
+    w = w - 0.01 * (mh / (np.sqrt(vh) + 1e-8) + 0.1 * w)
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_global_norm_clip():
+    p = _quadratic_param()
+    clip = paddle.nn_clip = paddle.optimizer.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    (p * p).sum().backward()
+    g = 2 * np.array([5.0, -3.0])
+    gnorm = np.linalg.norm(g)
+    expected = np.array([5.0, -3.0]) - g / gnorm
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), expected, rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _quadratic_param()
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+
+def test_training_converges():
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 4).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    ys = xs @ true_w + 0.7
+    x = paddle.to_tensor(xs)
+    y = paddle.to_tensor(ys)
+    losses = []
+    for _ in range(150):
+        pred = net(x)
+        loss = F.mse_loss(pred, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.01, losses[-1]
+
+
+def test_multi_precision_master_weights():
+    p = paddle.creation.create_parameter((4,), dtype="float32")
+    p._value = p._value.astype("bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=0.001, parameters=[p],
+                                 multi_precision=True)
+    (p.astype("float32") ** 2).sum().backward()
+    opt.step()
+    state = opt._accumulators[id(p)]
+    assert "master" in state
+    assert str(state["master"].dtype) == "float32"
+    assert str(p._value.dtype) == "bfloat16"
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _quadratic_param()
+    p.name = "w0"
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    p2 = _quadratic_param()
+    p2.name = "w0"
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators[id(p2)]["moment1"]),
+        np.asarray(opt._accumulators[id(p)]["moment1"]))

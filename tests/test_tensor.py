@@ -1,0 +1,108 @@
+"""Tensor + op surface tests (numpy-oracle style, SURVEY.md §4 OpTest model)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_arithmetic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b - a).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+    c = paddle.matmul(a, a, transpose_y=True)
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ a.numpy().T)
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x.sum().numpy(), 66)
+    np.testing.assert_allclose(x.mean(axis=0).numpy(), x.numpy().mean(0))
+    np.testing.assert_allclose(x.max(axis=1, keepdim=True).numpy(),
+                               x.numpy().max(1, keepdims=True))
+    np.testing.assert_allclose(paddle.std(x).numpy(), x.numpy().std(ddof=1),
+                               rtol=1e-6)
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    assert x.transpose([1, 0, 2]).shape == [3, 2, 4]
+    assert paddle.concat([x, x], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([x, x]).shape == [2, 2, 3, 4]
+    parts = paddle.split(x, 2, axis=2)
+    assert len(parts) == 2 and parts[0].shape == [2, 3, 2]
+    parts = paddle.split(x, [1, -1], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    assert x.flatten().shape == [24] or x.flatten(0, -1).shape == [24]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.ones([1, 3, 1]), axis=0).shape == [3, 1]
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0] = paddle.zeros([4])
+    np.testing.assert_allclose(x[0].numpy(), [0, 0, 0, 0])
+
+
+def test_comparison_and_where():
+    a = paddle.to_tensor([1.0, 5.0, 3.0])
+    b = paddle.to_tensor([4.0, 2.0, 3.0])
+    np.testing.assert_array_equal((a > b).numpy(), [False, True, False])
+    np.testing.assert_allclose(paddle.where(a > b, a, b).numpy(), [4, 5, 3])
+    np.testing.assert_allclose(paddle.maximum(a, b).numpy(), [4, 5, 3])
+
+
+def test_gather_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    vals, idx = paddle.topk(x, 2)
+    np.testing.assert_allclose(vals.numpy(), [5, 4])
+    np.testing.assert_array_equal(idx.numpy(), [4, 2])
+    g = paddle.gather(x, paddle.to_tensor([0, 2]))
+    np.testing.assert_allclose(g.numpy(), [3, 4])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 1, 3, 4, 5])
+
+
+def test_cast_dtype():
+    x = paddle.ones([2], dtype="float32")
+    y = x.astype("bfloat16")
+    assert str(y.dtype) == "bfloat16"
+    z = y.astype(paddle.int32)
+    assert z.numpy().dtype == np.int32
+
+
+def test_creation_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4, 4])
+    paddle.seed(42)
+    b = paddle.randn([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    u = paddle.uniform([100], min=-2, max=2)
+    assert float(u.min()) >= -2 and float(u.max()) <= 2
+
+
+def test_einsum():
+    a = paddle.randn([2, 3])
+    b = paddle.randn([3, 4])
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
