@@ -1,0 +1,1 @@
+from .api import ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard, dtensor_from_fn  # noqa: F401

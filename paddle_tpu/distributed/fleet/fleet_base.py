@@ -1,0 +1,96 @@
+"""Fleet facade.
+
+Rebuild of python/paddle/distributed/fleet/fleet.py (fleet.init /
+distributed_model / distributed_optimizer — SURVEY.md §2.4 hybrid row, §3.2
+call stack).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env as _env
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from .distributed_strategy import DistributedStrategy
+from ...parallel import mesh as _mesh
+
+_state = {"strategy": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level=None):
+    """Parity with fleet.init: parse strategy, build topology + mesh, create
+    axis groups."""
+    strategy = strategy or DistributedStrategy()
+    _state["strategy"] = strategy
+    _env.init_parallel_env()
+    degrees = strategy.degrees()
+    order = strategy.hybrid_configs.get("order", list(_mesh.HYBRID_ORDER))
+    # build the global mesh (folds leftover devices into dp) honouring the
+    # configured axis order
+    mesh = _mesh.build_mesh(degrees, order=order)
+    _mesh.set_global_mesh(mesh)
+    actual = {ax: mesh.shape[ax] for ax in mesh.axis_names}
+    dims = [actual.get(ax, 1) for ax in _mesh.HYBRID_ORDER]
+    topo = CommunicateTopology(list(_mesh.HYBRID_ORDER), dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _state["initialized"] = True
+    return None
+
+
+def fleet_initialized() -> bool:
+    return _state["initialized"]
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _state["strategy"]
+
+
+def distributed_model(model):
+    """Wrap per active parallelism (reference dispatch in fleet.py →
+    PipelineParallel / TensorParallel / ShardingParallel wrappers)."""
+    from ..meta_parallel.pipeline_parallel import PipelineParallel
+    from ..meta_parallel.pp_layers import PipelineLayer
+    from ..meta_parallel.parallel_wrapper import HybridParallelModel
+
+    hcg = get_hybrid_communicate_group()
+    strategy = _state["strategy"] or DistributedStrategy()
+    if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError(
+                "pp_degree > 1 requires the model to be a PipelineLayer "
+                "(parity with the reference)")
+        return PipelineParallel(model, hcg, strategy)
+    return HybridParallelModel(model, hcg, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .hybrid_optimizer import HybridParallelOptimizer
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _state["strategy"])
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_communicate_group()
+
+
+# re-export with the fleet.* names
+def worker_index() -> int:
+    return _env.get_rank()
+
+
+def worker_num() -> int:
+    return _env.get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    import jax
+    jax.effects_barrier()

@@ -1,0 +1,61 @@
+"""Model wrappers returned by fleet.distributed_model.
+
+Rebuild of the reference's TensorParallel / ShardingParallel wrappers
+(python/paddle/distributed/fleet/meta_parallel/{tensor_parallel,
+sharding_parallel}.py — SURVEY.md §2.4). Forward stays imperative; the
+compiled path is obtained with ``compile_train_step`` which returns the
+GSPMD HybridTrainStep over the fleet mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ...nn.layer import Layer
+
+
+class HybridParallelModel(Layer):
+    def __init__(self, model: Layer, hcg, strategy):
+        super().__init__()
+        self._layers = model
+        self._hcg = hcg
+        self._strategy = strategy
+        self._train_step = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    @property
+    def inner_model(self):
+        return self._layers
+
+    def compile_train_step(self, loss_fn: Callable, optimizer):
+        """loss_fn(model, *batch) -> scalar. Returns the compiled hybrid step
+        (cached)."""
+        from ..fleet.hybrid_engine import HybridTrainStep
+        if self._train_step is None:
+            inner_opt = getattr(optimizer, "_inner_opt", optimizer)
+            stage = 1
+            if self._strategy is not None and self._strategy.sharding:
+                stage = int(self._strategy.sharding_configs.get("stage", 1))
+            self._train_step = HybridTrainStep(
+                self._layers, loss_fn, inner_opt,
+                mesh=self._hcg.mesh if self._hcg else None,
+                zero_stage=stage)
+        return self._train_step
+
+    def train_batch(self, batch, optimizer, lr_scheduler=None, loss_fn=None):
+        if self._train_step is None:
+            if loss_fn is None:
+                raise ValueError("first train_batch call needs loss_fn")
+            self.compile_train_step(loss_fn, optimizer)
+        loss = self._train_step(*batch)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

@@ -119,6 +119,10 @@ class TrainStep:
 
     # -- build --------------------------------------------------------------
     def _build(self):
+        donate = (0, 1, 2) if self._donate else ()
+        self._jitted = jax.jit(self._make_step_fn(), donate_argnums=donate)
+
+    def _make_step_fn(self):
         model = self.model
         opt = self.optimizer
         loss_fn = self.loss_fn
@@ -167,8 +171,7 @@ class TrainStep:
                 opt._weight_decay = saved_wd
                 return tree_unwrap(loss), new_params, new_state, mutated_buffers
 
-        donate = (0, 1, 2) if self._donate else ()
-        self._jitted = jax.jit(step, donate_argnums=donate)
+        return step
 
     def __call__(self, *batch):
         if self._jitted is None:

@@ -1,0 +1,72 @@
+"""Pipeline-parallel execution over a mesh axis (shard_map + ppermute).
+
+TPU-native rebuild of the reference's PipelineParallel engine
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py — SURVEY.md §2.4 PP row). Instead of NCCL
+send/recv between trainer processes, the whole pipeline is ONE compiled XLA
+program: stages live on submeshes of the ``pp`` axis, activations rotate with
+``lax.ppermute`` over ICI, and the microbatch loop is a ``lax.scan`` — XLA
+overlaps the permute DMA with the next microbatch's compute, which is the
+latency-hiding the reference gets from its separate comm stream.
+
+Schedule: GPipe-style fill-drain (all-forward then AD-driven all-backward).
+The bubble fraction is (S-1)/(M+S-1); interleaved/1F1B variants change peak
+memory, not bubble math, and remat (jax.checkpoint on stage_fn) recovers the
+memory the way 1F1B would.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(stage_fn: Callable, stage_params: Any, microbatches,
+                  axis_name: str = "pp"):
+    """Run inside shard_map. Executes the fill-drain pipeline.
+
+    stage_fn(params, x) -> y : one stage's computation (same structure on
+        every stage; per-stage weights come pre-sliced by shard_map).
+    microbatches: (M, ...) — microbatch-major input, replicated over the pp
+        axis (only stage 0 reads it).
+    Returns (M, ...) outputs — valid on the LAST stage, zeros elsewhere.
+    """
+    S = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    state = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outs = jnp.zeros(microbatches.shape, microbatches.dtype)
+
+    def step(carry, t):
+        state, outs = carry
+        # stage 0 injects microbatch t (clamped; beyond M the value is unused
+        # because the corresponding output write is masked off downstream)
+        inject = microbatches[jnp.clip(t, 0, M - 1)]
+        state = jnp.where(sid == 0, inject, state)
+        state = stage_fn(stage_params, state)
+        # last stage emits microbatch t-(S-1) once the pipe is full
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = jnp.logical_and(sid == S - 1, t >= S - 1)
+        outs = jnp.where(valid, lax.dynamic_update_index_in_dim(outs, state, out_idx, 0), outs)
+        state = lax.ppermute(state, axis_name, perm)
+        return (state, outs), None
+
+    (state, outs), _ = lax.scan(step, (state, outs), jnp.arange(M + S - 1))
+    return outs
+
+
+def last_stage_broadcast(x, axis_name: str = "pp"):
+    """Broadcast the last pp-stage's value to all stages (psum of a mask)."""
+    S = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(sid == S - 1, x, jnp.zeros_like(x)), axis_name)
+
+
+def stage_slice_info(axis_name: str = "pp"):
+    """(stage_id, num_stages) inside shard_map."""
+    return lax.axis_index(axis_name), lax.axis_size(axis_name)

@@ -1,0 +1,301 @@
+"""Distributed engine tests on the 8-device CPU mesh (SURVEY.md §4 (c))."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.parallel import mesh as pmesh, pipeline as ppipe, pcontext
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    pmesh.set_global_mesh(None)
+    dist.topology.set_hybrid_communicate_group(None)
+    yield
+    pmesh.set_global_mesh(None)
+    dist.topology.set_hybrid_communicate_group(None)
+
+
+def test_mesh_build():
+    m = pmesh.build_mesh({"dp": 2, "mp": 4})
+    assert m.shape["dp"] == 2 and m.shape["mp"] == 4 and m.shape["pp"] == 1
+    m2 = pmesh.build_mesh({})  # all into dp
+    assert m2.shape["dp"] == 8
+
+
+def test_collectives_all_reduce():
+    pmesh.set_global_mesh(pmesh.build_mesh({"dp": 8}))
+    g = dist.new_group(axis="dp")
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    dist.all_reduce(x, group=g)
+    np.testing.assert_allclose(x.numpy(), np.full(8, 28.0))
+
+
+def test_collectives_all_gather_and_reduce_scatter():
+    pmesh.set_global_mesh(pmesh.build_mesh({"dp": 8}))
+    g = dist.new_group(axis="dp")
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    # global-array semantics: each rank's "tensor" is its dim-0 shard, so
+    # all_gather reconstitutes the global array (now replicated everywhere)
+    out = dist.all_gather(x, group=g)
+    assert out.shape == [8]
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    # reduce_scatter: replicated input, output = summed tensor scattered
+    rs = dist.reduce_scatter(paddle.to_tensor(np.ones(8, np.float32)), group=g)
+    np.testing.assert_allclose(rs.numpy(), np.full(8, 8.0))
+
+
+def test_alltoall():
+    pmesh.set_global_mesh(pmesh.build_mesh({"dp": 8}))
+    g = dist.new_group(axis="dp")
+    x = paddle.to_tensor(np.arange(64, dtype=np.float32))
+    out = dist.alltoall(x, group=g)
+    assert out.shape == [64]
+    # alltoall twice = identity
+    back = dist.alltoall(out, group=g)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+def test_fleet_init_topology():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    topo = hcg.topology()
+    assert topo.world_size() == 8
+    # rank->coord bijection
+    assert topo.get_coord(0) == (0, 0, 0, 0, 0)
+    groups = topo.get_comm_list("mp")
+    assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _run_training(step_builder, n=6):
+    paddle.seed(11)
+    net = MLP()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=net.parameters(),
+                                 grad_clip=paddle.optimizer.ClipGradByGlobalNorm(1.0))
+    step = step_builder(net, opt)
+    rng = np.random.RandomState(5)
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = rng.randint(0, 4, size=(16,)).astype(np.int64)
+    losses = []
+    for _ in range(n):
+        losses.append(float(step(paddle.to_tensor(xs), paddle.to_tensor(ys))))
+    return losses, net
+
+
+def test_dp_loss_parity_with_single_device():
+    def loss_fn(model, x, y):
+        return F.cross_entropy(model(x), y)
+
+    # single-device compiled step
+    losses_single, _ = _run_training(
+        lambda net, opt: paddle.jit.TrainStep(net, loss_fn, opt))
+
+    # 8-way DP via fleet hybrid engine
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    def build(net, opt):
+        dm = fleet.distributed_model(net)
+        return dm.compile_train_step(loss_fn, opt)
+
+    losses_dp, _ = _run_training(build)
+    np.testing.assert_allclose(losses_single, losses_dp, rtol=2e-4, atol=1e-5)
+
+
+def test_tp_gspmd_loss_parity():
+    from paddle_tpu.distributed.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    def loss_fn(model, x, y):
+        return F.cross_entropy(model(x), y)
+
+    class TPMLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = ColumnParallelLinear(8, 32, gather_output=False)
+            self.fc2 = RowParallelLinear(32, 4, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(21)
+    net = TPMLP()
+    init_sd = {k: paddle.to_tensor(v.numpy()) for k, v in net.state_dict().items()}
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=net.parameters())
+    dm = fleet.distributed_model(net)
+    step = dm.compile_train_step(loss_fn, opt)
+    rng = np.random.RandomState(5)
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = rng.randint(0, 4, size=(16,)).astype(np.int64)
+    tp_losses = [float(step(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+                 for _ in range(4)]
+
+    # reference: plain Linear seeded with the TP model's INITIAL weights
+    pmesh.set_global_mesh(None)
+    dist.topology.set_hybrid_communicate_group(None)
+    ref = MLPWithSameInit()
+    ref.set_state_dict(init_sd)
+    opt2 = paddle.optimizer.AdamW(learning_rate=0.01, parameters=ref.parameters())
+    sstep = paddle.jit.TrainStep(ref, loss_fn, opt2)
+    ref_losses = [float(sstep(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+                  for _ in range(4)]
+    np.testing.assert_allclose(tp_losses, ref_losses, rtol=2e-4, atol=1e-5)
+
+
+class MLPWithSameInit(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_zero_stage1_opt_state_sharded():
+    def loss_fn(model, x, y):
+        return F.mse_loss(model(x), y)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "sharding_degree": 8}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(3)
+    net = MLP()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=net.parameters())
+    dm = fleet.distributed_model(net)
+    step = dm.compile_train_step(loss_fn, opt)
+    x = paddle.randn([16, 8])
+    y = paddle.randn([16, 4])
+    step(x, y)
+    # moment1 of fc1.weight (shape [8, 32]) should be sharded over 'sharding'
+    m1 = opt._accumulators[id(net.fc1.weight)]["moment1"]
+    shardings = {tuple(d.device.id for d in m1.addressable_shards)}
+    assert len(m1.addressable_shards) == 8
+    shard_shape = m1.addressable_shards[0].data.shape
+    assert shard_shape == (1, 32), shard_shape
+
+
+def test_manual_mp_layers_inside_shard_map():
+    """Manual-mode TP layers: run a column+row pair under shard_map and
+    compare with the dense computation."""
+    from paddle_tpu.distributed.meta_parallel import mp_layers as mpl
+
+    mesh = pmesh.build_mesh({"mp": 8})
+    pmesh.set_global_mesh(mesh)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    w1 = rng.randn(16, 32).astype(np.float32)
+    w2 = rng.randn(32, 8).astype(np.float32)
+
+    def fn(xv, w1v, w2v):
+        with pcontext.manual_parallel({"mp": "mp"}):
+            h = jnp.maximum(jnp.matmul(xv, w1v), 0)
+            y = jnp.matmul(h, w2v)
+            y = lax.psum(y, "mp")
+        return y
+
+    f = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), P(None, "mp"), P("mp", None)),
+        out_specs=P(), check_vma=False))
+    out = np.asarray(f(x, w1, w2))
+    ref = np.maximum(x @ w1, 0) @ w2
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_spmd_matches_serial():
+    """Fill-drain pipeline over 8 pp stages == serial application."""
+    mesh = pmesh.build_mesh({"pp": 8})
+    rng = np.random.RandomState(1)
+    M, mbs, h = 4, 2, 16
+    x = rng.randn(M, mbs, h).astype(np.float32)
+    # stage params: one matrix per stage, stacked (8, h, h)
+    ws = (rng.randn(8, h, h) * 0.1).astype(np.float32)
+
+    def stage_fn(w, a):
+        return jnp.tanh(a @ w)
+
+    def pp_fn(w_local, mb):
+        out = ppipe.pipeline_spmd(lambda wp, a: stage_fn(wp[0], a), w_local, mb,
+                                  axis_name="pp")
+        return ppipe.last_stage_broadcast(out, "pp")
+
+    f = jax.jit(jax.shard_map(pp_fn, mesh=mesh,
+                              in_specs=(P("pp"), P()), out_specs=P(),
+                              check_vma=False))
+    out = np.asarray(f(ws, x))
+    # serial reference
+    ref = x.copy()
+    for s in range(8):
+        ref = np.tanh(ref @ ws[s])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_spmd_gradients():
+    mesh = pmesh.build_mesh({"pp": 4})
+    rng = np.random.RandomState(2)
+    M, mbs, h = 4, 2, 8
+    x = rng.randn(M, mbs, h).astype(np.float32)
+    ws = (rng.randn(4, h, h) * 0.1).astype(np.float32)
+
+    def loss_fn(w, xin):
+        def pp_fn(w_local, mb):
+            out = ppipe.pipeline_spmd(lambda wp, a: jnp.tanh(a @ wp[0]),
+                                      w_local, mb, axis_name="pp")
+            out = ppipe.last_stage_broadcast(out, "pp")
+            # replicated loss
+            return jnp.sum(out ** 2)
+        f = jax.shard_map(pp_fn, mesh=mesh, in_specs=(P("pp"), P()),
+                          out_specs=P(), check_vma=False)
+        return f(w, xin)
+
+    g = jax.jit(jax.grad(loss_fn))(ws, x)
+
+    def serial_loss(w, xin):
+        out = xin
+        for s in range(4):
+            out = jnp.tanh(out @ w[s])
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.jit(jax.grad(serial_loss))(ws, x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_shard_tensor_api():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = dist.shard_tensor(np.ones((8, 4), np.float32), mesh,
+                          [dist.Shard(0), dist.Replicate()])
+    assert t.is_distributed
+    assert t._sharding_spec == P("x", None)
