@@ -1,0 +1,107 @@
+"""Benchmark entry (driver-run on real TPU hardware).
+
+Measures the flagship workload: Llama causal-LM training throughput
+(tokens/sec/chip) and MFU on the available accelerator, via the compiled
+hybrid train step (bf16 compute, Pallas flash attention + rms_norm, remat).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value is MFU and vs_baseline is MFU / 0.50 (the north-star ≥50% MFU target,
+BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Peak bf16 TFLOP/s per chip by TPU generation (public figures).
+PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
+
+
+def detect_peak():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in gen:
+            return v, k
+    return PEAK_FLOPS["v5e"], "v5e?"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = True
+    try:
+        platform = jax.devices()[0].platform
+        on_tpu = platform == "tpu"
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+        on_tpu = False
+
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.parallel import mesh as pmesh
+
+    if on_tpu:
+        # ~350M-param model that exercises the full decoder path on one chip
+        cfg = L.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=2048, dtype=jnp.bfloat16)
+        B, S, steps, warmup = 8, 2048, 10, 2
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=4)
+        B, S, steps, warmup = 4, 64, 4, 1
+
+    mesh = pmesh.build_mesh({}, devices=jax.devices()[:1])
+    pmesh.set_global_mesh(mesh)
+    step, init_fn = L.build_hybrid_train_step(cfg, mesh, learning_rate=1e-4,
+                                              remat=True)
+    params, opt_state = init_fn(seed=0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (1, B, S)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=-1).astype(np.int32)
+
+    # warmup/compile
+    for _ in range(warmup):
+        loss, params, opt_state = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = B * S * steps
+    tok_per_sec = tokens / dt
+
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    h, l = cfg.hidden_size, cfg.num_hidden_layers
+    # training FLOPs/token: 6 FLOPs/param/token for matmul params (embedding
+    # table is a gather, excluded) + causal attention ≈ 6*L*S*h (12*L*S*h for
+    # full attention, halved by causal masking)
+    n_matmul = n_params - cfg.vocab_size * h  # exclude embed gather
+    flops_per_token = 6.0 * n_matmul + 6.0 * l * S * h
+    achieved = flops_per_token * tok_per_sec
+
+    peak, gen = detect_peak()
+    if not on_tpu:
+        peak = None
+    mfu = achieved / peak if peak else 0.0
+
+    result = {
+        "metric": f"llama_{n_params/1e6:.0f}M_train_mfu_{gen if on_tpu else platform}",
+        "value": round(mfu, 4) if on_tpu else round(tok_per_sec, 2),
+        "unit": "MFU" if on_tpu else "tokens/sec (cpu smoke)",
+        "vs_baseline": round(mfu / 0.5, 4) if on_tpu else 0.0,
+        "tokens_per_sec": round(tok_per_sec, 1),
+        "loss": float(loss),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,31 @@ class VocabParallelEmbedding(Layer):
         return F.embedding(x, self.weight)
 
 
+def vocab_parallel_ce_array(lg, lab, axis: str, ignore_index: Optional[int] = None):
+    """Array-level CE over vocab-sharded logits inside shard_map (shared by
+    ParallelCrossEntropy and the llama hybrid step). lg: (..., V_local) fp32;
+    lab: (...) int. Returns per-token loss; ignored positions get 0."""
+    lg = lg.astype(jnp.float32)
+    idx = lax.axis_index(axis)
+    per = lg.shape[-1]
+    start = idx * per
+    # stability shift; input detached because pmax has no AD rule and the
+    # shift's gradient contributions cancel exactly
+    gmax = lax.pmax(lax.stop_gradient(jnp.max(lg, axis=-1)), axis)
+    ex = jnp.exp(lg - gmax[..., None])
+    denom = lax.psum(jnp.sum(ex, axis=-1), axis)
+    li = lab.astype(jnp.int32)
+    local = li - start
+    ok = (local >= 0) & (local < per)
+    picked = jnp.take_along_axis(lg, jnp.where(ok, local, 0)[..., None],
+                                 axis=-1)[..., 0]
+    target = lax.psum(jnp.where(ok, picked, 0.0), axis)
+    loss = jnp.log(denom) + gmax - target
+    if ignore_index is not None:
+        loss = jnp.where(li != ignore_index, loss, 0.0)
+    return loss
+
+
 class ParallelCrossEntropy(Layer):
     """CE over vocab-sharded logits.
 
@@ -178,26 +203,11 @@ class ParallelCrossEntropy(Layer):
             ignore = self.ignore_index
 
             def fn(logits, lab):
-                lg = logits.astype(jnp.float32)
-                n = lax.axis_size(ax)
-                idx = lax.axis_index(ax)
-                per = lg.shape[-1]
-                start = idx * per
-                gmax = lax.pmax(jnp.max(lg, axis=-1), ax)
-                ex = jnp.exp(lg - gmax[..., None])
-                denom = lax.psum(jnp.sum(ex, axis=-1), ax)
-                li = lab.astype(jnp.int32)
-                if li.ndim == lg.ndim:
+                li = lab
+                if li.ndim == logits.ndim:
                     li = li[..., 0]
-                local = li - start
-                in_range = (local >= 0) & (local < per)
-                safe = jnp.where(in_range, local, 0)
-                picked = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
-                picked = jnp.where(in_range, picked, 0.0)
-                target_logit = lax.psum(picked, ax)
-                loss = jnp.log(denom) + gmax - target_logit
-                mask = li != ignore
-                return jnp.where(mask, loss, 0.0)
+                return vocab_parallel_ce_array(logits, li, ax,
+                                               ignore_index=ignore)
 
             return apply(fn, input, label, op_name="parallel_cross_entropy")
         return F.cross_entropy(input, label, reduction="none",
