@@ -32,17 +32,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..nn.layer import Layer
-from ..nn import initializer as I
-from ..nn.common_layers import RMSNorm, Linear, Embedding
+from ..nn.common_layers import RMSNorm
 from ..ops import rope as rope_ops
 from ..ops import flash_attention as fa
+from ..ops.rms_norm import rms_norm_array
 from ..distributed.meta_parallel.mp_layers import (
-    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
-    ParallelCrossEntropy)
-from ..parallel import mesh as _mesh
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+#: per-layer tensors in the stacked functional layout (leading L axis).
+LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln1", "ln2")
 
 
 @dataclasses.dataclass
@@ -197,8 +197,7 @@ def forward_stacked(params: Dict[str, Any], ids, config: LlamaConfig):
         return _decoder_layer_manual(lp, carry, cos, sin, config=config,
                                      mp_axis=None, fsdp_axis=None), None
 
-    layer_params = {k: params[k] for k in ("wq", "wk", "wv", "wo", "w_gate",
-                                           "w_up", "w_down", "ln1", "ln2")}
+    layer_params = {k: params[k] for k in LAYER_KEYS}
     x, _ = lax.scan(body, x, layer_params)
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     return jnp.einsum("bsh,hv->bsv", x, params["lm_head"])
@@ -265,15 +264,21 @@ def stacked_param_specs(config: LlamaConfig) -> Dict[str, P]:
 
 
 def _rms(x, w, eps):
-    xf = x.astype(jnp.float32)
-    inv = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+    # fused Pallas rms_norm on TPU (ops/rms_norm.py), XLA ref path elsewhere
+    return rms_norm_array(x, w, eps)
 
 
-def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis, fsdp_axis):
+def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
+                          fsdp_axis, sep_axis=None):
     """One decoder layer inside shard_map. Weight locals: wq (h, h/mp) etc.
     (the fsdp axis shards the *contraction* dim h — all-gathered here, which
-    is the ZeRO-3 gather; XLA overlaps it with the previous layer)."""
+    is the ZeRO-3 gather; XLA overlaps it with the previous layer).
+
+    When ``sep_axis`` is set, activations arrive sequence-sharded and
+    attention runs Ulysses-style (SURVEY.md §5.7 mechanism 2): all_to_all
+    repartitions (heads_local → seq_full) before attention and back after, so
+    causal attention always sees the full sequence per head subset.
+    """
     b, s, h = x.shape
     d = config.head_dim
 
@@ -297,7 +302,14 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis, fsdp_axi
     k = k.reshape(b, s, nkv_local, d)
     v = v.reshape(b, s, nkv_local, d)
     q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    if sep_axis is not None:
+        # (b, s_local, nh, d) -> (b, s_full, nh/sep, d)
+        q, k, v = (lax.all_to_all(t, sep_axis, split_axis=2, concat_axis=1,
+                                  tiled=True) for t in (q, k, v))
     attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+    if sep_axis is not None:
+        attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
+                              tiled=True)
     attn = attn.reshape(b, s, -1)
     out = jnp.einsum("bsd,dh->bsh", attn, gather_out(p["wo"]))
     if mp_axis is not None:
@@ -324,7 +336,9 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
     Parallelism inside: dp (batch), pp (fill-drain ppermute pipeline), mp
     (Megatron collectives), sharding (ZeRO-3 weight sharding with per-layer
-    all_gather), sp (sequence sharding of activations outside attention).
+    all_gather), and — with ``seq_shard=True`` and a ``sep`` mesh axis —
+    Ulysses context parallelism (activations sequence-sharded; all_to_all
+    head/seq repartition around attention).
     Optimizer: fused AdamW (state sharded like the weights).
     """
     from ..parallel import pipeline as ppipe
@@ -332,11 +346,15 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     pp = mesh.shape.get("pp", 1)
     mp = mesh.shape.get("mp", 1)
     sep = mesh.shape.get("sep", 1)
-    if sep > 1 and seq_shard:
-        raise NotImplementedError(
-            "sequence sharding over 'sep' requires the ring-attention path "
-            "(paddle_tpu.ops.ring_attention); build with seq_shard=False or "
-            "use build_ring_hybrid_train_step once available")
+    sep_axis = "sep" if (seq_shard and sep > 1) else None
+    if seq_shard and sep <= 1:
+        raise ValueError("seq_shard=True requires a 'sep' mesh axis of size>1")
+    if sep_axis is not None:
+        nh, nkv = config.num_attention_heads, config.num_key_value_heads
+        if nh % (mp * sep) or nkv % (mp * sep):
+            raise ValueError(
+                f"Ulysses sep={sep} with mp={mp} needs heads divisible by "
+                f"mp*sep (got q={nh}, kv={nkv})")
     fsdp = mesh.shape.get("sharding", 1) * mesh.shape.get("dp", 1)
     mp_axis = "mp" if mp > 1 else None
     fsdp_axes = ("dp", "sharding")
@@ -347,9 +365,16 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     assert config.num_hidden_layers % pp == 0
 
     def spmd_loss(params, ids, labels):
-        """Runs per-device inside shard_map. ids/labels: (M, mb_local, S)."""
+        """Runs per-device inside shard_map. ids/labels: (M, mb_local, S_local)."""
         M, mb, S = ids.shape
-        cos, sin = rope_ops.build_rope_cache(S, config.head_dim, config.rope_theta)
+        s_glob = S * sep if sep_axis is not None else S
+        cos, sin = rope_ops.build_rope_cache(s_glob, config.head_dim,
+                                             config.rope_theta)
+        if sep_axis is not None:
+            # RoPE runs pre-all_to_all on the local chunk: slice its positions
+            off = lax.axis_index(sep_axis) * S
+            cos = lax.dynamic_slice_in_dim(cos, off, S, axis=0)
+            sin = lax.dynamic_slice_in_dim(sin, off, S, axis=0)
 
         def embed(i):
             return jnp.take(params["embed"], i.astype(jnp.int32), axis=0)
@@ -357,14 +382,13 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
         def stage_fn(sparams, x):
             def layer_body(carry, lp):
                 fn = functools.partial(_decoder_layer_manual, config=config,
-                                       mp_axis=mp_axis, fsdp_axis=fsdp_axis)
+                                       mp_axis=mp_axis, fsdp_axis=fsdp_axis,
+                                       sep_axis=sep_axis)
                 if remat:
                     fn = jax.checkpoint(fn)
                 return fn(lp, carry, cos, sin), None
 
-            layer_params = {k: sparams[k] for k in
-                            ("wq", "wk", "wv", "wo", "w_gate", "w_up",
-                             "w_down", "ln1", "ln2")}
+            layer_params = {k: sparams[k] for k in LAYER_KEYS}
             x, _ = lax.scan(layer_body, x, layer_params)
             return x
 
@@ -386,16 +410,11 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             def pp_stage(sp, a):
                 return stage_fn(sp, a)
             out = ppipe.pipeline_spmd(
-                pp_stage,
-                {k: params[k] for k in ("wq", "wk", "wv", "wo", "w_gate",
-                                        "w_up", "w_down", "ln1", "ln2")},
-                x, axis_name="pp")
+                pp_stage, {k: params[k] for k in LAYER_KEYS}, x, axis_name="pp")
             out = ppipe.last_stage_broadcast(out, "pp")
         else:
             def micro_body(_, xm):
-                return None, stage_fn(
-                    {k: params[k] for k in ("wq", "wk", "wv", "wo", "w_gate",
-                                            "w_up", "w_down", "ln1", "ln2")}, xm)
+                return None, stage_fn({k: params[k] for k in LAYER_KEYS}, xm)
             _, out = lax.scan(micro_body, None, x)
 
         out = _rms(out, params["ln_f"], eps)
@@ -411,13 +430,16 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             logp = jax.nn.log_softmax(lg, axis=-1)
             picked = jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
             loss = -jnp.mean(picked)
-        # mean over dp/sharding batch shards
+        # mean over dp/sharding batch shards (+ sep sequence shards)
         for ax in ("dp", "sharding"):
             if mesh.shape.get(ax, 1) > 1:
                 loss = lax.pmean(loss, ax)
+        if sep_axis is not None:
+            loss = lax.pmean(loss, sep_axis)
         return loss
 
-    batch_in_spec = P(None, ("dp", "sharding"), None)
+    batch_in_spec = P(None, ("dp", "sharding"),
+                      "sep" if sep_axis is not None else None)
 
     def loss_shardmapped(params, ids, labels):
         f = jax.shard_map(

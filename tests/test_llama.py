@@ -51,8 +51,7 @@ def serial_reference_loss(params, ids, labels, cfg):
         return x
 
     for i in range(cfg.num_hidden_layers):
-        lp = {k: params[k][i] for k in ("wq", "wk", "wv", "wo", "w_gate",
-                                        "w_up", "w_down", "ln1", "ln2")}
+        lp = {k: params[k][i] for k in L.LAYER_KEYS}
         x = one_layer(x, lp)
     xf = x.astype(jnp.float32)
     inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + cfg.rms_norm_eps)
@@ -116,6 +115,27 @@ def test_hybrid_step_trains():
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert not any(np.isnan(l) for l in losses)
+
+
+def test_hybrid_step_with_sep_ulysses():
+    """Context parallelism: sequence sharded over 'sep', attention via
+    all_to_all head repartition. Must match the single-device oracle."""
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    mesh = pmesh.build_mesh({"dp": 2, "sep": 2, "mp": 2})
+    pmesh.set_global_mesh(mesh)
+    step, init_fn = L.build_hybrid_train_step(cfg, mesh, learning_rate=0.0,
+                                              remat=False, seq_shard=True)
+    params, opt_state = init_fn(seed=0)
+    rng = np.random.RandomState(3)
+    M, B, S = 1, 4, 32
+    ids = rng.randint(0, cfg.vocab_size, (M, B, S)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=-1).astype(np.int32)
+    loss, params2, _ = step(params, opt_state, ids, labels)
+    ref = L.loss_stacked(
+        {k: jnp.asarray(np.asarray(v)) for k, v in params2.items()},
+        jnp.asarray(ids.reshape(M * B, S)), jnp.asarray(labels.reshape(M * B, S)),
+        cfg)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4, atol=2e-5)
 
 
 def test_hybrid_step_with_zero3_sharding():

@@ -1,0 +1,147 @@
+"""Vision zoo tests — model forward shapes, transforms numerics, datasets.
+
+Mirrors the reference's test strategy (SURVEY.md §4): numpy oracles for
+transforms; shape/grad checks for models (full ImageNet-size forward is a
+bench concern, not a unit-test concern).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms, datasets, models
+from paddle_tpu.vision.transforms import functional as F
+
+
+# --------------------------------------------------------------------- models
+def test_resnet18_forward_and_grad():
+    m = models.resnet18(num_classes=10)
+    x = paddle.randn([2, 3, 64, 64])
+    out = m(x)
+    assert out.shape == [2, 10]
+    loss = out.sum()
+    loss.backward()
+    g = m.conv1.weight.grad
+    assert g is not None and list(g.shape) == [64, 3, 7, 7]
+
+
+def test_resnet50_bottleneck_forward():
+    m = models.resnet50(num_classes=8)
+    x = paddle.randn([1, 3, 64, 64])
+    assert m(x).shape == [1, 8]
+
+
+def test_resnext_and_wide_constructors():
+    m = models.resnext50_32x4d(num_classes=4)
+    assert m(paddle.randn([1, 3, 64, 64])).shape == [1, 4]
+    m2 = models.wide_resnet50_2(num_classes=4)
+    assert m2(paddle.randn([1, 3, 64, 64])).shape == [1, 4]
+
+
+def test_vgg11_forward():
+    m = models.vgg11(num_classes=5)
+    x = paddle.randn([1, 3, 224, 224])
+    assert m(x).shape == [1, 5]
+
+
+def test_mobilenet_v1_v2_forward():
+    m1 = models.mobilenet_v1(scale=0.25, num_classes=6)
+    assert m1(paddle.randn([1, 3, 64, 64])).shape == [1, 6]
+    m2 = models.mobilenet_v2(scale=0.25, num_classes=6)
+    assert m2(paddle.randn([1, 3, 64, 64])).shape == [1, 6]
+
+
+def test_lenet_train_step():
+    m = models.LeNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.randn([4, 1, 28, 28])
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], dtype=np.int64))
+    out = m(x)
+    loss = paddle.nn.functional.cross_entropy(out, y)
+    loss.backward()
+    before = m.fc[0].weight.numpy().copy()
+    opt.step()
+    assert not np.allclose(before, m.fc[0].weight.numpy())
+
+
+# ----------------------------------------------------------------- transforms
+def test_resize_bilinear_matches_manual():
+    img = np.arange(16, dtype=np.uint8).reshape(4, 4, 1)
+    out = F.resize(img, (2, 2))
+    assert out.shape == (2, 2, 1)
+    # half-pixel bilinear of a linear ramp = mean of each 2x2 block
+    expected = img.reshape(2, 2, 2, 2, 1).mean(axis=(1, 3))
+    np.testing.assert_allclose(out.astype(np.float32), expected, atol=1.0)
+
+
+def test_resize_short_side():
+    img = np.zeros((10, 20, 3), dtype=np.uint8)
+    out = F.resize(img, 5)
+    assert out.shape == (5, 10, 3)
+
+
+def test_center_crop_and_flip():
+    img = np.arange(25, dtype=np.uint8).reshape(5, 5, 1)
+    c = F.center_crop(img, 3)
+    assert c.shape == (3, 3, 1) and c[0, 0, 0] == 6
+    np.testing.assert_array_equal(F.hflip(img)[:, 0], img[:, -1])
+    np.testing.assert_array_equal(F.vflip(img)[0], img[-1])
+
+
+def test_normalize_and_to_tensor():
+    img = np.full((2, 2, 3), 255, dtype=np.uint8)
+    t = F.to_tensor(img)  # CHW [0,1]
+    assert t.shape == (3, 2, 2) and t.max() == pytest.approx(1.0)
+    n = F.normalize(t, mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])
+    np.testing.assert_allclose(n, np.ones_like(n))
+
+
+def test_compose_pipeline():
+    tf = transforms.Compose([
+        transforms.Resize(8),
+        transforms.CenterCrop(8),
+        transforms.RandomHorizontalFlip(0.0),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5], std=[0.5]),
+    ])
+    out = tf(np.zeros((16, 16, 1), dtype=np.uint8))
+    assert out.shape == (1, 8, 8)
+    np.testing.assert_allclose(out, -np.ones_like(out))
+
+
+def test_pad_rotate_grayscale():
+    img = np.ones((4, 4, 3), dtype=np.uint8) * 100
+    assert F.pad(img, 2).shape == (8, 8, 3)
+    r = F.rotate(img, 90)
+    assert r.shape == img.shape
+    g = F.to_grayscale(img)
+    assert g.shape == (4, 4, 1) and g[0, 0, 0] == 100
+
+
+# ------------------------------------------------------------------- datasets
+def test_fake_data_with_loader():
+    ds = datasets.FakeData(size=16, image_shape=(3, 8, 8), num_classes=4)
+    img, label = ds[0]
+    assert img.shape == (3, 8, 8) and 0 <= int(label) < 4
+    # deterministic
+    img2, label2 = ds[0]
+    np.testing.assert_array_equal(img, img2)
+
+    loader = paddle.io.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert list(xb.shape) == [4, 3, 8, 8] and list(yb.shape) == [4]
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.zeros((2, 2, 3), dtype=np.uint8))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    img, label = ds[5]
+    assert img.shape == (2, 2, 3) and int(label) == 1
