@@ -51,16 +51,23 @@ class HybridParallelOptimizer:
     keeps the reference's API (step/clear_grad/state_dict/…)."""
 
     def __init__(self, optimizer: Optimizer, hcg, strategy):
+        # reference: when sharding_degree > 1 the inner optimizer is wrapped
+        # in DygraphShardingOptimizer (stage 1) before the hybrid wrapper
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1 and \
+                isinstance(optimizer, Optimizer):
+            from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+            optimizer = DygraphShardingOptimizer(optimizer, hcg)
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
         # reference behaviour: only ClipGradByGlobalNorm is swapped for the
         # hybrid-aware variant; other clip types keep their own semantics.
-        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) and \
-                not isinstance(optimizer._grad_clip, HybridParallelClipGrad) and \
+        inner = getattr(optimizer, "inner_opt", optimizer)
+        if isinstance(inner._grad_clip, ClipGradByGlobalNorm) and \
+                not isinstance(inner._grad_clip, HybridParallelClipGrad) and \
                 hcg is not None:
-            optimizer._grad_clip = HybridParallelClipGrad(
-                optimizer._grad_clip, hcg)
+            inner._grad_clip = HybridParallelClipGrad(
+                inner._grad_clip, hcg)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

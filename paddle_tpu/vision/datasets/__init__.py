@@ -23,9 +23,10 @@ class FakeData(Dataset):
     """Synthetic dataset: deterministic random images + labels (benchmark
     input pipeline; not in the reference, needed for offline parity tests)."""
 
-    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+    def __init__(self, size=1000, image_shape=(224, 224, 3), num_classes=1000,
                  transform=None, seed=0):
         self.size = size
+        # images are generated HWC (the layout every transform expects)
         self.image_shape = tuple(image_shape)
         self.num_classes = num_classes
         self.transform = transform
@@ -40,7 +41,9 @@ class FakeData(Dataset):
         label = rng.randint(0, self.num_classes)
         if self.transform is not None:
             img = self.transform(img)
-        return img.astype(np.float32) / 255.0, np.int64(label)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.int64(label)
 
 
 class MNIST(Dataset):
@@ -48,8 +51,11 @@ class MNIST(Dataset):
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=False, backend=None):
-        if download and (image_path is None or not os.path.exists(image_path)):
-            raise RuntimeError("offline build: provide local image_path/label_path")
+        if image_path is None or label_path is None or \
+                not os.path.exists(image_path) or not os.path.exists(label_path):
+            raise RuntimeError(
+                "offline build: provide local image_path/label_path "
+                "(download is unavailable)")
         self.mode = mode
         self.transform = transform
         self.images, self.labels = self._load(image_path, label_path)
@@ -88,8 +94,10 @@ class Cifar10(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
-        if download and (data_file is None or not os.path.exists(data_file)):
-            raise RuntimeError("offline build: provide local data_file")
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "offline build: provide local data_file (download is "
+                "unavailable)")
         self.mode = mode
         self.transform = transform
         self.data, self.labels = self._load(data_file, mode)
@@ -125,6 +133,22 @@ class Cifar100(Cifar10):
     _n_classes = 100
 
 
+_DEFAULT_EXTENSIONS = (".npy",)
+
+
+def _default_loader(path):
+    return np.load(path)
+
+
+def _iter_valid_files(dirpath, fnames, extensions, is_valid_file):
+    for fname in sorted(fnames):
+        path = os.path.join(dirpath, fname)
+        ok = (is_valid_file(path) if is_valid_file is not None
+              else fname.lower().endswith(extensions))
+        if ok:
+            yield path
+
+
 class DatasetFolder(Dataset):
     """class-per-subdir image folder (reference: datasets/folder.py:§0).
     ``loader`` defaults to raw-numpy .npy loading; image decoding is
@@ -133,8 +157,8 @@ class DatasetFolder(Dataset):
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
         self.root = root
-        self.loader = loader or (lambda p: np.load(p))
-        extensions = extensions or (".npy",)
+        self.loader = loader or _default_loader
+        extensions = extensions or _DEFAULT_EXTENSIONS
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         self.classes = classes
@@ -142,12 +166,9 @@ class DatasetFolder(Dataset):
         self.samples = []
         for c in classes:
             cdir = os.path.join(root, c)
-            for fname in sorted(os.listdir(cdir)):
-                path = os.path.join(cdir, fname)
-                ok = (is_valid_file(path) if is_valid_file is not None
-                      else fname.lower().endswith(extensions))
-                if ok:
-                    self.samples.append((path, self.class_to_idx[c]))
+            for path in _iter_valid_files(cdir, os.listdir(cdir), extensions,
+                                          is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
         self.transform = transform
 
     def __len__(self):
@@ -167,16 +188,12 @@ class ImageFolder(DatasetFolder):
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
         self.root = root
-        self.loader = loader or (lambda p: np.load(p))
-        extensions = extensions or (".npy",)
+        self.loader = loader or _default_loader
+        extensions = extensions or _DEFAULT_EXTENSIONS
         self.samples = []
         for dirpath, _, fnames in sorted(os.walk(root)):
-            for fname in sorted(fnames):
-                path = os.path.join(dirpath, fname)
-                ok = (is_valid_file(path) if is_valid_file is not None
-                      else fname.lower().endswith(extensions))
-                if ok:
-                    self.samples.append(path)
+            self.samples.extend(
+                _iter_valid_files(dirpath, fnames, extensions, is_valid_file))
         self.transform = transform
 
     def __len__(self):

@@ -155,8 +155,14 @@ class Normalize(BaseTransform):
         self.mean = mean
         self.std = std
         self.data_format = data_format
+        self.to_rgb = to_rgb
 
     def _apply_image(self, img):
+        if self.to_rgb:
+            img = np.asarray(img)
+            # channel axis position follows data_format (reference reverses
+            # BGR→RGB before normalizing)
+            img = img[::-1] if self.data_format == "CHW" else img[..., ::-1]
         return normalize(img, self.mean, self.std, self.data_format)
 
 
