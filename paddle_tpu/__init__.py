@@ -50,10 +50,14 @@ from .framework.io_save import save, load  # noqa: F401
 def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
-                "profiler", "models", "inference"):
+                "profiler", "models", "inference", "static"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in ("Model", "summary"):
+        from .hapi import Model, summary
+        globals().update(Model=Model, summary=summary)
+        return globals()[name]
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

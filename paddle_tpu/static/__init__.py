@@ -1,0 +1,34 @@
+"""``paddle_tpu.static`` — minimal static-graph-surface parity.
+
+The reference's static graph engine (ProgramDesc + StandaloneExecutor,
+SURVEY.md §2.1) is replaced wholesale by jax tracing + XLA; what user code
+actually consumes from ``paddle.static`` in dygraph-era scripts is
+``InputSpec``, kept here.
+"""
+
+from __future__ import annotations
+
+from ..core.dtype import convert_dtype
+
+
+class InputSpec:
+    """Shape/dtype declaration for jit/save surfaces (reference:
+    python/paddle/static/input.py:§0)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)

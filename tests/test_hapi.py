@@ -1,0 +1,161 @@
+"""hapi Model API tests (reference test style: test/legacy_test/test_model.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping, ModelCheckpoint,
+                                       ReduceLROnPlateau, VisualDL)
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class XorDataset(Dataset):
+    """Tiny separable problem: y = (x0 > 0) ^ (x1 > 0)."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 2).astype(np.float32)
+        self.y = ((self.x[:, 0] > 0) ^ (self.x[:, 1] > 0)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class XorInputs(XorDataset):
+    """Inputs-only view (reference predict datasets carry no labels)."""
+
+    def __getitem__(self, i):
+        return self.x[i]
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_evaluate_predict(capsys):
+    model = _model()
+    history = model.fit(XorDataset(512), XorDataset(64, seed=1), batch_size=32,
+                        epochs=20, verbose=0)
+    assert len(history) == 20
+    assert history[-1]["loss"] < history[0]["loss"]
+    res = model.evaluate(XorDataset(64, seed=2), batch_size=32, verbose=0)
+    assert res["acc"] > 0.9
+    outs = model.predict(XorInputs(16, seed=3), batch_size=8,
+                         stack_outputs=True, verbose=0)
+    assert len(outs) == 1 and outs[0].shape == (16, 2)
+
+
+def test_train_eval_batch():
+    model = _model()
+    x = np.random.RandomState(0).randn(8, 2).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    l0 = model.train_batch([x], [y])
+    assert len(l0) == 1 and np.isfinite(l0[0])
+    le = model.eval_batch([x], [y])
+    assert len(le) == 1 and np.isfinite(le[0])
+    p = model.predict_batch([x])
+    assert p[0].shape == (8, 2)
+
+
+def test_save_load(tmp_path):
+    model = _model()
+    model.fit(XorDataset(32), batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+    model2 = _model()
+    model2.load(path)
+    for (n, p), (_, q) in zip(sorted(model.network.named_parameters()),
+                              sorted(model2.network.named_parameters())):
+        np.testing.assert_allclose(np.asarray(p._value), np.asarray(q._value),
+                                   err_msg=n)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    model = _model()
+    save_dir = str(tmp_path / "ck")
+    model.fit(XorDataset(32), batch_size=16, epochs=2, save_dir=save_dir,
+              save_freq=1, verbose=0)
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+
+def test_early_stopping():
+    model = _model()
+    es = EarlyStopping(monitor="acc", mode="max", patience=1, verbose=0,
+                       baseline=1.1)  # impossible baseline -> stops fast
+    model.fit(XorDataset(32), XorDataset(32, seed=1), batch_size=16,
+              epochs=10, eval_freq=1, callbacks=[es], verbose=0)
+    assert model.stop_training
+
+
+def test_custom_callback_order():
+    events = []
+
+    class Rec(Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(("begin", epoch))
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(("end", epoch))
+
+    model = _model()
+    model.fit(XorDataset(32), batch_size=16, epochs=2, callbacks=[Rec()],
+              verbose=0)
+    assert events == [("begin", 0), ("end", 0), ("begin", 1), ("end", 1)]
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 2))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, nn.CrossEntropyLoss())
+    model.fit(XorDataset(32), batch_size=16, epochs=1, verbose=0)
+    # 2 steps with step_size=2 -> one decay
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_visualdl_logs(tmp_path):
+    model = _model()
+    vdl = VisualDL(str(tmp_path / "vdl"))
+    model.fit(XorDataset(32), batch_size=16, epochs=1, callbacks=[vdl],
+              verbose=0)
+    assert os.path.exists(str(tmp_path / "vdl" / "train.log"))
+
+
+def test_summary(capsys):
+    model = _model()
+    info = model.summary()
+    # (2*32 + 32) + (32*2 + 2)
+    assert info["total_params"] == 96 + 66
+    top = paddle.summary(model.network)
+    assert top["trainable_params"] == info["total_params"]
+
+
+def test_jit_compile_path():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), jit_compile=True)
+    history = model.fit(XorDataset(), batch_size=32, epochs=4, verbose=0)
+    assert history[-1]["loss"] < history[0]["loss"]
