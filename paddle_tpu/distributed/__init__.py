@@ -17,5 +17,7 @@ from .topology import (  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import env  # noqa: F401
 from .auto_parallel.api import shard_tensor, ProcessMesh, Shard, Replicate, Partial  # noqa: F401

@@ -235,12 +235,22 @@ class EarlyStopping(Callback):
         if self.monitor_op(current - self.min_delta, self.best_value):
             self.best_value = current
             self.wait_epoch = 0
+            if self.save_best_model and self.model is not None:
+                self.best_weights = {
+                    k: np.array(np.asarray(v._value))
+                    for k, v in self.model.network.state_dict().items()}
         else:
             self.wait_epoch += 1
         if self.wait_epoch >= self.patience:
             self.model.stop_training = True
             if self.verbose:
                 print(f"Epoch {self.stopped_epoch + 1}: early stopping")
+
+    def on_train_end(self, logs=None):
+        # restore the best-seen weights (reference persists best_model;
+        # in-memory restore keeps the semantics without a save_dir)
+        if self.save_best_model and self.best_weights is not None:
+            self.model.network.set_state_dict(self.best_weights)
 
 
 class ReduceLROnPlateau(Callback):

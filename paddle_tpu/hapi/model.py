@@ -37,6 +37,15 @@ def _to_tensor(x):
     return Tensor(np.asarray(x))
 
 
+def _batch_len(x, default):
+    """Leading-dim size of a batch element (Tensor or numpy)."""
+    try:
+        v = x._value if isinstance(x, Tensor) else x
+        return int(np.asarray(v).shape[0])
+    except Exception:
+        return default
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -51,6 +60,7 @@ class Model:
     # -- setup --------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, jit_compile: bool = False):
+        self._train_step = None  # re-prepare drops any old compiled step
         self._optimizer = optimizer
         if loss is not None and not callable(loss):
             raise TypeError("loss must be a Layer or a callable")
@@ -205,13 +215,15 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
+            pending_grads = False
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
-                losses = self.train_batch(
-                    ins, labs, update=(step + 1) % accumulate_grad_batches == 0)
+                update = (step + 1) % accumulate_grad_batches == 0
+                losses = self.train_batch(ins, labs, update=update)
+                pending_grads = not update
                 logs["loss"] = losses[0] if len(losses) == 1 else losses
-                logs["batch_size"] = (np.asarray(ins[0]._value).shape[0]
+                logs["batch_size"] = (_batch_len(ins[0], batch_size)
                                       if ins else batch_size)
                 if self._train_step is None:
                     self._metric_logs(logs)
@@ -220,6 +232,11 @@ class Model:
                 if num_iters is not None and total_iters >= num_iters:
                     self.stop_training = True
                     break
+            if pending_grads:
+                # flush a partial accumulation group so stale grads never
+                # leak into the next epoch's first update
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs)
             history.append(dict(logs))
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
@@ -258,7 +275,7 @@ class Model:
             losses = self.eval_batch(ins, labs)
             if losses:
                 logs["loss"] = losses[0] if len(losses) == 1 else losses
-            seen += np.asarray(ins[0]._value).shape[0] if ins else 0
+            seen += _batch_len(ins[0], 0) if ins else 0
             self._metric_logs(logs)
             cbks.on_eval_batch_end(step, logs)
             if num_samples is not None and seen >= num_samples:
