@@ -219,7 +219,8 @@ class Parameter(Tensor):
     """Trainable tensor; ``stop_gradient=False`` by default (reference:
     python/paddle — framework Parameter; SURVEY.md §2.1 AutogradMeta)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed_param")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed_param", "expert")
 
     def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -229,6 +230,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed_param = False
+        self.expert = False  # expert-parallel param (MoE): excluded from dp sync
 
     def set_value(self, value):
         v = value._value if isinstance(value, Tensor) else jnp.asarray(value)

@@ -25,10 +25,22 @@ from .save_state_dict import _BF16
 from .utils import flatten_state_dict
 
 
-def _read_metadata(path: str) -> Metadata:
+def _read_metadata(path: str, unique_id=None) -> Metadata:
     files = sorted(glob.glob(os.path.join(path, "*.metadata")))
     if not files:
         raise FileNotFoundError(f"no .metadata file under {path!r}")
+
+    def uid_of(f):
+        stem = os.path.basename(f)[: -len(".metadata")]
+        # "{rank}_{uid}" (current) or bare "{uid}" (coordinator-style)
+        return int(stem.rsplit("_", 1)[-1])
+
+    if unique_id is None:
+        unique_id = max(uid_of(f) for f in files)  # latest checkpoint wins
+    files = [f for f in files if uid_of(f) == unique_id]
+    if not files:
+        raise FileNotFoundError(
+            f"no .metadata for unique_id={unique_id} under {path!r}")
     merged = Metadata()
     for f in files:
         with open(f, "rb") as fh:
@@ -72,7 +84,7 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     """Fill ``state_dict``'s tensors in place from the checkpoint at
     ``path``, resharding saved pieces into each target tensor's current
     global shape and sharding."""
-    meta = _read_metadata(path)
+    meta = _read_metadata(path, unique_id)
     data = _DataFiles(path)
     flat, mapping = flatten_state_dict(state_dict)
     storage = {(i.tensor_key, i.global_offset): ref
@@ -98,7 +110,12 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
                 f"{key!r} not found in checkpoint {path!r} "
                 f"(available: {sorted(meta.state_dict_metadata)[:8]}...)")
         is_tensor = isinstance(target, Tensor)
-        tv = target._value if is_tensor else target
+        if not is_tensor:
+            # fail fast before any shard IO: in-place fill needs a Tensor
+            raise TypeError(
+                f"load_state_dict target {key!r} must be a Tensor "
+                f"(got {type(target).__name__})")
+        tv = target._value
         # global shape = max over shards of offset+local_shape
         ndim = len(shards[0].local_shape)
         gshape = [0] * ndim
@@ -129,12 +146,4 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             arr = arr.astype(tv.dtype)
         if isinstance(sharding, jax.sharding.NamedSharding) and not offload:
             arr = jax.device_put(arr, sharding)
-        if is_tensor:
-            target._value = arr
-        else:
-            # plain jax array in the dict: can't assign in place; caller gets
-            # the replicated value via the Tensor path — mirror reference's
-            # requirement that values are paddle Tensors
-            raise TypeError(
-                f"load_state_dict target {key!r} must be a Tensor "
-                f"(got {type(target).__name__})")
+        target._value = arr

@@ -1,0 +1,4 @@
+"""``paddle_tpu.incubate`` — fused layers and MoE (reference:
+python/paddle/incubate/)."""
+
+from . import distributed  # noqa: F401

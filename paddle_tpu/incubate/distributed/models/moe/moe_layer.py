@@ -1,0 +1,122 @@
+"""MoE layer with expert-parallel dispatch.
+
+Rebuild of python/paddle/incubate/distributed/models/moe/moe_layer.py:§0
+(SURVEY.md §2.4 EP row). Reference pipeline: gate → global_scatter (count
+exchange + NCCL alltoall) → local experts → global_gather. TPU-native: the
+dense GShard dispatch/combine einsums (ops.moe_ops) carry the routing; under
+a mesh with an ``expert``-sharded axis, XLA lowers the expert dimension of
+those einsums to an ICI all_to_all — no hand-written comm. Experts compute on
+fixed-capacity slots, keeping shapes static for XLA.
+
+Gradients: dispatch/combine masks are index-only constants; probabilities,
+expert parameters, gate parameters and the input all differentiate through
+the eager tape (Tensor ops).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .....core import math_ops as pm
+from .....core.tensor import Tensor
+from .....nn.layer import Layer, LayerList
+from .....ops import moe_ops
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+class MoELayer(Layer):
+    """``MoELayer(d_model, experts=[...], gate='gshard', ...)``.
+
+    experts: list of Layers, each mapping (n, d_model) -> (n, d_model).
+    gate: BaseGate instance or one of 'naive' | 'gshard' | 'switch'.
+    """
+
+    def __init__(self, d_model: int, experts: Optional[List[Layer]] = None,
+                 gate="gshard", moe_group=None, mp_group=None,
+                 recompute_interval: int = 0, random_routing: bool = True,
+                 capacity_factor=(1.2, 2.4), topk: Optional[int] = None,
+                 **kwargs):
+        super().__init__()
+        if not experts:
+            raise ValueError("experts list must be non-empty")
+        self.d_model = d_model
+        self.experts = LayerList(experts)
+        self.num_expert = len(experts)
+        self.moe_group = moe_group
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        elif gate in (None, "naive"):
+            self.gate = NaiveGate(d_model, self.num_expert, 1, topk=topk or 2)
+        elif gate == "gshard":
+            self.gate = GShardGate(d_model, self.num_expert, 1,
+                                   capacity=capacity_factor,
+                                   random_routing=random_routing)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, self.num_expert, 1,
+                                   capacity=capacity_factor)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+        self.capacity_factor = capacity_factor
+        # tag expert params for expert-aware grad clip / no-dp-sync
+        for p in self.experts.parameters():
+            p.expert = True
+        self.l_aux = None
+
+    def forward(self, inp):
+        orig_shape = tuple(inp.shape)
+        d = orig_shape[-1]
+        xf = pm.reshape(inp, (-1, d))
+        n = xf.shape[0]
+
+        topi, topv = self.gate(xf)
+        self.l_aux = self.gate.l_aux
+        idx = topi._value
+        K = idx.shape[1]
+
+        # gates that prune by capacity define the factor; otherwise the
+        # layer's own capacity_factor governs (naive/custom gates)
+        factor = getattr(self.gate, "capacity_factor", None)
+        if factor is None:
+            factor = self.capacity_factor
+        if isinstance(factor, (tuple, list)):
+            factor = factor[0] if self.training else factor[1]
+        capacity = max(int(np.ceil(factor * n / self.num_expert)), 1)
+
+        valid = Tensor((idx >= 0).astype(jnp.float32))
+        if K == 1:
+            # top-1 (Switch) semantics: y = p(x) * E(x) — keep the raw gate
+            # prob so the gate trains from the task loss
+            probs = topv * valid
+        else:
+            # top-k: combine probs renormalized over admitted choices
+            probs = topv * valid
+            denom = pm.clip(pm.sum(probs, axis=-1, keepdim=True), min=1e-9)
+            probs = probs / denom
+
+        # reuse the gate's dispatch masks when it already built them for
+        # pruning (GShard); identity check guards against stale caches
+        cached = getattr(self.gate, "_dispatch_cache", None)
+        if cached is not None and cached[0] is idx and cached[1] == capacity:
+            masks = cached[2]
+        else:
+            masks = moe_ops.dispatch_masks_topk(idx, self.num_expert, capacity)
+        dtype = str(xf.dtype).split(".")[-1]
+        disp_sum = Tensor(sum(masks))  # (N,E,C) constant
+        expert_in = pm.einsum("nec,nd->ecd", pm.cast(disp_sum, dtype), xf)
+
+        # run experts on their capacity slots (static python loop: E is small
+        # and each expert owns distinct parameters)
+        outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
+        expert_out = pm.stack(outs, axis=0)  # (E, C, d)
+
+        # combine: sum_k mask_k * prob_k — probs differentiable
+        comb = None
+        for k in range(K):
+            pk = pm.unsqueeze(pm.unsqueeze(probs[:, k], -1), -1)  # (N,1,1)
+            term = pm.cast(Tensor(masks[k]), "float32") * pk
+            comb = term if comb is None else comb + term
+        out = pm.einsum("nec,ecd->nd", pm.cast(comb, dtype), expert_out)
+        return pm.reshape(out, orig_shape)

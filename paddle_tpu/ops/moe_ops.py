@@ -1,0 +1,135 @@
+"""MoE dispatch/capacity ops.
+
+Rebuild of the reference's CUDA capacity kernels and collective dispatch ops
+(SURVEY.md §2.4 EP row): ``number_count``, ``limit_by_capacity``,
+``prune_gate_by_capacity``, ``random_routing``
+(paddle/fluid/operators/collective/global_scatter_op.* and phi capacity
+kernels, file:§0) — here as pure-jnp ops XLA fuses, plus the dense
+GShard-style dispatch/combine einsums that replace global_scatter /
+global_gather. On an ``expert``-sharded mesh the einsum's expert dim IS the
+alltoall: GSPMD lowers the (N,E,C)×(N,d) contraction to an ICI all_to_all.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def number_count(gate_idx, upper_range: int):
+    """Histogram of expert assignments: out[e] = #tokens routed to e
+    (reference number_count op)."""
+    return jnp.bincount(gate_idx.reshape(-1).astype(jnp.int32),
+                        length=upper_range)
+
+
+def position_in_expert(gate_idx, num_experts: int):
+    """For each token, its arrival position within its expert's queue
+    (cumulative count of earlier tokens with the same expert)."""
+    one_hot = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot  # (N, E)
+    return pos.sum(axis=-1) - 1  # (N,) zero-based
+
+
+def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
+    """Clamp per-expert counts at capacity (reference limit_by_capacity):
+    returns the admitted counts."""
+    cap = jnp.asarray(capacity)
+    if cap.ndim == 0:
+        cap = jnp.full(expert_count.shape, cap)
+    return jnp.minimum(expert_count, cap)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,
+                           n_worker: int = 1):
+    """Set gate_idx to -1 for tokens beyond their expert's capacity
+    (reference prune_gate_by_capacity)."""
+    pos = position_in_expert(gate_idx, n_expert)
+    cap = expert_count[gate_idx]
+    return jnp.where(pos < cap, gate_idx, -1)
+
+
+def random_routing(topk_idx, topk_value, prob, topk: int = 2):
+    """GShard 2nd-expert random drop: keep expert #2 only when
+    2*value > prob (reference random_routing op). prob ~ U[0,1) per token."""
+    if topk != 2:
+        raise ValueError("random_routing supports topk=2 only")
+    keep = (2.0 * topk_value[:, 1]) > prob
+    second = jnp.where(keep, topk_idx[:, 1], -1)
+    return jnp.stack([topk_idx[:, 0], second], axis=1)
+
+
+def dispatch_combine_masks(gate_idx, gate_prob, num_experts: int,
+                           capacity: int):
+    """Dense GShard dispatch: returns
+      dispatch (N,E,C) bool — token n goes to slot c of expert e
+      combine  (N,E,C) f32  — same mask scaled by the gate prob.
+    Tokens with gate_idx -1 (pruned) or beyond capacity drop out.
+    """
+    valid = gate_idx >= 0
+    safe_idx = jnp.where(valid, gate_idx, 0)
+    oh_e = jax.nn.one_hot(safe_idx, num_experts, dtype=jnp.int32)
+    oh_e = oh_e * valid[:, None].astype(jnp.int32)
+    pos = jnp.cumsum(oh_e, axis=0) * oh_e  # 1-based where routed
+    pos = pos.sum(axis=-1) - 1  # (N,), -1 where unrouted
+    in_cap = (pos >= 0) & (pos < capacity)
+    keep = (valid & in_cap).astype(jnp.float32)
+    oh_c = jax.nn.one_hot(jnp.where(in_cap, pos, 0), capacity,
+                          dtype=jnp.float32)
+    disp = jnp.einsum("ne,nc->nec", oh_e.astype(jnp.float32), oh_c)
+    disp = disp * keep[:, None, None]
+    combine = disp * gate_prob[:, None, None]
+    return disp, combine
+
+
+def dispatch_masks_topk(gate_idx, num_experts: int, capacity: int):
+    """Per-choice dispatch masks with joint capacity ordering (GShard:
+    choice k's tokens queue after admitted tokens of choices < k). Returns a
+    list of K raw (N,E,C) float32 masks — index-only, no gradient path, so
+    callers can treat them as constants and keep probs differentiable."""
+    n, K = gate_idx.shape
+    masks = []
+    admitted = jnp.zeros((num_experts,), jnp.int32)
+    for k in range(K):
+        idx = gate_idx[:, k]
+        valid = idx >= 0
+        safe = jnp.where(valid, idx, 0)
+        oh = jax.nn.one_hot(safe, num_experts, dtype=jnp.int32) * \
+            valid[:, None].astype(jnp.int32)
+        pos = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1 + admitted[safe]
+        in_cap = valid & (pos >= 0) & (pos < capacity)
+        keep = in_cap.astype(jnp.float32)
+        oh_c = jax.nn.one_hot(jnp.where(in_cap, pos, 0), capacity,
+                              dtype=jnp.float32)
+        disp = jnp.einsum("ne,nc->nec", oh.astype(jnp.float32), oh_c) * \
+            keep[:, None, None]
+        masks.append(disp)
+        admitted = admitted + (oh * in_cap[:, None].astype(jnp.int32)
+                               ).sum(axis=0)
+    return masks
+
+
+def dispatch_combine_topk(gate_idx, gate_prob, num_experts: int,
+                          capacity: int):
+    """Joint top-K dispatch (GShard ordering: choice k's tokens queue after
+    the admitted tokens of choices < k), so (token, k) pairs never collide
+    in an expert's capacity slots. Returns summed (N,E,C) dispatch and
+    combine masks."""
+    masks = dispatch_masks_topk(gate_idx, num_experts, capacity)
+    disp_sum = sum(masks)
+    comb_sum = sum(m * gate_prob[:, k][:, None, None]
+                   for k, m in enumerate(masks))
+    return disp_sum, comb_sum
+
+
+def moe_dispatch(x, dispatch_mask):
+    """(N,d),(N,E,C) -> (E,C,d): the global_scatter equivalent — under an
+    expert-sharded mesh XLA turns this contraction into the alltoall."""
+    return jnp.einsum("nec,nd->ecd", dispatch_mask, x)
+
+
+def moe_combine(expert_out, combine_mask):
+    """(E,C,d),(N,E,C) -> (N,d): global_gather equivalent."""
+    return jnp.einsum("nec,ecd->nd", combine_mask, expert_out)

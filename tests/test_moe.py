@@ -1,0 +1,182 @@
+"""MoE tests: capacity ops vs numpy oracles, MoELayer numerics, gradients,
+expert-aware clip (reference: test/collective/fleet moe tests + op tests)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.distributed.models.moe import (
+    ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate, SwitchGate)
+from paddle_tpu.ops import moe_ops
+
+
+def test_number_count():
+    idx = jnp.asarray([0, 2, 2, 1, 2, 0])
+    np.testing.assert_array_equal(np.asarray(moe_ops.number_count(idx, 4)),
+                                  [2, 1, 3, 0])
+
+
+def test_prune_gate_by_capacity():
+    idx = jnp.asarray([0, 0, 0, 1, 1, 2])
+    counts = jnp.asarray([2, 1, 5])  # capacities per expert
+    pruned = np.asarray(moe_ops.prune_gate_by_capacity(idx, counts, 3))
+    # third 0-token and second 1-token dropped
+    np.testing.assert_array_equal(pruned, [0, 0, -1, 1, -1, 2])
+
+
+def test_random_routing():
+    topi = jnp.asarray([[0, 1], [2, 3], [1, 0]])
+    topv = jnp.asarray([[0.9, 0.4], [0.8, 0.05], [0.6, 0.3]])
+    prob = jnp.asarray([0.5, 0.5, 0.7])
+    out = np.asarray(moe_ops.random_routing(topi, topv, prob))
+    # keep second expert iff 2*value > prob
+    np.testing.assert_array_equal(out, [[0, 1], [2, -1], [1, -1]])
+
+
+def test_dispatch_combine_oracle():
+    rng = np.random.RandomState(0)
+    n, E, C, d = 12, 3, 4, 5
+    idx = rng.randint(0, E, (n, 2)).astype(np.int32)
+    idx[3, 1] = -1
+    prob = rng.rand(n, 2).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    disp, comb = moe_ops.dispatch_combine_topk(jnp.asarray(idx),
+                                               jnp.asarray(prob), E, C)
+    got_in = np.asarray(moe_ops.moe_dispatch(jnp.asarray(x), disp))
+
+    # numpy oracle: joint GShard ordering, k-major admission
+    slots = np.zeros((E, C, d), np.float32)
+    fill = np.zeros(E, np.int32)
+    slot_of = {}
+    for k in range(2):
+        for t in range(n):
+            e = idx[t, k]
+            if e < 0:
+                continue
+            if fill[e] < C:
+                slots[e, fill[e]] = x[t]
+                slot_of[(t, k)] = (e, fill[e])
+                fill[e] += 1
+    np.testing.assert_allclose(got_in, slots, atol=1e-6)
+
+    # combine returns prob-weighted slot contents per token
+    eo = rng.randn(E, C, d).astype(np.float32)
+    got_out = np.asarray(moe_ops.moe_combine(jnp.asarray(eo), comb))
+    want = np.zeros((n, d), np.float32)
+    for (t, k), (e, c) in slot_of.items():
+        want[t] += prob[t, k] * eo[e, c]
+    np.testing.assert_allclose(got_out, want, atol=1e-5)
+
+
+def _expert(d, seed):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(d, 2 * d), nn.GELU(), nn.Linear(2 * d, d))
+
+
+def test_moe_layer_naive_top1_matches_manual():
+    d, E = 8, 4
+    paddle.seed(0)
+    experts = [_expert(d, i) for i in range(E)]
+    layer = MoELayer(d, experts, gate="naive", topk=1,
+                     capacity_factor=(100.0, 100.0))
+    layer.eval()
+    x = np.random.RandomState(0).randn(16, d).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    # manual: each token to its argmax expert, scaled by the raw gate prob
+    # (top-1 keeps Switch semantics y = p(x) * E(x))
+    gate_w = np.asarray(layer.gate.gate._value)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(x @ gate_w, jnp.float32),
+                                      axis=-1))
+    choice = probs.argmax(-1)
+    want = np.zeros_like(x)
+    for t in range(16):
+        e = choice[t]
+        want[t] = probs[t, e] * np.asarray(
+            experts[e](paddle.to_tensor(x[t:t + 1]))._value)[0]
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-4,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch", "naive"])
+def test_moe_layer_trains(gate):
+    d, E = 8, 4
+    paddle.seed(0)
+    layer = MoELayer(d, [_expert(d, i) for i in range(E)], gate=gate,
+                     random_routing=False)
+    head = nn.Linear(d, 2)
+    params = layer.parameters() + head.parameters()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=params)
+    rng = np.random.RandomState(0)
+    losses = []
+    xs = rng.randn(32, d).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int64)
+    for i in range(8):
+        x = paddle.to_tensor(xs)
+        y = paddle.to_tensor(ys)
+        out = head(layer(x))
+        loss = nn.CrossEntropyLoss()(out, y)
+        if layer.l_aux is not None and gate != "naive":
+            loss = loss + 0.01 * layer.l_aux
+        loss.backward()
+        # gate + expert params must receive gradients
+        if i == 0:
+            assert layer.gate.gate._grad_value is not None
+            grads = [p._grad_value for p in layer.experts.parameters()]
+            assert any(g is not None and float(jnp.abs(g).sum()) > 0
+                       for g in grads), "expert grads missing"
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gshard_capacity_prunes():
+    d, E = 4, 2
+    paddle.seed(0)
+    gate = GShardGate(d, E, capacity=(0.6, 0.6), random_routing=False)
+    gate.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(20, d).astype(np.float32))
+    topi, topv = gate(x)
+    idx = np.asarray(topi._value)
+    cap = gate.capacity(20, 0.6)
+    for e in range(E):
+        assert (idx == e).sum() <= cap
+
+
+def test_expert_aware_clip():
+    d = 4
+    paddle.seed(0)
+    layer = MoELayer(d, [_expert(d, i) for i in range(2)], gate="naive")
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, d).astype(np.float32))
+    loss = layer(x).mean()
+    loss.backward()
+    clip = ClipGradForMOEByGlobalNorm(clip_norm=1e-8)
+    pg = [(p, p._grad_value) for p in layer.parameters()
+          if p._grad_value is not None]
+    clipped = clip(pg)
+    for p, g in clipped:
+        assert float(jnp.abs(g).max()) < 1.0  # heavily scaled down
+    # expert params are tagged
+    assert all(getattr(p, "expert", False)
+               for p in layer.experts.parameters())
+
+
+def test_moe_under_expert_mesh():
+    from paddle_tpu.parallel import mesh as pmesh
+    d, E = 8, 4
+    # expert axis folded over mp in the mesh order; just assert forward works
+    # with a global mesh active (compiled EP sharding is exercised in
+    # __graft_entry__/hybrid tests)
+    pmesh.set_global_mesh(pmesh.build_mesh({"mp": 4}))
+    try:
+        paddle.seed(0)
+        layer = MoELayer(d, [_expert(d, i) for i in range(E)], gate="switch")
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, d).astype(np.float32))
+        out = layer(x)
+        assert tuple(out.shape) == (16, d)
+    finally:
+        pmesh.set_global_mesh(None)
