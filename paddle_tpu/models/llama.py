@@ -58,6 +58,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: Any = jnp.float32
+    # context-parallel attention flavor when sep_degree > 1:
+    # "ulysses" (all_to_all head repartition) or "ring" (ppermute KV ring)
+    sep_mode: str = "ulysses"
 
     @property
     def head_dim(self) -> int:
@@ -302,14 +305,22 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
     k = k.reshape(b, s, nkv_local, d)
     v = v.reshape(b, s, nkv_local, d)
     q, k = rope_ops.apply_rope_array(q, k, cos, sin)
-    if sep_axis is not None:
-        # (b, s_local, nh, d) -> (b, s_full, nh/sep, d)
-        q, k, v = (lax.all_to_all(t, sep_axis, split_axis=2, concat_axis=1,
-                                  tiled=True) for t in (q, k, v))
-    attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
-    if sep_axis is not None:
-        attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
-                              tiled=True)
+    sep_mode = getattr(config, "sep_mode", "ulysses")
+    if sep_axis is not None and sep_mode == "ring":
+        # blockwise ring attention: KV rotates over the sep ICI ring with
+        # online-softmax merge (ops/ring_attention.py, SURVEY.md §5.7 (3))
+        from ..ops import ring_attention as ra
+        attn = ra.ring_attention_array(q, k, v, sep_axis, causal=True,
+                                       scale=1.0 / math.sqrt(d))
+    else:
+        if sep_axis is not None:
+            # (b, s_local, nh, d) -> (b, s_full, nh/sep, d)
+            q, k, v = (lax.all_to_all(t, sep_axis, split_axis=2, concat_axis=1,
+                                      tiled=True) for t in (q, k, v))
+        attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        if sep_axis is not None:
+            attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
     attn = attn.reshape(b, s, -1)
     out = jnp.einsum("bsd,dh->bsh", attn, gather_out(p["wo"]))
     if mp_axis is not None:
@@ -349,12 +360,22 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     sep_axis = "sep" if (seq_shard and sep > 1) else None
     if seq_shard and sep <= 1:
         raise ValueError("seq_shard=True requires a 'sep' mesh axis of size>1")
+    sep_mode = getattr(config, "sep_mode", "ulysses")
+    if sep_mode not in ("ulysses", "ring"):
+        raise ValueError(f"unknown sep_mode {sep_mode!r} "
+                         f"(expected 'ulysses' or 'ring')")
     if sep_axis is not None:
         nh, nkv = config.num_attention_heads, config.num_key_value_heads
-        if nh % (mp * sep) or nkv % (mp * sep):
+        if sep_mode == "ulysses":
+            # Ulysses repartitions heads over sep; ring never splits heads
+            if nh % (mp * sep) or nkv % (mp * sep):
+                raise ValueError(
+                    f"Ulysses sep={sep} with mp={mp} needs heads divisible "
+                    f"by mp*sep (got q={nh}, kv={nkv})")
+        elif nh % mp or nkv % mp:
             raise ValueError(
-                f"Ulysses sep={sep} with mp={mp} needs heads divisible by "
-                f"mp*sep (got q={nh}, kv={nkv})")
+                f"ring sep with mp={mp} needs heads divisible by mp "
+                f"(got q={nh}, kv={nkv})")
     fsdp = mesh.shape.get("sharding", 1) * mesh.shape.get("dp", 1)
     mp_axis = "mp" if mp > 1 else None
     fsdp_axes = ("dp", "sharding")

@@ -12,4 +12,4 @@ and as the numerics oracle in tests, and a custom_vjp so both paths are
 differentiable. Selection honours FLAGS_use_pallas_kernels.
 """
 
-from . import flash_attention, rms_norm, rope, moe_ops  # noqa: F401
+from . import flash_attention, rms_norm, rope, moe_ops, ring_attention  # noqa: F401
