@@ -1,0 +1,126 @@
+"""TCPStore rendezvous tests (SURVEY.md §2.3 TCPStore row).
+
+Covers both the native C++ daemon/client (ctypes) and the pure-Python
+fallback, plus cross-backend interop.
+"""
+
+import threading
+
+import pytest
+
+from paddle_tpu.distributed.store import MasterDaemon, TCPStore, native_lib
+
+
+def _roundtrip(prefer_native):
+    store = TCPStore(is_master=True, world_size=1, timeout=10.0,
+                     prefer_native=prefer_native)
+    try:
+        store.set("alpha", b"hello")
+        assert store.get("alpha") == b"hello"
+        store.set("alpha", "world")
+        assert store.get("alpha") == b"world"
+        assert store.add("cnt", 3) == 3
+        assert store.add("cnt", -1) == 2
+        store.wait("cnt", timeout=1.0)
+        with pytest.raises(TimeoutError):
+            store.get("missing", timeout=0.3)
+        store.delete_key("alpha")
+        with pytest.raises(TimeoutError):
+            store.get("alpha", timeout=0.3)
+    finally:
+        store.close()
+    return store
+
+
+def test_python_backend_roundtrip():
+    store = _roundtrip(prefer_native=False)
+    assert store.backend in ("python", "native")  # closed; attr still valid
+
+
+def test_native_backend_roundtrip():
+    if native_lib() is None:
+        pytest.skip("no C++ toolchain")
+    store = TCPStore(is_master=True, world_size=1, timeout=10.0)
+    try:
+        assert store.backend == "native"
+        assert store.daemon.backend == "native"
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+    finally:
+        store.close()
+    _roundtrip(prefer_native=True)
+
+
+def test_interop_python_client_native_daemon():
+    if native_lib() is None:
+        pytest.skip("no C++ toolchain")
+    daemon = MasterDaemon(prefer_native=True)
+    assert daemon.backend == "native"
+    try:
+        c = TCPStore(host="127.0.0.1", port=daemon.port, timeout=10.0,
+                     prefer_native=False)
+        c.set("x", b"42")
+        assert c.add("n", 5) == 5
+        c2 = TCPStore(host="127.0.0.1", port=daemon.port, timeout=10.0,
+                      prefer_native=True)
+        assert c2.get("x") == b"42"
+        assert c2.add("n", 1) == 6
+        c.close()
+        c2.close()
+    finally:
+        daemon.stop()
+
+
+def test_blocking_get_wakes_on_set():
+    daemon = MasterDaemon(prefer_native=False)
+    try:
+        got = {}
+
+        def getter():
+            c = TCPStore(host="127.0.0.1", port=daemon.port, timeout=10.0,
+                         prefer_native=False)
+            got["v"] = c.get("late", timeout=5.0)
+            c.close()
+
+        t = threading.Thread(target=getter)
+        t.start()
+        setter = TCPStore(host="127.0.0.1", port=daemon.port, timeout=10.0,
+                          prefer_native=False)
+        import time
+        time.sleep(0.2)
+        setter.set("late", b"arrived")
+        t.join(timeout=5.0)
+        assert got.get("v") == b"arrived"
+        setter.close()
+    finally:
+        daemon.stop()
+
+
+@pytest.mark.parametrize("prefer_native", [False, True])
+def test_barrier_multi_client(prefer_native):
+    if prefer_native and native_lib() is None:
+        pytest.skip("no C++ toolchain")
+    n = 4
+    daemon = MasterDaemon(prefer_native=prefer_native)
+    try:
+        done = []
+        lock = threading.Lock()
+
+        def worker(rank):
+            c = TCPStore(host="127.0.0.1", port=daemon.port, world_size=n,
+                         timeout=10.0, prefer_native=prefer_native)
+            c.barrier("b0")
+            c.barrier("b0")  # second round must not collide with first
+            with lock:
+                done.append(rank)
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15.0)
+        assert sorted(done) == list(range(n))
+    finally:
+        daemon.stop()
