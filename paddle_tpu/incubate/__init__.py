@@ -2,3 +2,4 @@
 python/paddle/incubate/)."""
 
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,196 @@
+"""Tests for the fused kernel additions: fused_linear_param_grad_add,
+fused_multi_transformer (block + incubate layers). Numerics oracle = plain
+jnp reference, per SURVEY.md §4 (OpTest numpy-oracle pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import fused_linear as fl
+from paddle_tpu.ops import fused_transformer_block as ftb
+
+
+class TestFusedLinearParamGradAdd:
+    def test_accumulate_matches_einsum(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 8, 16), jnp.float32)
+        g = jnp.asarray(rng.randn(4, 8, 24), jnp.float32)
+        acc = jnp.asarray(rng.randn(16, 24), jnp.float32)
+        dw, db = fl.fused_linear_param_grad_add(x, g, acc, None)
+        ref = acc + jnp.einsum("bsi,bso->io", x, g)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(db),
+                                   np.asarray(g.sum(axis=(0, 1))), rtol=1e-5)
+
+    def test_bf16_inputs_fp32_accumulator(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 16), jnp.bfloat16)
+        g = jnp.asarray(rng.randn(8, 24), jnp.bfloat16)
+        dw, db = fl.fused_linear_param_grad_add(x, g)
+        assert dw.dtype == jnp.float32 and db.dtype == jnp.float32
+        ref = jnp.einsum("bi,bo->io", x.astype(jnp.float32),
+                         g.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_linear_with_main_grad_vjp(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        b = jnp.asarray(rng.randn(8), jnp.float32)
+
+        def loss_fused(x, w, b):
+            return fl.linear_with_main_grad(x, w, b).sum()
+
+        def loss_ref(x, w, b):
+            return (x @ w + b).sum()
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-5)
+
+
+def _ref_stack(x, params, num_heads, act="gelu", eps=1e-5):
+    """Unfused per-layer reference (python loop, materialised softmax)."""
+    L = params["ln_scale"].shape[0]
+    for l in range(L):
+        p = {k: v[l] for k, v in params.items()}
+        xn = ftb.layer_norm_array(x, p["ln_scale"], p["ln_bias"], eps)
+        qkv = xn @ p["qkv_w"] + p["qkv_b"]
+        b, s, _ = x.shape
+        h = qkv.shape[-1] // 3
+        hd = h // num_heads
+        q, k, v = (qkv.reshape(b, s, 3, num_heads, hd)[:, :, i].transpose(
+            0, 2, 1, 3) for i in range(3))
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(hd)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+        attn = jax.nn.softmax(logits, -1) @ v
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h)
+        x = x + attn @ p["out_w"] + p["out_b"]
+        xn = ftb.layer_norm_array(x, p["ffn_ln_scale"], p["ffn_ln_bias"], eps)
+        x = x + jax.nn.gelu(xn @ p["ffn1_w"] + p["ffn1_b"]) @ p["ffn2_w"] + p["ffn2_b"]
+    return x
+
+
+class TestFusedMultiTransformer:
+    def setup_method(self, _):
+        self.params = ftb.init_stacked_block_params(3, 32, 64, seed=0)
+        self.x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 32),
+                             jnp.float32)
+
+    def test_prefill_matches_reference_loop(self):
+        out, kv = ftb.fused_multi_transformer_array(
+            self.x, self.params, num_heads=4)
+        assert kv is None
+        ref = _ref_stack(self.x, self.params, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_prefill_then_decode_matches_full_prefill(self):
+        """Decode step t must equal prefill over [0..t] — the KV-cache
+        correctness invariant of the reference kernel."""
+        params, nh = self.params, 4
+        full = np.asarray(np.random.RandomState(4).randn(1, 6, 32), np.float32)
+        out_full, _ = ftb.fused_multi_transformer_array(
+            jnp.asarray(full), params, num_heads=nh)
+        out_pre, cache = ftb.fused_multi_transformer_array(
+            jnp.asarray(full[:, :5]), params, num_heads=nh, max_cache_len=8)
+        assert cache.shape == (3, 2, 1, nh, 8, 8)
+        out_dec, cache2 = ftb.fused_multi_transformer_array(
+            jnp.asarray(full[:, 5:6]), params, num_heads=nh,
+            cache_kv=cache, time_step=5)
+        np.testing.assert_allclose(np.asarray(out_dec[:, 0]),
+                                   np.asarray(out_full[:, 5]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self):
+        def loss(params):
+            out, _ = ftb.fused_multi_transformer_array(
+                self.x, params, num_heads=4)
+            return (out ** 2).mean()
+        g = jax.grad(loss)(self.params)
+        assert float(jnp.abs(g["qkv_w"]).sum()) > 0
+
+
+class TestIncubateLayers:
+    def test_fused_multi_transformer_layer(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        layer = FusedMultiTransformer(32, 4, 64, num_layers=2)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 32)
+                             .astype(np.float32))
+        y = layer(x)
+        assert tuple(y.shape) == (2, 8, 32)
+        assert len(layer.parameters()) == 24
+        loss = (y * y).mean()
+        loss.backward()
+        assert layer.qkv_weights[0].grad is not None
+        assert float(np.abs(layer.qkv_weights[1].grad.numpy()).sum()) > 0
+
+    def test_fused_mha_and_ffn(self):
+        from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
+                                            FusedFeedForward)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(2, 8, 32)
+                             .astype(np.float32))
+        mha = FusedMultiHeadAttention(32, 4)
+        y = mha(x)
+        assert tuple(y.shape) == (2, 8, 32)
+        ffn = FusedFeedForward(32, 64)
+        z = ffn(y)
+        assert tuple(z.shape) == (2, 8, 32)
+        (z.mean()).backward()
+        assert mha.qkv_weight.grad is not None
+        assert ffn.w1.grad is not None
+
+    def test_functional_entry(self):
+        from paddle_tpu.incubate.nn import functional as FF
+        params = ftb.init_stacked_block_params(2, 32, 64, seed=1)
+        x = paddle.to_tensor(np.random.RandomState(2).randn(1, 4, 32)
+                             .astype(np.float32))
+        y = FF.fused_multi_transformer(x, params, num_heads=4)
+        assert tuple(y.shape) == (1, 4, 32)
+
+
+class TestReviewRegressions:
+    """Regressions for review findings: non-causal MHA, ragged decode."""
+
+    def test_mha_causal_flag_changes_output(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        mha = FusedMultiHeadAttention(32, 4)
+        x = paddle.to_tensor(np.random.RandomState(5).randn(2, 8, 32)
+                             .astype(np.float32))
+        y_c = mha(x, causal=True).numpy()
+        y_b = mha(x, causal=False).numpy()
+        assert np.abs(y_c - y_b).max() > 1e-5
+
+    def test_ragged_decode_ignores_padded_cache(self):
+        """Two sequences, prefill lens 3 and 5: the short one's decode must
+        equal its own standalone decode (no attention to pad slots)."""
+        nh = 4
+        params = ftb.init_stacked_block_params(2, 32, 64, seed=7)
+        rng = np.random.RandomState(8)
+        seq_a = rng.randn(1, 3, 32).astype(np.float32)
+        seq_b = rng.randn(1, 5, 32).astype(np.float32)
+        tok = rng.randn(2, 1, 32).astype(np.float32)
+
+        # batched ragged: right-pad seq_a with garbage to length 5
+        batched = np.concatenate(
+            [np.concatenate([seq_a, 99.0 * np.ones((1, 2, 32), np.float32)], 1),
+             seq_b], 0)
+        _, cache = ftb.fused_multi_transformer_array(
+            jnp.asarray(batched), params, num_heads=nh, max_cache_len=8)
+        out_dec, _ = ftb.fused_multi_transformer_array(
+            jnp.asarray(tok), params, num_heads=nh, cache_kv=cache,
+            time_step=5, seq_lens=jnp.asarray([3, 5]))
+
+        # standalone for seq_a: prefill 3 real tokens, decode at slot 5 too
+        _, cache_a = ftb.fused_multi_transformer_array(
+            jnp.asarray(seq_a), params, num_heads=nh, max_cache_len=8)
+        out_a, _ = ftb.fused_multi_transformer_array(
+            jnp.asarray(tok[:1]), params, num_heads=nh, cache_kv=cache_a,
+            time_step=5, seq_lens=jnp.asarray([3]))
+        np.testing.assert_allclose(np.asarray(out_dec[0]),
+                                   np.asarray(out_a[0]), rtol=1e-4, atol=1e-4)
