@@ -8,6 +8,12 @@
 //
 // Design: one daemon thread, poll(2)-driven, single-threaded state — no
 // locks on the KV map, waiters parked on a list and woken on SET/ADD.
+// Client sockets are NON-BLOCKING with per-connection receive buffers:
+// a request is dispatched only once fully buffered, so a client that
+// stalls mid-request (SIGSTOP, partition) cannot wedge the daemon — other
+// ranks keep being served and waiter timeouts keep firing. Replies use a
+// bounded-wait send; a connection that cannot drain its reply within
+// kSendTimeoutMs is dropped.
 // Exposed through a C ABI consumed from Python via ctypes
 // (paddle_tpu/distributed/store.py), which also implements the same wire
 // protocol in pure Python as a fallback — the two interoperate.
@@ -24,6 +30,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -83,11 +90,44 @@ bool recv_all(int fd, void* buf, size_t len) {
 }
 
 // --------------------------------------------------------------- daemon
+constexpr int kSendTimeoutMs = 5000;
+
+// Bounded-wait send on a non-blocking fd: waits for POLLOUT on EAGAIN,
+// gives up after kSendTimeoutMs so one undrained client can't stall the
+// daemon thread forever.
+bool send_bounded(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  int64_t deadline = now_ms() + kSendTimeoutMs;
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n > 0) {
+      p += n;
+      len -= static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      int64_t rem = deadline - now_ms();
+      if (rem <= 0) return false;
+      pollfd pfd{fd, POLLOUT, 0};
+      ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(rem, 200)));
+      continue;
+    }
+    return false;
+  }
+  return true;
+}
+
 struct Waiter {
   int fd;
   std::string key;
   int64_t deadline_ms;  // -1 = infinite
   bool reply_value;     // GET replies value, WAIT replies status only
+};
+
+struct Conn {
+  int fd;
+  std::string inbuf;  // bytes received but not yet forming a full request
 };
 
 struct Daemon {
@@ -98,13 +138,14 @@ struct Daemon {
   std::unordered_map<std::string, std::string> kv;
   std::list<Waiter> waiters;
 
-  void reply(int fd, uint8_t status, const std::string& val) {
+  // false → connection must be dropped (reply could not be delivered)
+  bool reply(int fd, uint8_t status, const std::string& val) {
     uint32_t vlen = static_cast<uint32_t>(val.size());
     std::string out;
     out.push_back(static_cast<char>(status));
     out.append(reinterpret_cast<const char*>(&vlen), 4);
     out += val;
-    send_all(fd, out.data(), out.size());
+    return send_bounded(fd, out.data(), out.size());
   }
 
   void wake_waiters(const std::string& key) {
@@ -118,47 +159,61 @@ struct Daemon {
     }
   }
 
-  // Returns false if the connection should be dropped.
-  bool handle_request(int fd) {
-    uint8_t cmd;
+  // Try to consume ONE complete request from c.inbuf.
+  // Returns 1 = handled, 0 = need more bytes, -1 = drop connection.
+  int try_handle(Conn& c) {
+    const char* p = c.inbuf.data();
+    size_t avail = c.inbuf.size();
+    if (avail < 5) return 0;
+    uint8_t cmd = static_cast<uint8_t>(p[0]);
     uint32_t klen;
-    if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &klen, 4)) return false;
-    if (klen > (1u << 20)) return false;
-    std::string key(klen, '\0');
-    if (klen && !recv_all(fd, &key[0], klen)) return false;
-
+    memcpy(&klen, p + 1, 4);
+    if (klen > (1u << 20)) return -1;
+    size_t fixed;  // payload bytes after the key, before any value
+    switch (cmd) {
+      case 1: fixed = 4; break;            // SET: u32 vallen
+      case 2: case 3: case 4: fixed = 8; break;  // GET/ADD/WAIT: i64
+      case 5: fixed = 0; break;            // DEL
+      default: return -1;
+    }
+    size_t base = 5 + static_cast<size_t>(klen);
+    if (avail < base + fixed) return 0;
+    uint32_t vlen = 0;
+    if (cmd == 1) {
+      memcpy(&vlen, p + base, 4);
+      if (vlen > (1u << 30)) return -1;
+      if (avail < base + 4 + vlen) return 0;
+    }
+    std::string key(p + 5, klen);
+    size_t consumed = base + fixed + (cmd == 1 ? vlen : 0);
+    bool ok = true;
     switch (cmd) {
       case 1: {  // SET
-        uint32_t vlen;
-        if (!recv_all(fd, &vlen, 4)) return false;
-        if (vlen > (1u << 30)) return false;
-        std::string val(vlen, '\0');
-        if (vlen && !recv_all(fd, &val[0], vlen)) return false;
-        kv[key] = std::move(val);
+        kv[key] = std::string(p + base + 4, vlen);
         wake_waiters(key);
-        reply(fd, 0, "");
-        return true;
+        ok = reply(c.fd, 0, "");
+        break;
       }
       case 2:    // GET (blocking)
       case 4: {  // WAIT
         int64_t timeout_ms;
-        if (!recv_all(fd, &timeout_ms, 8)) return false;
+        memcpy(&timeout_ms, p + base, 8);
         auto it = kv.find(key);
         if (it != kv.end()) {
-          reply(fd, 0, cmd == 2 ? it->second : std::string());
+          ok = reply(c.fd, 0, cmd == 2 ? it->second : std::string());
         } else {
           Waiter w;
-          w.fd = fd;
+          w.fd = c.fd;
           w.key = key;
           w.deadline_ms = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
           w.reply_value = (cmd == 2);
           waiters.push_back(std::move(w));
         }
-        return true;
+        break;
       }
       case 3: {  // ADD
         int64_t delta;
-        if (!recv_all(fd, &delta, 8)) return false;
+        memcpy(&delta, p + base, 8);
         int64_t cur = 0;
         auto it = kv.find(key);
         if (it != kv.end() && !it->second.empty())
@@ -166,17 +221,17 @@ struct Daemon {
         cur += delta;
         kv[key] = std::to_string(cur);
         wake_waiters(key);
-        reply(fd, 0, std::to_string(cur));
-        return true;
+        ok = reply(c.fd, 0, std::to_string(cur));
+        break;
       }
       case 5: {  // DEL
         kv.erase(key);
-        reply(fd, 0, "");
-        return true;
+        ok = reply(c.fd, 0, "");
+        break;
       }
-      default:
-        return false;
     }
+    c.inbuf.erase(0, consumed);
+    return ok ? 1 : -1;
   }
 
   void drop_fd_waiters(int fd) {
@@ -185,11 +240,18 @@ struct Daemon {
   }
 
   void run() {
-    std::vector<int> clients;
+    std::vector<Conn> clients;
+    auto drop = [&](int fd) {
+      drop_fd_waiters(fd);
+      ::close(fd);
+      clients.erase(std::remove_if(clients.begin(), clients.end(),
+                                   [fd](const Conn& c) { return c.fd == fd; }),
+                    clients.end());
+    };
     while (!stop.load(std::memory_order_relaxed)) {
       std::vector<pollfd> pfds;
       pfds.push_back({listen_fd, POLLIN, 0});
-      for (int c : clients) pfds.push_back({c, POLLIN, 0});
+      for (const Conn& c : clients) pfds.push_back({c.fd, POLLIN, 0});
       int rc = ::poll(pfds.data(), pfds.size(), 100);
       if (rc < 0 && errno != EINTR) break;
 
@@ -210,22 +272,38 @@ struct Daemon {
         if (c >= 0) {
           int one = 1;
           setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-          clients.push_back(c);
+          fcntl(c, F_SETFL, fcntl(c, F_GETFL, 0) | O_NONBLOCK);
+          clients.push_back(Conn{c, std::string()});
         }
       }
+      std::vector<int> dead;
       for (size_t i = 1; i < pfds.size(); ++i) {
         if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
-        int fd = pfds[i].fd;
-        bool keep = (pfds[i].revents & POLLIN) && handle_request(fd);
-        if (!keep) {
-          drop_fd_waiters(fd);
-          ::close(fd);
-          clients.erase(std::remove(clients.begin(), clients.end(), fd),
-                        clients.end());
+        Conn& c = clients[i - 1];
+        bool closed = false;
+        if (pfds[i].revents & POLLIN) {
+          char buf[65536];
+          for (;;) {  // drain what the kernel has; never block
+            ssize_t n = ::recv(c.fd, buf, sizeof(buf), 0);
+            if (n > 0) {
+              c.inbuf.append(buf, static_cast<size_t>(n));
+              continue;
+            }
+            if (n == 0) closed = true;
+            else if (errno == EINTR) continue;
+            else if (errno != EAGAIN && errno != EWOULDBLOCK) closed = true;
+            break;
+          }
+        } else {
+          closed = true;  // HUP/ERR with no data
         }
+        int h;
+        while ((h = try_handle(c)) == 1) {}
+        if (h == -1 || closed) dead.push_back(c.fd);
       }
+      for (int fd : dead) drop(fd);
     }
-    for (int c : clients) ::close(c);
+    for (const Conn& c : clients) ::close(c.fd);
     if (listen_fd >= 0) ::close(listen_fd);
   }
 };

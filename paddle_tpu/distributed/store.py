@@ -137,6 +137,9 @@ class _PyMasterDaemon:
                     (timeout_ms,) = struct.unpack("<q", raw)
                     deadline = (None if timeout_ms < 0
                                 else time.monotonic() + timeout_ms / 1000.0)
+                    # Build the reply under the lock, send OUTSIDE it — a
+                    # slow client draining a large value must not stall
+                    # every other connection's SET/ADD/GET.
                     with self._cond:
                         while key not in self._kv:
                             rem = (None if deadline is None
@@ -149,9 +152,10 @@ class _PyMasterDaemon:
                                 return
                         if key in self._kv:
                             val = self._kv[key] if cmd == _CMD_GET else b""
-                            conn.sendall(struct.pack("<BI", 0, len(val)) + val)
+                            msg = struct.pack("<BI", 0, len(val)) + val
                         else:
-                            conn.sendall(struct.pack("<BI", 1, 0))
+                            msg = struct.pack("<BI", 1, 0)
+                    conn.sendall(msg)
                 elif cmd == _CMD_ADD:
                     raw = _recv_exact(conn, 8)
                     if raw is None:
@@ -402,6 +406,13 @@ class TCPStore:
         n = self.add(key, 1)
         if n == self.world_size:
             self.set(key + "/done", b"1")
+            if rnd > 0:
+                # everyone has left round rnd-1 (they added for this round),
+                # so its keys are dead — reclaim them or the master's map
+                # grows two keys per barrier for the life of the job
+                prev = f"/barrier/{name}/r{rnd - 1}"
+                self.delete_key(prev)
+                self.delete_key(prev + "/done")
         self.wait(key + "/done", timeout)
 
     def close(self):

@@ -124,3 +124,47 @@ def test_barrier_multi_client(prefer_native):
         assert sorted(done) == list(range(n))
     finally:
         daemon.stop()
+
+
+@pytest.mark.parametrize("prefer_native", [False, True])
+def test_stalled_client_does_not_wedge_daemon(prefer_native):
+    """A client that sends only a partial request (header, no key) must not
+    block other clients' operations — review regression for the blocking
+    recv in the single-threaded daemon."""
+    import socket
+    import struct
+    import time
+    if prefer_native and native_lib() is None:
+        pytest.skip("no C++ toolchain")
+    daemon = MasterDaemon(prefer_native=prefer_native)
+    try:
+        stalled = socket.create_connection(("127.0.0.1", daemon.port))
+        # header claims a 100-byte key but we never send it
+        stalled.sendall(struct.pack("<BI", 1, 100))
+        time.sleep(0.2)
+
+        c = TCPStore(host="127.0.0.1", port=daemon.port, world_size=1,
+                     timeout=5.0, prefer_native=prefer_native)
+        c.set("k", b"v")                    # would hang if daemon is wedged
+        assert c.get("k", timeout=5.0) == b"v"
+        c.close()
+        stalled.close()
+    finally:
+        daemon.stop()
+
+
+def test_barrier_reclaims_previous_round_keys():
+    """Barrier rounds must not leak keys into the master map."""
+    daemon = MasterDaemon(prefer_native=False)
+    try:
+        c = TCPStore(host="127.0.0.1", port=daemon.port, world_size=1,
+                     timeout=5.0, prefer_native=False)
+        for _ in range(5):
+            c.barrier("leak")
+        kv = daemon._py._kv
+        barrier_keys = [k for k in kv if k.startswith(b"/barrier/leak/r")]
+        # only the latest round's two keys may remain
+        assert len(barrier_keys) <= 2, barrier_keys
+        c.close()
+    finally:
+        daemon.stop()
