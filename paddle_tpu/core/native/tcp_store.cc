@@ -137,6 +137,10 @@ struct Daemon {
   std::thread thread;
   std::unordered_map<std::string, std::string> kv;
   std::list<Waiter> waiters;
+  // fds whose waiter-path reply failed: the byte stream is desynced, so the
+  // connection must be dropped (deferred to run()'s drop phase — dropping
+  // here would invalidate the client list mid-iteration)
+  std::vector<int> failed_fds;
 
   // false → connection must be dropped (reply could not be delivered)
   bool reply(int fd, uint8_t status, const std::string& val) {
@@ -151,7 +155,8 @@ struct Daemon {
   void wake_waiters(const std::string& key) {
     for (auto it = waiters.begin(); it != waiters.end();) {
       if (it->key == key) {
-        reply(it->fd, 0, it->reply_value ? kv[key] : std::string());
+        if (!reply(it->fd, 0, it->reply_value ? kv[key] : std::string()))
+          failed_fds.push_back(it->fd);
         it = waiters.erase(it);
       } else {
         ++it;
@@ -259,13 +264,17 @@ struct Daemon {
       int64_t t = now_ms();
       for (auto it = waiters.begin(); it != waiters.end();) {
         if (it->deadline_ms >= 0 && t >= it->deadline_ms) {
-          reply(it->fd, 1, "");
+          if (!reply(it->fd, 1, "")) failed_fds.push_back(it->fd);
           it = waiters.erase(it);
         } else {
           ++it;
         }
       }
-      if (rc <= 0) continue;
+      if (rc <= 0) {
+        for (int fd : failed_fds) drop(fd);
+        failed_fds.clear();
+        continue;
+      }
 
       if (pfds[0].revents & POLLIN) {
         int c = ::accept(listen_fd, nullptr, nullptr);
@@ -301,6 +310,10 @@ struct Daemon {
         while ((h = try_handle(c)) == 1) {}
         if (h == -1 || closed) dead.push_back(c.fd);
       }
+      dead.insert(dead.end(), failed_fds.begin(), failed_fds.end());
+      failed_fds.clear();
+      std::sort(dead.begin(), dead.end());
+      dead.erase(std::unique(dead.begin(), dead.end()), dead.end());
       for (int fd : dead) drop(fd);
     }
     for (const Conn& c : clients) ::close(c.fd);

@@ -21,3 +21,5 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import env  # noqa: F401
 from .auto_parallel.api import shard_tensor, ProcessMesh, Shard, Replicate, Partial  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

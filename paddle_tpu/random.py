@@ -18,10 +18,25 @@ import jax.numpy as jnp
 
 
 class _RNGState:
+    """Key creation is lazy: materialising a jax PRNG key initialises the
+    backend, and importing the package must not dial the TPU (the launcher
+    process, for one, never touches a device)."""
+
     def __init__(self, seed: int = 0):
-        self.base_key = jax.random.key(seed)
+        self.seed = seed
+        self._base_key = None
         self.counter = 0
         self.traced_key = None  # set by jit machinery during trace
+
+    @property
+    def base_key(self):
+        if self._base_key is None:
+            self._base_key = jax.random.key(self.seed)
+        return self._base_key
+
+    @base_key.setter
+    def base_key(self, key):
+        self._base_key = key
 
     def next_key(self):
         if self.traced_key is not None:

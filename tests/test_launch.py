@@ -1,0 +1,160 @@
+"""Launch CLI + spawn tests (single-host multi-process fake cluster).
+
+Mirrors the reference test strategy (SURVEY.md §4): multi-node is faked as
+multi-process on localhost; payload asserts, driver checks exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PAYLOAD_OK = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.distributed.store import TCPStore
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == world, (eps, world)
+    assert os.environ["PADDLE_CURRENT_ENDPOINT"] == eps[rank]
+    host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                     world_size=world, timeout=30)
+    store.barrier("launch-test")
+    out = os.path.join({outdir!r}, f"rank{{rank}}.json")
+    with open(out, "w") as f:
+        json.dump({{"rank": rank, "world": world,
+                   "local": os.environ["PADDLE_LOCAL_RANK"],
+                   "restart": os.environ["PADDLE_RESTART_COUNT"]}}, f)
+    # check out before the master closes (it hosts the daemon)
+    import time
+    n = store.add("bye", 1)
+    if rank == 0:
+        while store.add("bye", 0) < world:
+            time.sleep(0.05)
+    store.close()
+""")
+
+PAYLOAD_FLAKY = textwrap.dedent("""
+    import os, sys
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    marker = os.path.join({outdir!r}, "attempted")
+    if not os.path.exists(marker):
+        if rank == 0:
+            open(marker, "w").close()
+        sys.exit(7)   # first generation: rank0 writes marker, all fail
+    open(os.path.join({outdir!r}, f"ok{{rank}}"), "w").close()
+""")
+
+
+def run_launch(args, timeout=120):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch"] + args
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_launch_two_procs(tmp_path):
+    payload = tmp_path / "payload.py"
+    payload.write_text(PAYLOAD_OK.format(repo=REPO, outdir=str(tmp_path)))
+    r = run_launch(["--nproc_per_node", "2",
+                    "--log_dir", str(tmp_path / "log"), str(payload)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    for rank in range(2):
+        data = json.loads((tmp_path / f"rank{rank}.json").read_text())
+        assert data == {"rank": rank, "world": 2, "local": str(rank),
+                        "restart": "0"}
+        # per-rank workerlog exists (SURVEY §5.5 observability surface)
+        assert (tmp_path / "log" / f"workerlog.{rank}").exists()
+
+
+def test_launch_propagates_failure(tmp_path):
+    payload = tmp_path / "boom.py"
+    payload.write_text("import sys; sys.exit(3)\n")
+    r = run_launch(["--nproc_per_node", "2",
+                    "--log_dir", str(tmp_path / "log"), str(payload)])
+    assert r.returncode == 1
+
+
+def test_launch_elastic_restart(tmp_path):
+    payload = tmp_path / "flaky.py"
+    payload.write_text(PAYLOAD_FLAKY.format(outdir=str(tmp_path)))
+    r = run_launch(["--nproc_per_node", "2", "--elastic_level", "1",
+                    "--max_restart", "2", "--log_dir", str(tmp_path / "log"),
+                    str(payload)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
+
+
+def test_launch_multi_node_fake(tmp_path):
+    """Two launcher processes on localhost = fake 2-node cluster
+    (reference test strategy: multi-node faked as multi-process)."""
+    from paddle_tpu.distributed.launch.context import free_port
+    payload = tmp_path / "payload.py"
+    payload.write_text(PAYLOAD_OK.format(repo=REPO, outdir=str(tmp_path)))
+    master = f"127.0.0.1:{free_port()}"
+    import threading
+    results = {}
+
+    def run_node(idx):
+        results[idx] = run_launch(
+            ["--nnodes", "2", "--master", master, "--rank", str(idx),
+             "--nproc_per_node", "1",
+             "--log_dir", str(tmp_path / f"log{idx}"), str(payload)])
+
+    threads = [threading.Thread(target=run_node, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for idx in range(2):
+        r = results[idx]
+        assert r.returncode == 0, (idx, r.stdout, r.stderr)
+    for rank in range(2):
+        data = json.loads((tmp_path / f"rank{rank}.json").read_text())
+        assert data["world"] == 2 and data["rank"] == rank
+
+
+def test_launch_multi_node_requires_master(tmp_path):
+    payload = tmp_path / "payload.py"
+    payload.write_text("pass\n")
+    r = run_launch(["--nnodes", "2", "--nproc_per_node", "1", str(payload)])
+    assert r.returncode != 0
+    assert "--master" in (r.stdout + r.stderr)
+
+
+def test_elastic_range_settles_below_max(tmp_path):
+    """--nnodes 1:2 with only one node joined: membership closes at 1 after
+    the settle window instead of timing out waiting for node 2."""
+    payload = tmp_path / "payload.py"
+    payload.write_text(PAYLOAD_OK.format(repo=REPO, outdir=str(tmp_path)))
+    from paddle_tpu.distributed.launch.context import free_port
+    master = f"127.0.0.1:{free_port()}"
+    r = run_launch(["--nnodes", "1:2", "--master", master, "--rank", "0",
+                    "--nproc_per_node", "2",
+                    "--log_dir", str(tmp_path / "log"), str(payload)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    data = json.loads((tmp_path / "rank0.json").read_text())
+    assert data["world"] == 2  # 1 node x 2 procs
+
+
+def _spawn_target(out_dir):
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    with open(os.path.join(out_dir, f"spawn{rank}"), "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def test_spawn(tmp_path):
+    from paddle_tpu.distributed import spawn
+    spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    for rank in range(2):
+        assert (tmp_path / f"spawn{rank}").read_text() == "2"
