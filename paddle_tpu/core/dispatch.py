@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from . import autograd
 from ..flags import flag_value
+from ..profiler.record import RecordEvent, host_recorder
 
 
 def _is_tensor(x) -> bool:
@@ -45,6 +46,15 @@ def apply(fn: Callable, *args, op_name: str = "op", n_outputs: int = None, **sta
     passed through untraced w.r.t. grad). Returns Tensor(s) mirroring fn's
     output structure (a single array or a tuple of arrays).
     """
+    # Profiler hook (reference: RecordEvent inside eager op dispatch,
+    # SURVEY.md §5.1) — armed only during a capture window.
+    if host_recorder.enabled:
+        with RecordEvent(op_name, "Operator"):
+            return _apply_impl(fn, args, op_name, static)
+    return _apply_impl(fn, args, op_name, static)
+
+
+def _apply_impl(fn: Callable, args, op_name: str, static):
     from .tensor import Tensor
 
     if amp_cast_hook is not None:

@@ -288,19 +288,28 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _iter_batches(self):
+        # Profiler hook (reference: RecordEvent in dataloader, SURVEY §5.1)
+        from ..profiler.record import host_recorder, RecordEvent
+
+        def _record(make):
+            if not host_recorder.enabled:
+                return make()
+            with RecordEvent("DataLoader", "Dataloader"):
+                return make()
+
         if self._iterable:
             batch = []
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    yield _record(lambda: self.collate_fn(batch))
                     batch = []
             if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+                yield _record(lambda: self.collate_fn(batch))
             return
         for idx_batch in self.batch_sampler:
-            items = [self.dataset[i] for i in idx_batch]
-            yield self.collate_fn(items)
+            yield _record(lambda: self.collate_fn(
+                [self.dataset[i] for i in idx_batch]))
 
     def __iter__(self):
         if self.num_workers == 0:

@@ -1,0 +1,134 @@
+"""Profiler tests: scheduler state machine, RecordEvent capture, op-dispatch
+hook, chrome-tracing export, summary stats (SURVEY.md §5.1)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, make_scheduler,
+    export_chrome_tracing, summary,
+)
+from paddle_tpu.profiler.record import host_recorder
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [sched(i) for i in range(7)]
+    assert states == [
+        ProfilerState.CLOSED,             # skip_first
+        ProfilerState.CLOSED,             # closed
+        ProfilerState.READY,              # ready
+        ProfilerState.RECORD,             # record 1
+        ProfilerState.RECORD_AND_RETURN,  # record 2 (last of window)
+        ProfilerState.CLOSED,             # repeat exhausted
+        ProfilerState.CLOSED,
+    ]
+
+
+def test_scheduler_repeats_forever():
+    sched = make_scheduler(closed=0, ready=0, record=1)
+    assert sched(0) == ProfilerState.RECORD_AND_RETURN
+    assert sched(100) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_record_event_disabled_is_noop():
+    host_recorder.clear()
+    assert not host_recorder.enabled
+    with RecordEvent("should-not-appear"):
+        pass
+    assert host_recorder.drain() == []
+
+
+def test_profiler_captures_user_and_op_spans(tmp_path):
+    exports = []
+
+    def on_ready(prof):
+        export_chrome_tracing(str(tmp_path))(prof)
+        exports.append(prof.last_export_path)
+
+    p = Profiler(targets=[ProfilerTarget.CPU],
+                 scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=1),
+                 on_trace_ready=on_ready)
+    p.start()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with RecordEvent("user-span"):
+        y = (x @ x).sum()
+    p.step()
+    (x + x).mean()
+    p.step()  # RECORD_AND_RETURN -> window closes, export fires
+    p.stop()
+
+    assert len(exports) == 1
+    names = {sp.name for sp in p.collected_spans}
+    assert "user-span" in names
+    assert any(n.startswith("ProfileStep#") for n in names)
+    # op dispatch hook recorded eager ops
+    assert any(n in names for n in ("matmul", "sum", "add", "mean")), names
+
+    with open(exports[0]) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert any(e["name"] == "user-span" for e in evs)
+    assert all({"ts", "dur", "ph", "pid", "tid"} <= set(e) for e in evs)
+
+
+def test_profiler_step_range_shorthand(tmp_path):
+    p = Profiler(scheduler=(1, 3),
+                 on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    p.start()                      # step 0: closed
+    assert p.current_state == ProfilerState.CLOSED
+    p.step()                       # step 1: record
+    assert p.current_state == ProfilerState.RECORD
+    p.step()                       # step 2: record-and-return
+    assert p.current_state == ProfilerState.RECORD_AND_RETURN
+    p.step()                       # step 3: closed; export fired
+    assert p.current_state == ProfilerState.CLOSED
+    p.stop()
+    assert p.last_export_path and os.path.exists(p.last_export_path)
+
+
+def test_summary_table():
+    host_recorder.clear()
+    host_recorder.enabled = True
+    for _ in range(3):
+        with RecordEvent("alpha"):
+            time.sleep(0.001)
+    with RecordEvent("beta"):
+        time.sleep(0.003)
+    host_recorder.enabled = False
+    spans = host_recorder.drain()
+    text = summary(spans)
+    lines = text.splitlines()
+    assert "alpha" in text and "beta" in text
+    alpha_row = next(l for l in lines if l.startswith("alpha"))
+    assert " 3 " in alpha_row or alpha_row.split()[1] == "3"
+
+
+def test_dataloader_span():
+    from paddle_tpu import io
+    ds = io.TensorDataset([np.arange(8, dtype=np.float32).reshape(8, 1)])
+    loader = io.DataLoader(ds, batch_size=4)
+    host_recorder.clear()
+    host_recorder.enabled = True
+    list(loader)
+    host_recorder.enabled = False
+    names = [sp.name for sp in host_recorder.drain()]
+    assert "DataLoader" in names
+
+
+def test_step_info_ips():
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        time.sleep(0.002)
+        p.step(num_samples=32)
+    info = p.step_info()
+    assert "batch_cost" in info and "ips" in info
+    p.stop()
