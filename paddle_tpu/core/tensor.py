@@ -220,7 +220,8 @@ class Parameter(Tensor):
     python/paddle — framework Parameter; SURVEY.md §2.1 AutogradMeta)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "is_distributed_param", "expert")
+                 "is_distributed_param", "expert", "is_sequence_parallel",
+                 "main_grad")
 
     def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -231,6 +232,8 @@ class Parameter(Tensor):
         self.need_clip = True
         self.is_distributed_param = False
         self.expert = False  # expert-parallel param (MoE): excluded from dp sync
+        self.is_sequence_parallel = False  # SP-marked (grad allreduced over mp)
+        self.main_grad = None  # fp32 accumulation buffer (mix_precision_utils)
 
     def set_value(self, value):
         v = value._value if isinstance(value, Tensor) else jnp.asarray(value)

@@ -23,3 +23,7 @@ from . import env  # noqa: F401
 from .auto_parallel.api import shard_tensor, ProcessMesh, Shard, Replicate, Partial  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import communication  # noqa: F401
+from .communication.p2p import (  # noqa: F401
+    P2POp, batch_isend_irecv, isend, irecv,
+)

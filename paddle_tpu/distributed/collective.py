@@ -217,15 +217,13 @@ def all_to_all(*args, **kwargs):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager point-to-point send/recv is process-based in the reference; "
-        "in-program p2p uses lax.ppermute via the pipeline engine")
+    from .communication.p2p import send as _send
+    return _send(tensor, dst=dst, group=group, sync_op=sync_op)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager point-to-point send/recv is process-based in the reference; "
-        "in-program p2p uses lax.ppermute via the pipeline engine")
+    from .communication.p2p import recv as _recv
+    return _recv(tensor, src=src, group=group, sync_op=sync_op)
 
 
 def barrier(group=None):

@@ -1,0 +1,108 @@
+"""Fleet utils: main_grad mixed precision, tensor-fusion comm buffers,
+hybrid grad-sync helpers (SURVEY.md §2.4/§2.5)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.utils import (
+    mix_precision_utils as mpu,
+    tensor_fusion_helper as tfh,
+    hybrid_parallel_util as hpu,
+)
+
+
+def _tiny_net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_main_grad_accumulates_fp32():
+    net = _tiny_net()
+    # cast params to bf16 (O2-style pure half)
+    for p in net.parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    wrapped = mpu.MixPrecisionLayer(net, dtype="bfloat16")
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 4)
+                         .astype(np.float32)).astype("bfloat16")
+    for micro in range(3):
+        loss = wrapped(x).astype("float32").sum()
+        loss.backward()
+        wrapped.accumulate_main_grads()
+        assert net[0].weight.grad is None           # folded away
+    mg = net[0].weight.main_grad
+    assert mg is not None and mg._value.dtype == jnp.float32
+    # 3 identical microbatches -> main_grad = 3 * single-step grad
+    single = _tiny_net()
+    for p in single.parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    loss = single(x).astype("float32").sum()
+    loss.backward()
+    g1 = np.asarray(single[0].weight.grad._value, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(mg._value), 3 * g1,
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_mix_precision_optimizer_steps_from_main_grad():
+    net = _tiny_net()
+    opt = mpu.MixPrecisionOptimizer(
+        optimizer.SGD(learning_rate=0.5, parameters=net.parameters()))
+    w0 = np.asarray(net[0].weight._value).copy()
+    net[0].weight.main_grad = paddle.to_tensor(
+        np.ones_like(w0, dtype=np.float32))
+    opt.step()
+    np.testing.assert_allclose(np.asarray(net[0].weight._value), w0 - 0.5,
+                               rtol=1e-6)
+    opt.clear_grad()
+    assert net[0].weight.main_grad is None
+
+
+def test_fused_buffer_roundtrip():
+    net = _tiny_net()
+    params = list(net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    net(x).sum().backward()
+    before = {id(p): np.asarray(p.grad._value).copy() for p in params}
+    bufs = tfh.fused_parameters(params, group_size=1 << 20)
+    assert len(bufs) == 1
+    buf = bufs[0]
+    for p in params:
+        buf.add_grad(p)
+    assert buf.all_grads_added
+    buf.comm(collective_fn=lambda b: b)  # identity collective
+    buf.scatter_grads()
+    for p in params:
+        np.testing.assert_allclose(np.asarray(p.grad._value),
+                                   before[id(p)], rtol=1e-6)
+
+
+def test_fused_parameters_bucketing():
+    net = nn.Sequential(*[nn.Linear(64, 64) for _ in range(4)])
+    params = list(net.parameters())
+    # force multiple buckets: each weight is 64*64*4B = 16KB
+    bufs = tfh.fused_parameters(params, group_size=20 * 1024)
+    assert len(bufs) > 1
+    total = sum(len(b._params) for b in bufs)
+    assert total == len(params)
+
+
+def test_fused_allreduce_gradients_world1():
+    net = _tiny_net()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    net(x).sum().backward()
+    g0 = np.asarray(net[0].weight.grad._value).copy()
+    hpu.fused_allreduce_gradients(list(net.parameters()))
+    np.testing.assert_allclose(np.asarray(net[0].weight.grad._value), g0,
+                               rtol=1e-6)
+
+
+def test_expert_params_excluded():
+    net = _tiny_net()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    net(x).sum().backward()
+    net[0].weight.expert = True
+    marker = np.asarray(net[0].weight.grad._value).copy()
+    hpu.fused_allreduce_gradients(list(net.parameters()))
+    np.testing.assert_allclose(np.asarray(net[0].weight.grad._value), marker)

@@ -1,0 +1,139 @@
+"""Megatron sequence-parallel utils (SURVEY.md §2.4 SP row): op semantics,
+custom gradients, and the Column/Row SP linear pair vs dense reference —
+all on the 8-device CPU mesh in manual (shard_map) mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import mesh as pmesh, pcontext
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+S, B, H, FF = 16, 2, 8, 32  # seq divisible by mp=8
+
+
+def _mesh8():
+    mesh = pmesh.build_mesh({"mp": 8})
+    pmesh.set_global_mesh(mesh)
+    return mesh
+
+
+def test_scatter_gather_roundtrip():
+    mesh = _mesh8()
+    x = np.random.RandomState(0).randn(S, B, H).astype(np.float32)
+
+    def fn(v):
+        shard = spu.scatter_array(v, "mp")         # full -> local slice
+        assert shard.shape == (S // 8, B, H)
+        return spu.gather_array(shard, "mp")       # back to full
+
+    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                              check_vma=False))
+    np.testing.assert_array_equal(np.asarray(f(x)), x)
+
+
+def test_all_gather_reduce_scatter_grads():
+    """bwd(all_gather) == reduce_scatter and bwd(reduce_scatter) == all_gather:
+    check via jax.grad against the mathematically expected gradient."""
+    mesh = _mesh8()
+    rng = np.random.RandomState(1)
+    x = rng.randn(S, B).astype(np.float32)        # seq-sharded input
+    w = rng.randn(S, B).astype(np.float32)        # full-seq weighting
+
+    def loss_fn(xs, wf):
+        full = spu.all_gather_array(xs, "mp")     # [S, B] assembled
+        return jnp.sum(full * wf)
+
+    g = jax.jit(jax.shard_map(jax.grad(loss_fn), mesh=mesh,
+                              in_specs=(P("mp"), P()), out_specs=P("mp"),
+                              check_vma=False))(x, w)
+    # every device's local loss counts each x shard once (the loss is
+    # effectively summed over devices), so bwd = psum_scatter accumulates
+    # n copies: grad = n * w slice — the reduce_scatter transpose at work
+    np.testing.assert_allclose(np.asarray(g), 8 * w, rtol=1e-5)
+
+    def loss_rs(xf, wf):
+        red = spu.reduce_scatter_array(xf, "mp")  # [S/8, B] on each rank
+        return jnp.sum(red * spu.scatter_array(wf, "mp"))
+
+    g2 = jax.jit(jax.shard_map(jax.grad(loss_rs), mesh=mesh,
+                               in_specs=(P(), P()), out_specs=P(),
+                               check_vma=False))(x, w)
+    # bwd(reduce_scatter) = all_gather of the per-rank cotangent slices:
+    # each device assembles exactly w — no n-fold accumulation
+    np.testing.assert_allclose(np.asarray(g2), w, rtol=1e-5)
+
+
+def test_sp_mlp_matches_dense():
+    """ColumnSP -> gelu -> RowSP over seq-sharded activations == dense MLP,
+    values and input gradient."""
+    mesh = _mesh8()
+    rng = np.random.RandomState(2)
+    x = rng.randn(S, B, H).astype(np.float32)
+    w1 = rng.randn(H, FF).astype(np.float32)
+    w2 = rng.randn(FF, H).astype(np.float32)
+
+    def sp_loss_local(xs, w1l, w2l):
+        with pcontext.manual_parallel({"mp": "mp"}):
+            full = spu.all_gather_array(xs, "mp")
+            h = jax.nn.gelu(jnp.matmul(full, w1l))
+            y = spu.reduce_scatter_array(jnp.matmul(h, w2l), "mp")
+            # local shard contribution; the global loss is the sum over
+            # devices (psum here would double-count in the gradients)
+            return jnp.sum(y ** 2)
+
+    vg = jax.value_and_grad(sp_loss_local)
+
+    def wrapped(xs, w1l, w2l):
+        l, g = vg(xs, w1l, w2l)
+        return l[None], g
+
+    f = jax.jit(jax.shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P("mp"), P(None, "mp"), P("mp", None)),
+        out_specs=(P("mp"), P("mp")), check_vma=False))
+    loss_shards, gx = f(x, w1, w2)
+    loss = jnp.sum(loss_shards)
+
+    def dense(xf, w1f, w2f):
+        h = jax.nn.gelu(xf @ w1f)
+        return jnp.sum((h @ w2f) ** 2)
+
+    ref_loss, ref_gx = jax.value_and_grad(dense)(x, w1, w2)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ref_gx),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_tensor_ops_identity_outside_manual_mode():
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    assert spu.ScatterOp.apply(x) is x
+    assert spu.GatherOp.apply(x) is x
+    assert spu.AllGatherOp.apply(x) is x
+    assert spu.ReduceScatterOp.apply(x) is x
+
+
+def test_sp_linear_layers_eager_fallback():
+    """Outside manual mode the SP linears behave as plain linears."""
+    import paddle_tpu as paddle
+    _mesh8()
+    col = spu.ColumnSequenceParallelLinear(H, FF, has_bias=True)
+    row = spu.RowSequenceParallelLinear(FF, H, has_bias=True)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(S, B, H)
+                         .astype(np.float32))
+    y = row(col(x))
+    assert tuple(y.shape) == (S, B, H)
+
+
+def test_mark_and_sync_helpers():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    _mesh8()
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    ln = net[1]
+    spu.mark_as_sequence_parallel_parameter(ln.weight)
+    marked = spu.register_sequence_parallel_allreduce_hooks(net)
+    assert ln.weight in marked and len(marked) == 1
