@@ -41,6 +41,9 @@ from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import jit  # noqa: F401
 from . import framework  # noqa: F401
+from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
+from . import static  # noqa: F401
 from .framework.io_save import save, load  # noqa: F401
 
 # subpackages imported lazily by user code: distributed, vision, hapi, parallel,

@@ -196,3 +196,6 @@ def not_to_static(fn):
 
 def enable_to_static(flag: bool):
     pass
+
+
+from .save_load import save, load, TranslatedLayer  # noqa: E402,F401

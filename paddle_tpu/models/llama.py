@@ -524,3 +524,113 @@ def microbatch(ids: np.ndarray, labels: np.ndarray, num_micro: int):
     assert B % num_micro == 0
     return (ids.reshape(num_micro, B // num_micro, -1),
             labels.reshape(num_micro, B // num_micro, -1))
+
+
+# ===========================================================================
+# KV-cache inference path (serving: prefill + single-token decode)
+# ===========================================================================
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int, dtype=None):
+    """Contiguous per-layer KV cache (L, B, S_max, n_kv, d). The paged
+    variant for ragged serving batches lives in ops/paged_attention.py."""
+    L = config.num_hidden_layers
+    d = config.head_dim
+    nkv = config.num_key_value_heads
+    dt = dtype or config.dtype
+    shape = (L, batch, max_len, nkv, d)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attention(q, k_cache, v_cache, kv_len, config: LlamaConfig):
+    """q: (B, T, nh, d); caches: (B, S_max, nkv, d); attend over [0, kv_len)
+    with causality inside the current T block (query i sits at absolute
+    position kv_len - T + i)."""
+    b, t, nh, d = q.shape
+    s_max = k_cache.shape[1]
+    rep = nh // k_cache.shape[2]
+    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(d)
+    q_pos = kv_len - t + jnp.arange(t)                      # (T,)
+    mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]     # (T, S_max)
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out
+
+
+def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
+                          config: LlamaConfig):
+    """One decoder layer with cache write + cached attention.
+    x: (B, T, H); cos/sin: (T, d) rope rows for these positions;
+    caches: (B, S_max, nkv, d). Returns (x', k_cache', v_cache')."""
+    b, t, h = x.shape
+    d = config.head_dim
+    xn = _rms(x, lp["ln1"], config.rms_norm_eps)
+    q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, t, -1, d)
+    k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, t, -1, d)
+    v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, t, -1, d)
+    q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    start = kv_len - t
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                       (0, start, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                       (0, start, 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, kv_len, config)
+    x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), lp["wo"])
+    xn = _rms(x, lp["ln2"], config.rms_norm_eps)
+    g = jnp.einsum("bth,hm->btm", xn, lp["w_gate"])
+    u = jnp.einsum("bth,hm->btm", xn, lp["w_up"])
+    x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+    return x, k_cache, v_cache
+
+
+def prefill_stacked(params, ids, cache, config: LlamaConfig):
+    """Process the whole prompt, filling the cache.
+    ids: (B, T) int32 (pad to a bucket length for shape stability).
+    Returns (per-position logits (B, T, V), cache') — the caller picks the
+    last *real* prompt position (right-padding makes position T-1 a pad)."""
+    t = ids.shape[1]
+    s_max = cache["k"].shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+    kv_len = jnp.asarray(t, jnp.int32)
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kc, vc = lp_kv
+        xo, kc, vc = _decoder_layer_cached(lp, xc, cos_full[:t], sin_full[:t],
+                                           kc, vc, kv_len, config)
+        return xo, (kc, vc)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bth,hv->btv", x, params["lm_head"])
+    return logits, {"k": k_new, "v": v_new}
+
+
+def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
+    """One generated token. tok: (B,) int32; pos: scalar int32 — absolute
+    position of ``tok`` (so kv_len becomes pos+1). Returns (logits, cache')."""
+    s_max = cache["k"].shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], tok.astype(jnp.int32), axis=0)[:, None, :]
+    cos = lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+    sin = lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+    kv_len = pos + 1
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kc, vc = lp_kv
+        xo, kc, vc = _decoder_layer_cached(lp, xc, cos, sin, kc, vc,
+                                           kv_len, config)
+        return xo, (kc, vc)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], params["lm_head"])
+    return logits, {"k": k_new, "v": v_new}
