@@ -106,6 +106,13 @@ class CollectiveController:
                         self.job.pod.restart_count >= restart_budget:
                     self.master.close()
                     return 1
+                # A failed rendezvous poisons its generation (half-written
+                # counters/endpoints): bump so every node retries in a fresh
+                # namespace — peers already deployed notice via their watch.
+                try:
+                    self.gen = self.master.bump_gen()
+                except Exception:
+                    pass
                 self.job.pod.reset()
                 time.sleep(1)
                 continue

@@ -24,17 +24,29 @@ class ProcessContext:
         self.processes = procs
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        for p in self.processes:
-            p.join(timeout)
-        alive = [p for p in self.processes if p.is_alive()]
-        if alive:
-            return False
-        bad = [p for p in self.processes if p.exitcode != 0]
-        if bad:
-            raise RuntimeError(
-                f"{len(bad)} spawned process(es) failed with exit codes "
-                f"{[p.exitcode for p in bad]}")
-        return True
+        """Join all workers. If any worker dies non-zero while siblings are
+        still running, the survivors are terminated (they may be blocked on
+        a rendezvous with the dead rank) and RuntimeError is raised —
+        reference spawn behaviour."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            alive = [p for p in self.processes if p.is_alive()]
+            bad = [p for p in self.processes
+                   if not p.is_alive() and p.exitcode != 0]
+            if bad:
+                for p in alive:
+                    p.terminate()
+                for p in alive:
+                    p.join(5)
+                raise RuntimeError(
+                    f"{len(bad)} spawned process(es) failed with exit codes "
+                    f"{[p.exitcode for p in bad]}")
+            if not alive:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            alive[0].join(0.2)
 
 
 def _worker(func, i: int, args, env: Dict[str, str]):
