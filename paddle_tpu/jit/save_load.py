@@ -95,6 +95,10 @@ class TranslatedLayer:
     def input_spec(self) -> List[InputSpec]:
         return [InputSpec(m["shape"], m["dtype"]) for m in self._meta["inputs"]]
 
+    @property
+    def n_outputs(self) -> int:
+        return len(self._exported.out_avals)
+
     def __call__(self, *args):
         xs = [a._value if isinstance(a, Tensor) else np.asarray(a)
               for a in args]

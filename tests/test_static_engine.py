@@ -1,0 +1,81 @@
+"""static.Executor / load_inference_model and auto_parallel.Engine
+(SURVEY.md §2.1 executor row, §2.4 auto-parallel row)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.static import InputSpec
+from paddle_tpu.distributed.auto_parallel import Engine, shard_layer
+from paddle_tpu.distributed import ProcessMesh, Shard, Replicate
+
+
+def _net():
+    paddle.seed(11)
+    return nn.Sequential(nn.Linear(6, 24), nn.Tanh(), nn.Linear(24, 2))
+
+
+def test_executor_runs_loaded_program(tmp_path):
+    net = _net()
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x))._value)
+    prefix = str(tmp_path / "prog")
+    static.save_inference_model(prefix, [InputSpec([3, 6], "float32")],
+                                None, layer=net)
+    exe = static.Executor()
+    prog, feed_names, _ = static.load_inference_model(prefix, exe)
+    outs = exe.run(prog, feed={feed_names[0]: x}, fetch_list=[0])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+
+
+def test_executor_missing_feed_raises(tmp_path):
+    net = _net()
+    prefix = str(tmp_path / "prog2")
+    static.save_inference_model(prefix, [InputSpec([1, 6], "float32")],
+                                None, layer=net)
+    exe = static.Executor()
+    prog, _, _ = static.load_inference_model(prefix, exe)
+    with pytest.raises(ValueError):
+        exe.run(prog, feed={}, fetch_list=[0])
+
+
+def test_engine_fit_evaluate_predict():
+    from paddle_tpu.io import TensorDataset
+    net = _net()
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 6).astype(np.float32)
+    W = rng.randn(6, 2).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    ds = TensorDataset([X, Y])
+
+    def mse(pred, label):
+        return ((pred - label) ** 2).mean()
+
+    eng = Engine(net, loss=mse,
+                 optimizer=optimizer.AdamW(learning_rate=2e-2,
+                                           parameters=net.parameters()))
+    hist = eng.fit(ds, epochs=6, batch_size=8)
+    assert hist[-1]["loss"] < hist[0]["loss"] * 0.5, hist
+    ev = eng.evaluate(ds, batch_size=8)
+    assert ev["loss"] == pytest.approx(hist[-1]["loss"], rel=0.8)
+    preds = eng.predict(ds, batch_size=8)
+    assert len(preds) == 4 and preds[0].shape == (8, 2)
+
+
+def test_shard_layer_places_params():
+    net = _net()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+
+    def shard_fn(name, layer, pmesh):
+        if "weight" in name and "0" in name:
+            return [Replicate(), Shard(1)]  # shard out-features over 'y'
+        return None
+
+    shard_layer(net, mesh, shard_fn)
+    w = net[0].weight
+    assert w._sharding_spec is not None
+    # 24 out-features over y=4 -> shard dim 1 in 4 pieces
+    shards = w._value.addressable_shards
+    assert len(shards) == 8
+    assert shards[0].data.shape == (6, 6)
