@@ -14,4 +14,6 @@ decode loop with KV cache lives in paddle_tpu.inference.decoding.
 from .config import Config  # noqa: F401
 from .predictor import Predictor, create_predictor  # noqa: F401
 from . import decoding  # noqa: F401
-from .decoding import GenerationConfig, GenerationEngine, KVCache  # noqa: F401
+from .decoding import (  # noqa: F401
+    GenerationConfig, GenerationEngine, PagedGenerationEngine, KVCache,
+)

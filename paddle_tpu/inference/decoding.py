@@ -134,10 +134,8 @@ class GenerationEngine:
         bucket = _bucket(t)
         padded = np.full((b, bucket), cfg.pad_token_id, ids.dtype)
         padded[:, :t] = ids
-        # left-fill: contiguous cache wants real tokens at the end? No —
-        # causal prefill with right-padding: cache rows beyond prompt_len
-        # are garbage but masked off by kv_len during decode only if decode
-        # starts at prompt_len. We pass prompt_len so positions line up.
+        # right-padding is safe: pad rows in the cache sit beyond kv_len
+        # until decode overwrites each position before first attending to it
         key = (bucket, cfg.max_new_tokens, b)
         if key not in self._compiled:
             self._compiled[key] = self._build(bucket, cfg.max_new_tokens)
@@ -172,3 +170,89 @@ def _llama_prefill(params, ids, cache, config):
 def _llama_decode(params, tok, pos, cache, config):
     from ..models import llama as L
     return L.decode_step_stacked(params, tok, pos, cache, config)
+
+
+# ---------------------------------------------------------------------------
+# Ragged (paged) serving engine
+# ---------------------------------------------------------------------------
+class PagedGenerationEngine:
+    """Ragged-batch generation over the paged KV cache.
+
+    Unlike GenerationEngine (uniform prompt lengths, contiguous cache),
+    prompts may have different lengths: each sequence owns pages via a
+    block table (ops/paged_attention.py), decode positions advance per row,
+    and sampling starts from each row's own last prompt token.
+    """
+
+    def __init__(self, model_config, generation_config: Optional[GenerationConfig] = None,
+                 page_size: int = 16, num_pages: Optional[int] = None):
+        from ..models import llama as L
+        self._L = L
+        self.model_config = model_config
+        self.config = generation_config or GenerationConfig()
+        self.page_size = page_size
+        self._num_pages = num_pages
+        self._compiled: Dict[Tuple, Callable] = {}
+
+    def _build(self, max_new: int):
+        L = self._L
+        cfg = self.config
+        mcfg = self.model_config
+
+        def run(params, ids, seq_lens, k_pages, v_pages, block_tables, key):
+            logits, k_pages, v_pages = L.prefill_paged(
+                params, ids, seq_lens, k_pages, v_pages, block_tables, mcfg)
+            last = jnp.take_along_axis(
+                logits, (seq_lens - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                       # (B, V) per-row last token
+            key, sub = jax.random.split(key)
+            tok = _sample(last, sub, cfg)
+
+            def step(carry, i):
+                tok, kp, vp, key = carry
+                positions = seq_lens + i            # (B,) per-row position
+                lg, kp, vp = L.decode_step_paged(
+                    params, tok, positions, kp, vp, block_tables, mcfg)
+                key, sub = jax.random.split(key)
+                nxt = _sample(lg, sub, cfg)
+                return (nxt, kp, vp, key), tok
+
+            (last_tok, k_pages, v_pages, _), toks = jax.lax.scan(
+                step, (tok, k_pages, v_pages, key), jnp.arange(max_new - 1))
+            toks = jnp.concatenate([toks, last_tok[None]], axis=0)
+            return jnp.swapaxes(toks, 0, 1), k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(3, 4))
+
+    def generate(self, params, prompts):
+        """prompts: list of 1-D int arrays (ragged) → (B, max_new_tokens)."""
+        from ..ops.paged_attention import PagedKVCacheManager
+        cfg = self.config
+        mcfg = self.model_config
+        lens = [len(p) for p in prompts]
+        b = len(prompts)
+        t_bucket = _bucket(max(lens))
+        ids = np.full((b, t_bucket), cfg.pad_token_id, np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = np.asarray(p, np.int32)
+
+        total = [l + cfg.max_new_tokens for l in lens]
+        pages_per_seq = [(n + self.page_size - 1) // self.page_size
+                         for n in total]
+        num_pages = self._num_pages or (sum(pages_per_seq) + 1)
+        mgr = PagedKVCacheManager(
+            mcfg.num_hidden_layers, num_pages, self.page_size,
+            mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+        for i in range(b):
+            mgr.allocate(i, total[i])
+            mgr._lens[i] = lens[i]  # prompt length is the live length
+        bt, seq_lens = mgr.block_tables(list(range(b)))
+
+        key = (t_bucket, cfg.max_new_tokens, b, bt.shape[1])
+        if key not in self._compiled:
+            self._compiled[key] = self._build(cfg.max_new_tokens)
+        rng = jax.random.key(cfg.seed)
+        toks, _, _ = self._compiled[key](
+            params, jnp.asarray(ids), jnp.asarray(seq_lens, jnp.int32),
+            mgr.k_pages, mgr.v_pages, jnp.asarray(bt), rng)
+        return np.asarray(toks)

@@ -634,3 +634,99 @@ def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     logits = jnp.einsum("bh,hv->bv", x[:, 0], params["lm_head"])
     return logits, {"k": k_new, "v": v_new}
+
+
+# ===========================================================================
+# Paged KV-cache path (ragged serving batches; ops/paged_attention.py)
+# ===========================================================================
+def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
+                  config: LlamaConfig):
+    """Prefill a ragged batch into paged KV.
+
+    ids: (B, T) right-padded prompts; seq_lens: (B,) true lengths;
+    k_pages/v_pages: (L, P, page, nkv, d); block_tables: (B, max_pages),
+    padded slots pointing at reserved page 0.
+    Returns (logits (B, T, V), k_pages', v_pages').
+    """
+    b, t = ids.shape
+    page = k_pages.shape[2]
+    cos, sin = rope_ops.build_rope_cache(t, config.head_dim, config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+
+    # scatter indices for every (b, t) slot: pad tokens land in page 0
+    tpos = jnp.arange(t)
+    page_idx = tpos[None, :] // page                      # (B, T)
+    page_off = tpos[None, :] % page
+    phys = jnp.take_along_axis(block_tables, page_idx, axis=1)  # (B, T)
+    valid = tpos[None, :] < seq_lens[:, None]
+    phys = jnp.where(valid, phys, 0)
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kp, vp = lp_kv
+        d = config.head_dim
+        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+        q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, t, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, t, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, t, -1, d)
+        q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+        # causal attention within the (padded) prompt
+        attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        xo = xc + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), lp["wo"])
+        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+        g = jnp.einsum("bth,hm->btm", xn2, lp["w_gate"])
+        u = jnp.einsum("bth,hm->btm", xn2, lp["w_up"])
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+        # scatter this layer's K/V into its pages
+        kp = kp.at[phys, page_off].set(k.astype(kp.dtype))
+        vp = vp.at[phys, page_off].set(v.astype(vp.dtype))
+        return xo, (kp, vp)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bth,hv->btv", x, params["lm_head"])
+    return logits, k_new, v_new
+
+
+def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
+                      config: LlamaConfig):
+    """One ragged decode step. tok: (B,); positions: (B,) absolute position
+    of each row's new token (may differ per row). Returns
+    (logits (B, V), k_pages', v_pages')."""
+    from ..ops import paged_attention as pa
+    b = tok.shape[0]
+    d = config.head_dim
+    s_max = block_tables.shape[1] * k_pages.shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], tok.astype(jnp.int32), axis=0)[:, None, :]
+    cos = jnp.take(cos_full, positions, axis=0)[:, None, :]  # (B, 1, d)
+    sin = jnp.take(sin_full, positions, axis=0)[:, None, :]
+    kv_lens = positions + 1
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kp, vp = lp_kv
+        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+        q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, 1, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, 1, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, 1, -1, d)
+        q2, k2 = rope_ops.apply_rope_array(q, k, cos, sin)  # (B,1,d) 3-D form
+        kp, vp = pa.paged_write_array(kp, vp, k2[:, 0], v[:, 0],
+                                      block_tables, positions)
+        attn = pa.paged_attention_array(q2[:, 0], kp, vp, block_tables,
+                                        kv_lens, scale=1.0 / math.sqrt(d))
+        xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
+                             lp["wo"])[:, None, :]
+        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+        g = jnp.einsum("bth,hm->btm", xn2, lp["w_gate"])
+        u = jnp.einsum("bth,hm->btm", xn2, lp["w_up"])
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+        return xo, (kp, vp)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], params["lm_head"])
+    return logits, k_new, v_new

@@ -14,3 +14,4 @@ differentiable. Selection honours FLAGS_use_pallas_kernels.
 
 from . import flash_attention, rms_norm, rope, moe_ops, ring_attention  # noqa: F401
 from . import fused_linear, fused_transformer_block  # noqa: F401
+from . import paged_attention  # noqa: F401
