@@ -2,7 +2,9 @@
 
 llama — TP/PP/DP/SP hybrid training flagship (workload #2).
 gpt   — FusedMultiTransformer pretraining/inference path (workload #3).
+ernie — bidirectional encoder on fused attention/FFN (workload #3).
 """
 
 from . import llama  # noqa: F401
 from . import gpt  # noqa: F401
+from . import ernie  # noqa: F401
