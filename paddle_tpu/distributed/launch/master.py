@@ -88,19 +88,20 @@ class StoreMaster:
         """
         ns = f"{self.prefix}/g{gen}"
         # Pinned (--rank) and auto-assigned ranks cannot mix: an auto node
-        # could collide with a pinned rank it cannot see. Fail fast instead
-        # of hanging with a hole at rank 0.
+        # could collide with a pinned rank it cannot see. Fail fast — and do
+        # it BEFORE joining the membership counter, so an aborting node does
+        # not become a phantom member its peers wait on.
         mode = "pinned" if rank >= 0 else "auto"
-        self.store.add(f"{ns}/mode_{mode}", 1)
-        if rank < 0:
-            rank = self.store.add(f"{ns}/node_counter", 1) - 1
-        else:
-            self.store.add(f"{ns}/node_counter", 1)
         other = "auto" if mode == "pinned" else "pinned"
+        self.store.add(f"{ns}/mode_{mode}", 1)
         if self.store.add(f"{ns}/mode_{other}", 0) > 0:
             raise RuntimeError(
                 "rendezvous: some nodes pinned --rank while others did not; "
                 "pin every node's rank or none")
+        if rank < 0:
+            rank = self.store.add(f"{ns}/node_counter", 1) - 1
+        else:
+            self.store.add(f"{ns}/node_counter", 1)
         self.store.set(f"{ns}/node/{rank}", json.dumps(endpoints))
 
         if rank == 0:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence
@@ -235,6 +236,36 @@ class DistributedBatchSampler(BatchSampler):
 # ---------------------------------------------------------------------------
 # collate
 # ---------------------------------------------------------------------------
+def numpy_collate_fn(batch):
+    """Collate to host numpy (no device work) — what worker processes run:
+    device placement must happen in the trainer process, never in a worker
+    (a worker touching jax would initialize its own backend — on TPU, dial
+    the chip — per process)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return [numpy_collate_fn([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: numpy_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _wrap_collated(tree):
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    if isinstance(tree, list):
+        return [_wrap_collated(e) for e in tree]
+    if isinstance(tree, dict):
+        return {k: _wrap_collated(v) for k, v in tree.items()}
+    return tree
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (np.ndarray, np.generic)):
@@ -254,12 +285,59 @@ def default_collate_fn(batch):
 # ---------------------------------------------------------------------------
 # DataLoader
 # ---------------------------------------------------------------------------
+class WorkerInfo:
+    """Parity with paddle.io.get_worker_info()."""
+
+    def __init__(self, id: int, num_workers: int, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = [None]
+
+
+def get_worker_info():
+    return _worker_info[0]
+
+
+def _worker_loop(dataset, collate_fn, idx_queue, out_queue, init_fn,
+                 worker_id: int, num_workers: int):
+    """Worker process body (reference: dataloader/worker.py _worker_loop).
+    Must be module-level so spawn contexts can pickle it."""
+    # Safety net: if user code in this worker does touch jax, keep it on the
+    # CPU backend — a worker must never dial the accelerator (the axon
+    # sitecustomize would otherwise pick the TPU platform and block).
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    _worker_info[0] = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    try:
+        while True:
+            item = idx_queue.get()
+            if item is None:
+                break
+            b, idxs = item
+            batch = collate_fn([dataset[i] for i in idxs])
+            out_queue.put(("ok", (b, batch)))
+        out_queue.put(("done", worker_id))
+    except Exception as e:  # surface the error to the consumer
+        out_queue.put(("err", f"worker {worker_id}: {type(e).__name__}: {e}"))
 class DataLoader:
-    """Reference uses forked worker processes + shared-memory transport
-    (python/paddle/io/dataloader/worker.py, mmap_allocator.cc). Host-side numpy
-    work here is lighter-weight: a thread pool with prefetch queue (python
-    threads release the GIL in numpy) — multiprocess mode can be layered on
-    when input pipelines dominate."""
+    """Batch loader with optional multiprocess workers.
+
+    Reference: python/paddle/io/dataloader/{dataloader_iter,worker}.py with
+    shared-memory tensor transport (mmap_allocator.cc — SURVEY.md §2.5).
+    ``num_workers>0`` on a map-style dataset forks real worker processes:
+    batch i goes to worker i % num_workers and an ordering buffer restores
+    sequence on the consumer side (the reference's scheme). Transport is
+    pickle over an OS pipe — numpy arrays ride the zero-copy pickle-5
+    buffer protocol, the portable analog of the reference's shm segments.
+    IterableDataset (not index-addressable) uses a prefetch thread."""
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -270,6 +348,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self._worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -315,6 +394,10 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
+        if not self._iterable:
+            yield from self._iter_multiprocess()
+            return
+        # IterableDataset: prefetch thread (no index addressing to split on)
         q: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
@@ -332,3 +415,87 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+    def _iter_multiprocess(self):
+        """Real worker processes, reference ordering scheme: batch b is
+        produced by worker b % num_workers; a reorder buffer keeps output
+        in batch order while workers run ahead up to prefetch_factor."""
+        import multiprocessing as mp
+        # spawn, not fork: the parent holds live jax/XLA threads and forking
+        # a multithreaded process deadlocks (observed, and warned by jax).
+        # Workers do host-side numpy only, so a fresh interpreter is correct;
+        # dataset/collate_fn must be picklable (same rule as the reference's
+        # spawn-mode dataloader).
+        ctx = mp.get_context("spawn")
+        nw = self.num_workers
+        idx_queues = [ctx.Queue() for _ in range(nw)]
+        out_queue = ctx.Queue(maxsize=nw * self.prefetch_factor)
+        # workers collate to numpy; Tensor wrapping happens on this side
+        worker_collate = (numpy_collate_fn
+                          if self.collate_fn is default_collate_fn
+                          else self.collate_fn)
+        wrap = (self.collate_fn is default_collate_fn)
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, worker_collate, idx_queues[w], out_queue,
+                      self._worker_init_fn, w, nw),
+                daemon=True)
+            for w in range(nw)
+        ]
+        # Children must never dial the accelerator — including during
+        # bootstrap arg-unpickling (a dataset holding jax arrays would
+        # initialize a backend before _worker_loop's own guard runs), so
+        # the platform pin goes into the env the children inherit.
+        saved_platform = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for p in workers:
+                p.start()
+        finally:
+            if saved_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved_platform
+        batches = list(self.batch_sampler)
+        try:
+            # prime + stream the index queues
+            for b, idxs in enumerate(batches):
+                idx_queues[b % nw].put((b, idxs))
+            for q in idx_queues:
+                q.put(None)  # per-worker end marker
+            buffer = {}
+            next_out = 0
+            n = len(batches)
+            while next_out < n:
+                try:
+                    kind, payload = out_queue.get(timeout=5.0)
+                except queue.Empty:
+                    # don't block forever on silently-dead workers (e.g. a
+                    # spawn child that crashed before reaching the loop)
+                    dead = [w for w, p in enumerate(workers)
+                            if not p.is_alive() and p.exitcode != 0]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died with exit "
+                            f"codes {[workers[w].exitcode for w in dead]}")
+                    if all(not p.is_alive() for p in workers):
+                        raise RuntimeError(
+                            "DataLoader workers exited before producing all "
+                            "batches")
+                    continue
+                if kind == "err":
+                    raise RuntimeError(f"DataLoader worker failed: {payload}")
+                if kind == "done":
+                    continue
+                b, batch = payload
+                buffer[b] = _wrap_collated(batch) if wrap else batch
+                while next_out in buffer:
+                    yield buffer.pop(next_out)
+                    next_out += 1
+        finally:
+            for p in workers:
+                if p.is_alive():
+                    p.terminate()
+            for p in workers:
+                p.join(5)
