@@ -204,3 +204,6 @@ class GradScaler:
         self._scale = s["scale"]
         self._good = s["good"]
         self._bad = s["bad"]
+
+
+from . import debugging  # noqa: E402,F401

@@ -67,6 +67,9 @@ def _apply_impl(fn: Callable, args, op_name: str, static):
 
     if not needs_grad:
         out = fn(*vals, **static)
+        if flag_value("check_nan_inf"):
+            _check_nan_inf(op_name,
+                           out if isinstance(out, (tuple, list)) else (out,))
         return _wrap_outputs(out, stop_gradient=True)
 
     # Differentiate only w.r.t. Tensor positional args; close over the rest.
@@ -106,15 +109,29 @@ def _wrap_outputs(out, stop_gradient: bool):
     return Tensor(out, stop_gradient=stop_gradient)
 
 
+# nan/inf checker policy, configured by paddle_tpu.amp.debugging
+nan_inf_abort = [True]          # False: report (log) instead of raising
+nan_inf_skip_ops: set = set()   # op names excluded from the scan
+nan_inf_check_ops: set = set()  # when non-empty, ONLY these ops are scanned
+
+
 def _check_nan_inf(op_name: str, outs: Sequence[Any]) -> None:
     """Debug pass: reference FLAGS_check_nan_inf / nan_inf_utils_detail.cc
-    (SURVEY.md §5.2). Host-side check; only valid outside jit."""
+    (SURVEY.md §5.2). Host-side check; only valid outside jit (for values
+    inside compiled fns use amp.debugging.checkify_wrap)."""
+    if op_name in nan_inf_skip_ops:
+        return
+    if nan_inf_check_ops and op_name not in nan_inf_check_ops:
+        return
     for i, o in enumerate(outs):
         if isinstance(o, jax.core.Tracer):
             return  # under trace: skip (use checkify-style tools instead)
         if jnp.issubdtype(o.dtype, jnp.floating):
             bad = ~jnp.isfinite(o)
             if bool(jnp.any(bad)):
-                raise FloatingPointError(
-                    f"nan/inf detected in output {i} of op '{op_name}'"
-                )
+                msg = f"nan/inf detected in output {i} of op '{op_name}'"
+                if nan_inf_abort[0]:
+                    raise FloatingPointError(msg)
+                import logging
+                logging.getLogger("paddle_tpu.debugging").warning(msg)
+                return

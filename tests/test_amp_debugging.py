@@ -1,0 +1,81 @@
+"""nan/inf debugging: eager check, dispatch flag, checkify in compiled fns
+(SURVEY.md §5.2)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as D
+
+
+def test_check_numerics_eager():
+    ok = paddle.to_tensor(np.ones(4, np.float32))
+    assert D.check_numerics(ok) == (0, 0)
+    bad = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+    with pytest.raises(FloatingPointError, match="1 nan, 1 inf"):
+        D.check_numerics(bad, op_type="test", var_name="x")
+    n_nan, n_inf = D.check_numerics(bad, debug_mode=D.DebugMode.CHECK_NAN_INF)
+    assert (n_nan, n_inf) == (1, 1)
+
+
+def test_dispatch_flag_scan():
+    cfg = D.TensorCheckerConfig(enable=True)
+    D.enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.zeros(3, np.float32), )
+        x.stop_gradient = False
+        with pytest.raises(FloatingPointError):
+            y = paddle.to_tensor(np.zeros(3, np.float32)) / x  # 0/0 -> nan
+    finally:
+        D.disable_tensor_checker()
+
+
+def test_checkify_catches_nan_in_jit():
+    def f(x):
+        return jnp.log(x).sum()
+
+    wrapped = D.checkify_wrap(f)
+    assert float(wrapped(jnp.ones(3))) == 0.0
+    with pytest.raises(FloatingPointError, match="log"):
+        wrapped(jnp.array([-1.0, 1.0]))
+
+
+def test_checkify_catches_inf():
+    def f(x):
+        return (1.0 / x).sum()
+
+    wrapped = D.checkify_wrap(f)
+    with pytest.raises(FloatingPointError):
+        wrapped(jnp.array([0.0, 1.0]))
+
+
+def test_dispatch_flag_scan_no_grad_path():
+    D.enable_tensor_checker(D.TensorCheckerConfig(enable=True))
+    try:
+        a = paddle.to_tensor(np.zeros(3, np.float32))  # stop_gradient=True
+        with pytest.raises(FloatingPointError):
+            a / a
+    finally:
+        D.disable_tensor_checker()
+
+
+def test_report_only_mode_does_not_abort():
+    D.enable_tensor_checker(D.TensorCheckerConfig(
+        enable=True, debug_mode=D.DebugMode.CHECK_NAN_INF))
+    try:
+        a = paddle.to_tensor(np.zeros(3, np.float32))
+        out = a / a  # nan, but report-only: no raise
+        assert np.isnan(np.asarray(out._value)).all()
+    finally:
+        D.disable_tensor_checker()
+
+
+def test_skipped_op_list():
+    D.enable_tensor_checker(D.TensorCheckerConfig(
+        enable=True, skipped_op_list=["divide"]))
+    try:
+        a = paddle.to_tensor(np.zeros(3, np.float32))
+        a / a  # divide skipped: no raise
+    finally:
+        D.disable_tensor_checker()
