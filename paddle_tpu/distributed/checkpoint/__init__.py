@@ -6,3 +6,4 @@ from .save_state_dict import save_state_dict  # noqa: F401
 from .load_state_dict import load_state_dict  # noqa: F401
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
 from .utils import flatten_state_dict, unflatten_state_dict  # noqa: F401
+from .async_save import async_save_state_dict, AsyncSaveFuture, TrainState  # noqa: F401

@@ -1,0 +1,131 @@
+"""Async checkpoint + TrainState capture/restore (SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import (
+    async_save_state_dict, load_state_dict, TrainState,
+)
+
+
+def _net():
+    paddle.seed(21)
+    return nn.Sequential(nn.Linear(3, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_async_save_then_load(tmp_path):
+    net = _net()
+    sd = net.state_dict()
+    fut = async_save_state_dict(sd, str(tmp_path / "ck"))
+    path = fut.result(timeout=60)
+    assert fut.done()
+
+    net2 = _net()
+    # perturb then restore
+    for p in net2.parameters():
+        p.set_value(np.zeros(p.shape, np.float32))
+    target = net2.state_dict()
+    load_state_dict(target, path)
+    net2.set_state_dict(target)
+    for a, b in zip(net.parameters(), net2.parameters()):
+        np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value))
+
+
+def test_async_save_snapshot_isolated_from_mutation(tmp_path):
+    """Mutating params after async_save must not corrupt the checkpoint
+    (the snapshot is taken synchronously)."""
+    net = _net()
+    w0 = np.asarray(net[0].weight._value).copy()
+    fut = async_save_state_dict(net.state_dict(), str(tmp_path / "ck2"))
+    net[0].weight.set_value(np.full_like(w0, 7.0))  # mutate immediately
+    path = fut.result(60)
+    target = _net().state_dict()
+    load_state_dict(target, path)
+    key = [k for k in target if "weight" in k][0]
+    np.testing.assert_allclose(np.asarray(target[key]._value
+                                          if hasattr(target[key], "_value")
+                                          else target[key]), w0)
+
+
+def test_train_state_roundtrip(tmp_path):
+    net = _net()
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2)
+    ts = TrainState(net, opt, sched)
+    # do a couple of steps so optimizer state materialises
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    for _ in range(3):
+        (net(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+        ts.step()
+    ts.next_epoch()
+    ts.step(2)
+    fut = async_save_state_dict(ts.state_dict(), str(tmp_path / "ts"))
+    fut.result(60)
+
+    net2 = _net()
+    opt2 = optimizer.AdamW(learning_rate=1e-2, parameters=net2.parameters())
+    sched2 = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2)
+    ts2 = TrainState(net2, opt2, sched2)
+    target = ts2.state_dict()
+    load_state_dict(target, str(tmp_path / "ts"))
+    ts2.set_state_dict(target)
+    assert ts2.global_step == 5 and ts2.epoch == 1 and ts2.batch_in_epoch == 2
+    for a, b in zip(net.parameters(), net2.parameters()):
+        np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value),
+                                   rtol=1e-6)
+
+
+def test_skip_batches():
+    from paddle_tpu import io
+    ds = io.TensorDataset([np.arange(12, dtype=np.float32).reshape(12, 1)])
+    loader = io.DataLoader(ds, batch_size=2)
+    ts = TrainState()
+    ts.batch_in_epoch = 2
+    it = ts.skip_batches(loader)
+    nxt = np.asarray(next(it))
+    np.testing.assert_array_equal(nxt.ravel(), [4, 5])
+
+
+def test_skip_batches_shuffled_resume():
+    """Mid-epoch resume under shuffle replays the exact permutation."""
+    from paddle_tpu import io
+    ds = io.TensorDataset([np.arange(16, dtype=np.float32).reshape(16, 1)])
+    loader = io.DataLoader(ds, batch_size=4, shuffle=True)
+    loader.batch_sampler.set_epoch(3)
+    seen = [np.asarray(b).ravel().tolist() for b in loader][:2]
+
+    loader2 = io.DataLoader(ds, batch_size=4, shuffle=True)
+    ts = TrainState()
+    ts.epoch, ts.batch_in_epoch = 3, 2
+    it = ts.skip_batches(loader2)
+    third = np.asarray(next(it)).ravel().tolist()
+    # the fresh loader pinned to epoch 3 must continue after `seen`
+    loader3 = io.DataLoader(ds, batch_size=4, shuffle=True)
+    loader3.batch_sampler.set_epoch(3)
+    full3 = [np.asarray(b).ravel().tolist() for b in loader3]
+    assert full3[:2] == seen and full3[2] == third
+
+
+def test_failed_async_save_does_not_wedge(tmp_path, monkeypatch):
+    import paddle_tpu.distributed.checkpoint.async_save as A
+    calls = {"n": 0}
+    real = A.save_state_dict
+
+    def flaky(sd, path, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise IOError("disk full")
+        return real(sd, path, **kw)
+
+    monkeypatch.setattr(A, "save_state_dict", flaky)
+    net = _net()
+    f1 = A.async_save_state_dict(net.state_dict(), str(tmp_path / "a"))
+    with pytest.raises(IOError):
+        f1.result(30)
+    # next save proceeds despite the earlier failure
+    f2 = A.async_save_state_dict(net.state_dict(), str(tmp_path / "b"))
+    assert f2.result(30)
