@@ -117,11 +117,20 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """Epoch-deterministic shuffling: the permutation is a pure function of
+    (seed, epoch), so a mid-epoch resume (TrainState.skip_batches after
+    set_epoch) replays exactly the already-consumed prefix."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
-                 generator=None):
+                 generator=None, seed: int = 0):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
 
     @property
     def num_samples(self):
@@ -129,9 +138,10 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = np.random.RandomState(self.seed + self.epoch)
         if self.replacement:
-            return iter(np.random.randint(0, n, size=self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(rng.randint(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -164,6 +174,10 @@ class BatchSampler(Sampler):
             self.sampler = RandomSampler(dataset)
         else:
             self.sampler = SequenceSampler(dataset)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self):
         batch = []
@@ -267,19 +281,10 @@ def _wrap_collated(tree):
 
 
 def default_collate_fn(batch):
-    sample = batch[0]
-    if isinstance(sample, (np.ndarray, np.generic)):
-        return Tensor(np.stack(batch))
-    if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
-    if isinstance(sample, (int, float)):
-        return Tensor(np.asarray(batch))
-    if isinstance(sample, (list, tuple)):
-        return [default_collate_fn([b[i] for b in batch])
-                for i in range(len(sample))]
-    if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
-    return batch
+    # single recursion shared with the multiprocess path: workers run
+    # numpy_collate_fn, the trainer side wraps — serial mode composes the
+    # same two steps so the two paths cannot drift
+    return _wrap_collated(numpy_collate_fn(batch))
 
 
 # ---------------------------------------------------------------------------
@@ -325,8 +330,9 @@ def _worker_loop(dataset, collate_fn, idx_queue, out_queue, init_fn,
             batch = collate_fn([dataset[i] for i in idxs])
             out_queue.put(("ok", (b, batch)))
         out_queue.put(("done", worker_id))
-    except Exception as e:  # surface the error to the consumer
-        out_queue.put(("err", f"worker {worker_id}: {type(e).__name__}: {e}"))
+    except Exception:  # surface the error WITH its stack to the consumer
+        import traceback
+        out_queue.put(("err", f"worker {worker_id}:\n{traceback.format_exc()}"))
 class DataLoader:
     """Batch loader with optional multiprocess workers.
 
