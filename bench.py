@@ -32,6 +32,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Local smoke runs: JAX_PLATFORMS=cpu must win over the axon
+    # sitecustomize (which overrides the env var programmatically and would
+    # dial the TPU tunnel from jax.devices()).
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     on_tpu = True
     try:
         platform = jax.devices()[0].platform
