@@ -108,6 +108,11 @@ class PipelineParallel(Layer):
 
 class PipelineParallelWithInterleave(PipelineParallel):
     """Interleaved virtual-pipeline schedule (reference: same class name).
-    Same numerics as the base schedule; kept for API parity — the compiled
-    scan path owns the performance story on TPU."""
+
+    Eager path: numerics identical to the base schedule (gradient
+    accumulation commutes), so train_batch is inherited. The *compiled*
+    interleave — the systolic one-chunk-per-tick scan with the v-fold
+    bubble reduction — is parallel/pipeline.py::pipeline_spmd_interleaved;
+    homogeneous decoder stacks should route through it with chunk params
+    pre-permuted by interleave_chunk_order."""
     pass
