@@ -8,3 +8,7 @@ from .mobilenet import (  # noqa: F401
     MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
 )
 from .lenet import LeNet  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_base_patch16_224, vit_large_patch16_224,
+    vit_tiny_test,
+)

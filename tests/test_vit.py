@@ -1,0 +1,44 @@
+"""ViT on fused blocks: shapes, training, feature extraction."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.vision.models import vit_tiny_test, VisionTransformer
+
+
+def test_forward_shapes():
+    paddle.seed(0)
+    m = vit_tiny_test()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 16, 16).astype(np.float32))
+    logits = m(x)
+    assert tuple(logits.shape) == (2, 10)
+    feats = m.forward_features(x)
+    assert tuple(feats.shape) == (2, 1 + 16, 32)  # cls + 4x4 patches
+
+
+def test_feature_only_head():
+    paddle.seed(1)
+    m = vit_tiny_test(class_num=0)
+    x = paddle.to_tensor(np.ones((1, 3, 16, 16), np.float32))
+    out = m(x)
+    assert tuple(out.shape) == (1, 32)
+
+
+def test_training_step():
+    paddle.seed(2)
+    m = vit_tiny_test(depth=1)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(4, 3, 16, 16).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    losses = []
+    for _ in range(6):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
