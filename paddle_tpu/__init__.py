@@ -41,9 +41,6 @@ from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import jit  # noqa: F401
 from . import framework  # noqa: F401
-from . import profiler  # noqa: F401
-from . import inference  # noqa: F401
-from . import static  # noqa: F401
 from .framework.io_save import save, load  # noqa: F401
 
 # subpackages imported lazily by user code: distributed, vision, hapi, parallel,
@@ -53,7 +50,7 @@ from .framework.io_save import save, load  # noqa: F401
 def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
-                "profiler", "models", "inference", "static"):
+                "profiler", "models", "inference", "static", "quantization"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -203,7 +203,7 @@ def forward_stacked(params: Dict[str, Any], ids, config: LlamaConfig):
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, _ = lax.scan(body, x, layer_params)
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
-    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"])
+    return jnp.einsum("bsh,hv->bsv", x, _dense(params["lm_head"]))
 
 
 def loss_stacked(params: Dict[str, Any], ids, labels, config: LlamaConfig):
@@ -271,6 +271,18 @@ def _rms(x, w, eps):
     return rms_norm_array(x, w, eps)
 
 
+def _dense(w):
+    """Materialize a possibly weight-only-quantized weight ({"q","scale"}
+    from paddle_tpu.quantization.quantize_stacked_params) into its dense
+    form. Called inside the per-layer scan body so only ONE layer's weight
+    is dequantized at a time and XLA fuses the multiply into the consuming
+    einsum — int8 storage halves the HBM bytes the decode loop waits on.
+    Dense arrays pass through untouched."""
+    if isinstance(w, dict):
+        return w["q"].astype(jnp.float32) * w["scale"][..., None, :]
+    return w
+
+
 def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
                           fsdp_axis, sep_axis=None):
     """One decoder layer inside shard_map. Weight locals: wq (h, h/mp) etc.
@@ -296,9 +308,9 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
         return w
 
     xn = _rms(x, p["ln1"], config.rms_norm_eps)
-    q = jnp.einsum("bsh,hd->bsd", xn, gather_in(p["wq"]))
-    k = jnp.einsum("bsh,hd->bsd", xn, gather_in(p["wk"]))
-    v = jnp.einsum("bsh,hd->bsd", xn, gather_in(p["wv"]))
+    q = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wq"])))
+    k = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wk"])))
+    v = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wv"])))
     nh_local = q.shape[-1] // d
     nkv_local = k.shape[-1] // d
     q = q.reshape(b, s, nh_local, d)
@@ -322,15 +334,15 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
             attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
                                   tiled=True)
     attn = attn.reshape(b, s, -1)
-    out = jnp.einsum("bsd,dh->bsh", attn, gather_out(p["wo"]))
+    out = jnp.einsum("bsd,dh->bsh", attn, gather_out(_dense(p["wo"])))
     if mp_axis is not None:
         out = lax.psum(out, mp_axis)
     x = x + out
 
     xn = _rms(x, p["ln2"], config.rms_norm_eps)
-    g = jnp.einsum("bsh,hm->bsm", xn, gather_in(p["w_gate"]))
-    u = jnp.einsum("bsh,hm->bsm", xn, gather_in(p["w_up"]))
-    dn = jnp.einsum("bsm,mh->bsh", jax.nn.silu(g) * u, gather_out(p["w_down"]))
+    g = jnp.einsum("bsh,hm->bsm", xn, gather_in(_dense(p["w_gate"])))
+    u = jnp.einsum("bsh,hm->bsm", xn, gather_in(_dense(p["w_up"])))
+    dn = jnp.einsum("bsm,mh->bsh", jax.nn.silu(g) * u, gather_out(_dense(p["w_down"])))
     if mp_axis is not None:
         dn = lax.psum(dn, mp_axis)
     return x + dn
@@ -439,7 +451,7 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             _, out = lax.scan(micro_body, None, x)
 
         out = _rms(out, params["ln_f"], eps)
-        logits = jnp.einsum("mbsh,hv->mbsv", out, params["lm_head"])
+        logits = jnp.einsum("mbsh,hv->mbsv", out, _dense(params["lm_head"]))
         # vocab is replicated over mp here (lm_head spec P(None, 'mp') is
         # sliced by shard_map, so logits are vocab-sharded when mp>1)
         lg = logits.astype(jnp.float32)
@@ -567,9 +579,9 @@ def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
     b, t, h = x.shape
     d = config.head_dim
     xn = _rms(x, lp["ln1"], config.rms_norm_eps)
-    q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, t, -1, d)
-    k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, t, -1, d)
-    v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, t, -1, d)
+    q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
+    k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
+    v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
     q, k = rope_ops.apply_rope_array(q, k, cos, sin)
     start = kv_len - t
     k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
@@ -577,11 +589,11 @@ def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
     v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                        (0, start, 0, 0))
     attn = _cached_attention(q, k_cache, v_cache, kv_len, config)
-    x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), lp["wo"])
+    x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
     xn = _rms(x, lp["ln2"], config.rms_norm_eps)
-    g = jnp.einsum("bth,hm->btm", xn, lp["w_gate"])
-    u = jnp.einsum("bth,hm->btm", xn, lp["w_up"])
-    x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+    g = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_gate"]))
+    u = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_up"]))
+    x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
     return x, k_cache, v_cache
 
 
@@ -607,7 +619,7 @@ def prefill_stacked(params, ids, cache, config: LlamaConfig):
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
-    logits = jnp.einsum("bth,hv->btv", x, params["lm_head"])
+    logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -632,7 +644,7 @@ def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
-    logits = jnp.einsum("bh,hv->bv", x[:, 0], params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -666,17 +678,17 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
         lp, kp, vp = lp_kv
         d = config.head_dim
         xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
-        q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, t, -1, d)
-        k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, t, -1, d)
-        v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, t, -1, d)
+        q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
         q, k = rope_ops.apply_rope_array(q, k, cos, sin)
         # causal attention within the (padded) prompt
         attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
-        xo = xc + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), lp["wo"])
+        xo = xc + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
-        g = jnp.einsum("bth,hm->btm", xn2, lp["w_gate"])
-        u = jnp.einsum("bth,hm->btm", xn2, lp["w_up"])
-        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
+        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
         # scatter this layer's K/V into its pages
         kp = kp.at[phys, page_off].set(k.astype(kp.dtype))
         vp = vp.at[phys, page_off].set(v.astype(vp.dtype))
@@ -685,7 +697,7 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
-    logits = jnp.einsum("bth,hv->btv", x, params["lm_head"])
+    logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
     return logits, k_new, v_new
 
 
@@ -709,24 +721,24 @@ def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
         xc = carry
         lp, kp, vp = lp_kv
         xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
-        q = jnp.einsum("bth,hd->btd", xn, lp["wq"]).reshape(b, 1, -1, d)
-        k = jnp.einsum("bth,hd->btd", xn, lp["wk"]).reshape(b, 1, -1, d)
-        v = jnp.einsum("bth,hd->btd", xn, lp["wv"]).reshape(b, 1, -1, d)
+        q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, 1, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, 1, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, 1, -1, d)
         q2, k2 = rope_ops.apply_rope_array(q, k, cos, sin)  # (B,1,d) 3-D form
         kp, vp = pa.paged_write_array(kp, vp, k2[:, 0], v[:, 0],
                                       block_tables, positions)
         attn = pa.paged_attention_array(q2[:, 0], kp, vp, block_tables,
                                         kv_lens, scale=1.0 / math.sqrt(d))
         xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
-                             lp["wo"])[:, None, :]
+                             _dense(lp["wo"]))[:, None, :]
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
-        g = jnp.einsum("bth,hm->btm", xn2, lp["w_gate"])
-        u = jnp.einsum("bth,hm->btm", xn2, lp["w_up"])
-        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, lp["w_down"])
+        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
+        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
         return xo, (kp, vp)
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
-    logits = jnp.einsum("bh,hv->bv", x[:, 0], params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
     return logits, k_new, v_new

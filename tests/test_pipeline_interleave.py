@@ -91,9 +91,48 @@ def test_interleaved_gradients_match_serial():
                                rtol=2e-3, atol=1e-4)
 
 
-def test_interleaved_beats_filldrain_tick_count():
-    """Structural check: interleave runs M*v + S - 1 chunk-ticks where
-    fill-drain runs (M + S - 1) stage-ticks = (M + S - 1)*v chunk-ticks."""
-    interleave_ticks = M * V + S - 1
-    filldrain_chunk_ticks = (M + S - 1) * V
-    assert interleave_ticks < filldrain_chunk_ticks
+def test_validation_errors():
+    """Shape/microbatch validation (dynamic_index_in_dim would clamp
+    silently, so both must fail fast)."""
+    import pytest
+    mesh, w, b, x = _setup()
+    order = interleave_chunk_order(S, V)
+
+    def run(mb, wl):
+        f = jax.jit(jax.shard_map(
+            lambda wl, bl, m: pipeline_spmd_interleaved(
+                _chunk_fn, {"w": wl, "b": bl}, m, V, axis_name="pp"),
+            mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
+            out_specs=P(), check_vma=False))
+        return f(wl, b[order], mb)
+
+    with pytest.raises(ValueError, match="must divide"):
+        run(x[:M - 1], w[order])          # M not a multiple of S (v>1)
+    with pytest.raises(ValueError, match="leading dim"):
+        run(x, w[order][: S * V - S])     # wrong chunk count per device
+
+
+def test_filldrain_is_v1_special_case():
+    """pipeline_spmd (delegating to the v=1 interleave) still matches the
+    serial oracle for M not divisible by S."""
+    mesh, w, b, x = _setup()
+    M_odd = M - 1  # 7: not divisible by S=4 — allowed at v=1
+
+    def stage_fn(p, xx):
+        # pipeline_spmd hands each stage its locally-sharded leaves, which
+        # keep the per-device leading dim (1 here) — same as the llama
+        # stage_fn, which scans over its local layer dim
+        return _chunk_fn({"w": p["w"][0], "b": p["b"][0]}, xx)
+
+    def fn(wl, bl, mb):
+        from paddle_tpu.parallel.pipeline import last_stage_broadcast
+        out = pipeline_spmd(stage_fn, {"w": wl, "b": bl}, mb, axis_name="pp")
+        return last_stage_broadcast(out, "pp")
+
+    f = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
+        out_specs=P(), check_vma=False))
+    out = np.asarray(f(w[:S], b[:S], x[:M_odd]))
+    ref = np.asarray(_serial(jnp.asarray(w[:S]), jnp.asarray(b[:S]),
+                             jnp.asarray(x[:M_odd])))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
