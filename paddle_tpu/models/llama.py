@@ -279,7 +279,8 @@ def _dense(w):
     einsum — int8 storage halves the HBM bytes the decode loop waits on.
     Dense arrays pass through untouched."""
     if isinstance(w, dict):
-        return w["q"].astype(jnp.float32) * w["scale"][..., None, :]
+        from ..quantization import weight_dequantize
+        return weight_dequantize(w["q"], w["scale"])
     return w
 
 
@@ -351,18 +352,26 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
 def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                             learning_rate: float = 1e-3,
                             remat: bool = True,
-                            seq_shard: bool = False):
+                            seq_shard: bool = False,
+                            virtual_pp: int = 1):
     """Returns (step_fn, init_fn).
 
     step_fn(params, opt_state, batch_ids, batch_labels) ->
         (loss, params, opt_state) — jitted, fully sharded.
 
-    Parallelism inside: dp (batch), pp (fill-drain ppermute pipeline), mp
-    (Megatron collectives), sharding (ZeRO-3 weight sharding with per-layer
-    all_gather), and — with ``seq_shard=True`` and a ``sep`` mesh axis —
-    Ulysses context parallelism (activations sequence-sharded; all_to_all
-    head/seq repartition around attention).
+    Parallelism inside: dp (batch), pp (ppermute pipeline: fill-drain, or
+    the interleaved virtual-pipeline schedule when ``virtual_pp > 1`` —
+    each pp stage holds virtual_pp strided layer chunks, cutting the
+    bubble by that factor), mp (Megatron collectives), sharding (ZeRO-3
+    weight sharding with per-layer all_gather), and — with
+    ``seq_shard=True`` and a ``sep`` mesh axis — Ulysses context
+    parallelism (activations sequence-sharded; all_to_all head/seq
+    repartition around attention).
     Optimizer: fused AdamW (state sharded like the weights).
+
+    Note: with virtual_pp > 1 the stacked layer arrays are stored in the
+    interleave-permuted order (init_fn applies it); checkpoints of these
+    params carry that layout.
     """
     from ..parallel import pipeline as ppipe
 
@@ -395,7 +404,22 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     specs = stacked_param_specs(config)
     eps = config.rms_norm_eps
 
-    assert config.num_hidden_layers % pp == 0
+    vpp = max(int(virtual_pp), 1)
+    if vpp > 1 and pp <= 1:
+        raise ValueError("virtual_pp > 1 requires a pp mesh axis of size > 1")
+    if config.num_hidden_layers % (pp * vpp):
+        raise ValueError(
+            f"num_hidden_layers {config.num_hidden_layers} must divide by "
+            f"pp*virtual_pp = {pp * vpp}")
+    layers_per_chunk = config.num_hidden_layers // (pp * vpp)
+    if vpp > 1:
+        # storage order: device-contiguous blocks hold strided model chunks
+        layer_order = np.asarray(
+            [c * layers_per_chunk + r
+             for c in ppipe.interleave_chunk_order(pp, vpp)
+             for r in range(layers_per_chunk)])
+    else:
+        layer_order = None
 
     def spmd_loss(params, ids, labels):
         """Runs per-device inside shard_map. ids/labels: (M, mb_local, S_local)."""
@@ -440,10 +464,18 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
         x = embed(ids)  # (M, mb, S, h)
 
         if pp > 1:
-            def pp_stage(sp, a):
-                return stage_fn(sp, a)
-            out = ppipe.pipeline_spmd(
-                pp_stage, {k: params[k] for k in LAYER_KEYS}, x, axis_name="pp")
+            local = {k: params[k] for k in LAYER_KEYS}
+            if vpp > 1:
+                # local leaves: (L/pp, ...) -> (vpp, layers_per_chunk, ...);
+                # stage_fn scans whatever layer dim it receives, so it IS
+                # the chunk function
+                chunks = jax.tree_util.tree_map(
+                    lambda a: a.reshape((vpp, layers_per_chunk) + a.shape[1:]),
+                    local)
+                out = ppipe.pipeline_spmd_interleaved(
+                    stage_fn, chunks, x, vpp, axis_name="pp")
+            else:
+                out = ppipe.pipeline_spmd(stage_fn, local, x, axis_name="pp")
             out = ppipe.last_stage_broadcast(out, "pp")
         else:
             def micro_body(_, xm):
@@ -486,6 +518,9 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
     def init_fn(seed: int = 0):
         params = init_stacked_params(config, seed)
+        if layer_order is not None:
+            params = {k: (v[layer_order] if k in LAYER_KEYS else v)
+                      for k, v in params.items()}
         params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
                   for k, v in params.items()}
         opt_state = {

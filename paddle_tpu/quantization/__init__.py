@@ -51,8 +51,11 @@ def weight_quantize(w, algo: str = "weight_only_int8"):
 
 
 def weight_dequantize(q, scale, algo: str = "weight_only_int8"):
+    """Inverse of weight_quantize. Accepts stacked layouts too: q
+    (..., in, out) with scale (..., out) — the broadcast keeps per-layer
+    scales aligned (quantize_stacked_params format)."""
     if algo == "weight_only_int8":
-        return q.astype(jnp.float32) * scale[None, :]
+        return q.astype(jnp.float32) * scale[..., None, :]
     if algo == "weight_only_int4":
         u = q.astype(jnp.uint8)
         lo = (u & 0x0F).astype(jnp.int8)
@@ -61,7 +64,7 @@ def weight_dequantize(q, scale, algo: str = "weight_only_int8"):
         lo = jnp.where(lo > 7, lo - 16, lo)
         hi = jnp.where(hi > 7, hi - 16, hi)
         full = jnp.stack([lo, hi], axis=1).reshape((-1,) + q.shape[1:])
-        return full.astype(jnp.float32) * scale[None, :]
+        return full.astype(jnp.float32) * scale[..., None, :]
     raise ValueError(f"unknown algo {algo!r}")
 
 
@@ -122,8 +125,7 @@ class WeightOnlyLinear(Layer):
         qcls = cls(int(w.shape[0]), int(w.shape[1]),
                    weight_dtype=weight_dtype,
                    has_bias=linear.bias is not None)
-        algo = ("weight_only_int8" if weight_dtype == "int8"
-                else "weight_only_int4")
+        algo = _ALGOS[weight_dtype]  # cls() above validated the name
         q, s = weight_quantize(w, algo)
         qcls.weight._value = q
         qcls.weight_scale._value = s

@@ -102,3 +102,23 @@ def test_unknown_weight_dtype_raises():
         weight_only_linear(x, q, s, weight_dtype="bf16")
     with pytest.raises(ValueError, match="even in_features"):
         WeightOnlyLinear(65, 8, weight_dtype="int4")
+
+
+def test_from_linear_accepts_long_alias():
+    paddle.seed(6)
+    lin = nn.Linear(16, 8)
+    q = WeightOnlyLinear.from_linear(lin, weight_dtype="weight_only_int8")
+    x = paddle.to_tensor(np.ones((2, 16), np.float32))
+    ref = np.asarray(lin(x)._value)
+    out = np.asarray(q(x)._value)
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.02
+
+
+def test_stacked_scale_dequant_broadcast():
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=0)
+    qp = quantize_stacked_params(params)
+    # stacked (L, in, out) with (L, out) scales dequantizes in one call
+    wd = np.asarray(weight_dequantize(qp["wq"]["q"], qp["wq"]["scale"]))
+    assert wd.shape == params["wq"].shape
