@@ -16,3 +16,4 @@ from . import recompute as _recompute_mod  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad  # noqa: F401
 from . import utils  # noqa: F401
+from . import meta_optimizers  # noqa: F401

@@ -1,0 +1,59 @@
+"""Strategy-driven meta-optimizers — parity shims.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ — static-graph
+rewrite passes (AMP, recompute, sharding, pipeline, ...) selected by
+DistributedStrategy flags (SURVEY.md §2.5, marked design-level for the
+rebuild: jax has no separate static graph to rewrite — the same strategy
+flags configure *transform composition* instead).
+
+Each class here keeps the reference's name and constructor and delegates
+to the dygraph/TPU-native mechanism, so strategy-driven code paths
+(fleet.distributed_optimizer dispatch) resolve the same way.
+"""
+
+from __future__ import annotations
+
+
+class _DelegatingMetaOptimizer:
+    """Wraps an inner optimizer; subclasses attach their transform."""
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+
+class AMPOptimizer(_DelegatingMetaOptimizer):
+    """amp strategy → paddle_tpu.amp.decorate / auto_cast (bf16-first)."""
+
+
+class RecomputeOptimizer(_DelegatingMetaOptimizer):
+    """recompute strategy → fleet.recompute (jax.checkpoint policies)."""
+
+
+class ShardingOptimizer(_DelegatingMetaOptimizer):
+    """sharding strategy → DygraphShardingOptimizer / group_sharded APIs."""
+
+
+class PipelineOptimizer(_DelegatingMetaOptimizer):
+    """pipeline strategy → meta_parallel.PipelineParallel engines."""
+
+
+class GradientMergeOptimizer(_DelegatingMetaOptimizer):
+    """gradient merge → microbatch accumulation in PipelineParallel /
+    MixPrecisionLayer main_grad accumulation."""
+
+
+class LambOptimizer(_DelegatingMetaOptimizer):
+    """lamb strategy → paddle_tpu.optimizer.Lamb."""
+
+
+class LocalSGDOptimizer(_DelegatingMetaOptimizer):
+    """localsgd: periodic parameter averaging over dp — host-side loop
+    calling distributed.all_reduce on params every k steps."""
+
+
+class DGCOptimizer(_DelegatingMetaOptimizer):
+    """deep gradient compression: not applicable on ICI (collectives are
+    compiler-scheduled); kept for strategy-surface parity."""
