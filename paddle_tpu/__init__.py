@@ -50,7 +50,8 @@ from .framework.io_save import save, load  # noqa: F401
 def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
-                "profiler", "models", "inference", "static", "quantization"):
+                "profiler", "models", "inference", "static", "quantization",
+                "linalg"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -21,6 +21,8 @@ class _DelegatingMetaOptimizer:
         self.inner_opt = optimizer
 
     def __getattr__(self, item):
+        if item == "inner_opt":  # not yet set (unpickling) → no recursion
+            raise AttributeError(item)
         return getattr(self.inner_opt, item)
 
 

@@ -1,0 +1,97 @@
+"""paddle.linalg parity: numpy-oracle checks (SURVEY.md §4 op-test style)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import linalg
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_basic_decompositions():
+    rng = np.random.RandomState(0)
+    a = rng.randn(5, 5).astype(np.float32)
+    spd = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+
+    l = np.asarray(linalg.cholesky(_t(spd))._value)
+    np.testing.assert_allclose(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+
+    q, r = linalg.qr(_t(a))
+    np.testing.assert_allclose(np.asarray(q._value) @ np.asarray(r._value),
+                               a, rtol=1e-4, atol=1e-4)
+
+    u, s, vt = linalg.svd(_t(a))
+    rec = np.asarray(u._value) @ np.diag(np.asarray(s._value)) @ np.asarray(vt._value)
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+
+
+def test_solve_and_inverse():
+    rng = np.random.RandomState(1)
+    a = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    x = np.asarray(linalg.solve(_t(a), _t(b))._value)
+    np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-4)
+    inv = np.asarray(linalg.inv(_t(a))._value)
+    np.testing.assert_allclose(inv, np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+
+def test_norm_det_eigh():
+    rng = np.random.RandomState(2)
+    a = rng.randn(3, 3).astype(np.float32)
+    sym = (a + a.T) / 2
+    np.testing.assert_allclose(float(linalg.det(_t(a))), np.linalg.det(a),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        float(linalg.norm(_t(a))), np.linalg.norm(a), rtol=1e-5)
+    w, v = linalg.eigh(_t(sym))
+    np.testing.assert_allclose(np.sort(np.asarray(w._value)),
+                               np.sort(np.linalg.eigh(sym)[0]), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_norm_grad_flows():
+    x = _t(np.ones((3, 3)))
+    x.stop_gradient = False
+    linalg.norm(x).backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.ones((3, 3)) / 3.0, rtol=1e-5)
+
+
+def test_norm_flattened_semantics():
+    # paddle p=2 on a matrix = flattened vector 2-norm, not spectral norm
+    eye = np.eye(2, dtype=np.float32)
+    assert abs(float(linalg.norm(_t(eye), p=2)) - np.sqrt(2)) < 1e-5
+
+
+def test_qr_mode_r():
+    rng = np.random.RandomState(3)
+    a = rng.randn(4, 4).astype(np.float32)
+    r = linalg.qr(_t(a), mode="r")
+    assert tuple(r.shape) == (4, 4)
+    np.testing.assert_allclose(np.asarray(r._value), np.triu(np.asarray(r._value)),
+                               atol=1e-5)
+
+
+def test_eigh_uplo():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 3).astype(np.float32)
+    wl, _ = linalg.eigh(_t(a), UPLO="L")
+    wu, _ = linalg.eigh(_t(a), UPLO="U")
+    low = np.tril(a) + np.tril(a, -1).T
+    up = np.triu(a) + np.triu(a, 1).T
+    np.testing.assert_allclose(np.sort(np.asarray(wl._value)),
+                               np.sort(np.linalg.eigvalsh(low)), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.sort(np.asarray(wu._value)),
+                               np.sort(np.linalg.eigvalsh(up)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_matrix_rank_absolute_tol():
+    d = np.diag([100.0, 1.0, 1e-4]).astype(np.float32)
+    assert int(linalg.matrix_rank(_t(d), tol=1e-3)._value) == 2
+    assert int(linalg.matrix_rank(_t(d))._value) == 3  # default eps-based

@@ -1,0 +1,39 @@
+"""Host-offload utilities (SURVEY.md §2.7 #11) — portable CPU-path tests;
+the pinned_host memory-kind path engages on real TPU."""
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.core import offload
+
+
+def test_offload_reload_roundtrip():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    ref = np.asarray(t._value).copy()
+    offload.offload_to_host(t)
+    out = offload.reload_to_device(t)
+    assert isinstance(out._value, jax.Array)
+    np.testing.assert_array_equal(np.asarray(out._value), ref)
+
+
+def test_offload_plain_array():
+    x = jax.numpy.ones((4,))
+    host = offload.offload_to_host(x)
+    back = offload.reload_to_device(host)
+    assert isinstance(back, jax.Array)
+    np.testing.assert_array_equal(np.asarray(back), np.ones(4))
+
+
+def test_offload_checkpoint_policy_usable():
+    policy = offload.offload_checkpoint_policy()
+    import jax.numpy as jnp
+
+    import functools
+
+    @functools.partial(jax.checkpoint, policy=policy)
+    def f(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    g = jax.grad(f)(jnp.ones((4, 4)), jnp.ones((2, 4)))
+    assert g.shape == (4, 4)

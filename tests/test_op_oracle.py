@@ -1,0 +1,103 @@
+"""OpTest-style numpy-oracle sweep (SURVEY.md §4: the reference's universal
+op-test pattern — declarative op + inputs + numpy reference, checked for
+forward values and, where marked, analytic-vs-numeric gradients)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(7)
+
+# (name, paddle_fn(tensors...), numpy_fn(arrays...), input shapes, grad?)
+CASES = [
+    ("add", lambda a, b: a + b, lambda a, b: a + b, [(3, 4), (3, 4)], True),
+    ("sub", lambda a, b: a - b, lambda a, b: a - b, [(3, 4), (3, 4)], True),
+    ("mul", lambda a, b: a * b, lambda a, b: a * b, [(3, 4), (3, 4)], True),
+    ("div", lambda a, b: a / b, lambda a, b: a / b, [(3, 4), (3, 4)], True),
+    ("broadcast_add", lambda a, b: a + b, lambda a, b: a + b,
+     [(3, 4), (4,)], True),
+    ("pow", lambda a, b: a ** 2.0, lambda a, b: a ** 2.0,
+     [(3, 3), (1,)], True),
+    ("exp", lambda a: a.exp(), np.exp, [(4, 4)], True),
+    ("log", lambda a: (a.abs() + 1.0).log(),
+     lambda a: np.log(np.abs(a) + 1.0), [(4, 4)], True),
+    ("sqrt", lambda a: a.abs().sqrt(), lambda a: np.sqrt(np.abs(a)),
+     [(5,)], False),
+    ("tanh", lambda a: a.tanh(), np.tanh, [(4, 4)], True),
+    ("sigmoid", lambda a: paddle.nn.functional.sigmoid(a),
+     lambda a: 1 / (1 + np.exp(-a)), [(4, 4)], True),
+    ("relu", lambda a: paddle.nn.functional.relu(a),
+     lambda a: np.maximum(a, 0), [(4, 4)], False),
+    ("mean", lambda a: a.mean(), np.mean, [(6, 2)], True),
+    ("sum_axis", lambda a: a.sum(axis=1), lambda a: a.sum(axis=1),
+     [(3, 5)], True),
+    ("max_axis", lambda a: a.max(axis=0), lambda a: a.max(axis=0),
+     [(4, 3)], False),
+    ("min", lambda a: a.min(), np.min, [(7,)], False),
+    ("prod", lambda a: a.prod(), np.prod, [(5,)], False),
+    ("matmul", lambda a, b: paddle.matmul(a, b), lambda a, b: a @ b,
+     [(3, 4), (4, 5)], True),
+    ("transpose", lambda a: a.transpose([1, 0]), lambda a: a.T,
+     [(3, 4)], False),
+    ("reshape", lambda a: a.reshape([2, 6]), lambda a: a.reshape(2, 6),
+     [(3, 4)], False),
+    ("concat", lambda a, b: paddle.concat([a, b], axis=0),
+     lambda a, b: np.concatenate([a, b], 0), [(2, 3), (4, 3)], False),
+    ("clip", lambda a: paddle.clip(a, -0.5, 0.5),
+     lambda a: np.clip(a, -0.5, 0.5), [(4, 4)], False),
+    ("abs", lambda a: a.abs(), np.abs, [(4, 4)], False),
+    ("cumsum", lambda a: paddle.cumsum(a, axis=0),
+     lambda a: np.cumsum(a, axis=0), [(4, 3)], False),
+    ("tril", lambda a: paddle.tril(a), np.tril, [(4, 4)], False),
+    ("softmax", lambda a: paddle.nn.functional.softmax(a, axis=-1),
+     lambda a: np.exp(a - a.max(-1, keepdims=True)) /
+     np.exp(a - a.max(-1, keepdims=True)).sum(-1, keepdims=True),
+     [(3, 5)], True),
+    ("stack", lambda a, b: paddle.stack([a, b], axis=0),
+     lambda a, b: np.stack([a, b], 0), [(2, 3), (2, 3)], False),
+    ("where", lambda a, b: paddle.where(a > 0, a, b),
+     lambda a, b: np.where(a > 0, a, b), [(4, 4), (4, 4)], False),
+    ("topk_values", lambda a: paddle.topk(a, k=2)[0],
+     lambda a: np.sort(a, axis=-1)[..., ::-1][..., :2], [(3, 6)], False),
+    ("maximum", lambda a, b: paddle.maximum(a, b), np.maximum,
+     [(3, 3), (3, 3)], False),
+]
+
+
+def _inputs(shapes):
+    return [RNG.randn(*s).astype(np.float32) + 0.1 for s in shapes]
+
+
+@pytest.mark.parametrize("name,pfn,nfn,shapes,check_grad",
+                         CASES, ids=[c[0] for c in CASES])
+def test_op_oracle(name, pfn, nfn, shapes, check_grad):
+    arrays = _inputs(shapes)
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = pfn(*tensors)
+    ref = nfn(*arrays)
+    np.testing.assert_allclose(np.asarray(out._value), ref,
+                               rtol=1e-4, atol=1e-5, err_msg=name)
+    if not check_grad:
+        return
+    # analytic grad of sum(out) vs central finite differences on input 0
+    for t in tensors:
+        t.stop_gradient = False
+    out2 = pfn(*tensors)
+    s = out2.sum() if hasattr(out2, "sum") else out2
+    s.backward()
+    g = np.asarray(tensors[0].grad._value)
+    eps = 1e-3
+    a0 = arrays[0]
+    num = np.zeros_like(a0)
+    flat = a0.reshape(-1)
+    for i in range(min(flat.size, 8)):  # spot-check 8 coordinates
+        idx = np.unravel_index(i, a0.shape)
+        ap, am = a0.copy(), a0.copy()
+        ap[idx] += eps
+        am[idx] -= eps
+        fp = nfn(ap, *arrays[1:]).sum()
+        fm = nfn(am, *arrays[1:]).sum()
+        num[idx] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(g[idx], num[idx], rtol=5e-2, atol=5e-3,
+                                   err_msg=f"{name} grad @ {idx}")
