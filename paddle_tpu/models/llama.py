@@ -762,8 +762,8 @@ def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
         q2, k2 = rope_ops.apply_rope_array(q, k, cos, sin)  # (B,1,d) 3-D form
         kp, vp = pa.paged_write_array(kp, vp, k2[:, 0], v[:, 0],
                                       block_tables, positions)
-        attn = pa.paged_attention_array(q2[:, 0], kp, vp, block_tables,
-                                        kv_lens, scale=1.0 / math.sqrt(d))
+        attn = pa.paged_attention(q2[:, 0], kp, vp, block_tables,
+                                  kv_lens, scale=1.0 / math.sqrt(d))
         xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
                              _dense(lp["wo"]))[:, None, :]
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)

@@ -8,21 +8,30 @@ so ragged batches don't reserve max_len × batch HBM and finished sequences
 return pages to the pool immediately (vLLM-style, and the layout of the
 TPU ragged-paged-attention kernels referenced in PAPERS.md).
 
-Compute path: a gather of the sequence's pages + masked softmax attention,
-expressed so XLA fuses the gather into the attention einsums. A dedicated
-Pallas kernel (double-buffered page fetch into VMEM) is the next perf step;
-the op signature already matches what that kernel needs (pages, block
-table, lengths), so swapping it in is local to this file.
+Two compute paths behind one dispatcher (:func:`paged_attention`):
+
+* XLA fallback — gather of the sequence's pages + masked softmax, fused by
+  XLA; runs everywhere (CPU tests included).
+* Pallas kernel (:func:`paged_attention_pallas`) — the block table rides
+  scalar prefetch, each grid step streams exactly ONE physical page
+  HBM→VMEM (Mosaic double-buffers consecutive steps), online-softmax
+  accumulation in VMEM scratch. HBM traffic is precisely the pages each
+  sequence owns — the point of paging on a bandwidth-bound decode.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # additive mask fill AND m_ref init — must stay identical
 
 
 # ---------------------------------------------------------------------------
@@ -59,7 +68,7 @@ def paged_attention_array(q, k_pages, v_pages, block_tables, seq_lens,
     scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * s
     mask = jnp.arange(max_pages * page)[None, :] < seq_lens[:, None]
-    scores = jnp.where(mask[:, None, :], scores, -1e30)
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)
 
@@ -160,3 +169,123 @@ class PagedKVCacheManager:
             bt[i, :len(t)] = t
         lens = np.asarray([self._lens[s] for s in seq_ids], np.int32)
         return bt, lens
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel (TPU): double-buffered page fetch via scalar-prefetched
+# block tables — the ragged-paged-attention pattern (PAPERS.md)
+# ---------------------------------------------------------------------------
+def _paged_decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, page: int,
+                         n_pages: int, scale: float, nh: int, nkv: int,
+                         d: int):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = seq_lens_ref[b]
+    # skip pages entirely beyond this sequence's length
+    run = j * page < seq_len
+
+    @pl.when(run)
+    def _compute():
+        rep = nh // nkv
+        q = q_ref[0].astype(jnp.float32)            # (nh, d)
+        k = k_ref[0].astype(jnp.float32)            # (page, nkv, d)
+        v = v_ref[0].astype(jnp.float32)
+        qg = q.reshape(nkv, rep, d)
+        # (nkv, rep, d) x (page, nkv, d) -> (nkv, rep, page)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (nkv, rep, page), 2)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        s2 = s.reshape(nh, page)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)                     # (nh, page)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        pg = p.reshape(nkv, rep, page)
+        # (nkv, rep, page) x (page, nkv, d) -> (nkv, rep, d)
+        pv = jax.lax.dot_general(
+            pg, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv.reshape(nh, d)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Pallas decode kernel: same contract as paged_attention_array.
+
+    Each grid step fetches ONE physical page via the scalar-prefetched
+    block table (Mosaic double-buffers the HBM→VMEM stream), so HBM
+    traffic is exactly the pages each sequence owns — the fused
+    gather+softmax the XLA fallback approximates.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, seq_lens
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, nh, d), lambda bi, j, bt, sl: (bi, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda bi, j, bt, sl: (bt[bi, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda bi, j, bt, sl: (bt[bi, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d), lambda bi, j, bt, sl: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, n_pages=max_pages, scale=s,
+        nh=nh, nkv=nkv, d=d)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, d), v_pages.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                    scale: Optional[float] = None):
+    """Dispatcher: Pallas kernel on TPU (FLAGS_use_pallas_kernels), XLA
+    gather fallback elsewhere. Same contract as paged_attention_array."""
+    from ._common import use_pallas
+    if use_pallas():
+        return paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                      seq_lens, scale)
+    return paged_attention_array(q, k_pages, v_pages, block_tables,
+                                 seq_lens, scale)
