@@ -133,3 +133,60 @@ def moe_dispatch(x, dispatch_mask):
 def moe_combine(expert_out, combine_mask):
     """(E,C,d),(N,E,C) -> (N,d): global_gather equivalent."""
     return jnp.einsum("nec,ecd->nd", combine_mask, expert_out)
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel execution inside shard_map (the ragged alltoall of
+# global_scatter/global_gather over an ICI 'expert' axis — SURVEY §2.4 EP)
+# ---------------------------------------------------------------------------
+def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
+                        axis_name: str, num_experts: int, capacity: int,
+                        topk: int = 1, act=None):
+    """Run a MoE FFN with experts sharded over ``axis_name``.
+
+    Call inside shard_map. Per device: T_local tokens, E_local =
+    num_experts/n experts (w1_local (E_local, d, ff), w2_local
+    (E_local, ff, d)); gating is over ALL experts (gate weights
+    replicated → gate_logits_local (T_local, num_experts)).
+
+    Data path (the reference's global_scatter → expert → global_gather,
+    SURVEY §3.2 MoE):
+      local dispatch (T_local, E, C) → (E, C, d)
+      all_to_all over the expert axis → (E_local, n·C, d) per device
+      local expert FFN
+      inverse all_to_all → local combine back to (T_local, d)
+    """
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    if num_experts % n:
+        raise ValueError(f"num_experts {num_experts} must be divisible by "
+                         f"'{axis_name}' axis size {n}")
+    e_local = num_experts // n
+    if act is None:
+        act = jax.nn.gelu
+
+    probs = jax.nn.softmax(gate_logits_local.astype(jnp.float32), axis=-1)
+    if topk == 1:
+        gate_idx = jnp.argmax(probs, axis=-1)[:, None]       # (T, 1)
+        gate_prob = jnp.take_along_axis(probs, gate_idx, axis=-1)
+    else:
+        gate_prob, gate_idx = lax.top_k(probs, topk)
+    disp, comb = dispatch_combine_topk(gate_idx, gate_prob, num_experts,
+                                       capacity)
+    slots = moe_dispatch(x_local, disp)                      # (E, C, d)
+
+    d_model = x_local.shape[-1]
+    z = slots.reshape(n, e_local, capacity, d_model)
+    # chunk i (this device's dispatch FOR expert-group i) goes to device i;
+    # received leading dim then indexes the SOURCE device
+    z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0)
+    z = jnp.swapaxes(z, 0, 1).reshape(e_local, n * capacity, d_model)
+
+    h = act(jnp.einsum("ecd,edf->ecf", z, w1_local))
+    y = jnp.einsum("ecf,efd->ecd", h, w2_local)              # (E_local, nC, d)
+
+    y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    y = y.reshape(num_experts, capacity, d_model)
+    return moe_combine(y, comb)
