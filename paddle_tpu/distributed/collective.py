@@ -148,6 +148,20 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return out
 
 
+def all_reduce_replicated(value, op=ReduceOp.SUM,
+                          group: Optional[Group] = None):
+    """Reduce a REPLICATED array over the group: every device contributes
+    its (identical, under one controller) copy — result = nranks * value for
+    sum. This is the per-rank-tensor all_reduce without the dim-0 slab view;
+    flat fused-grad buffers need it because their dim 0 packs many params
+    and must not be sharded."""
+    g = get_group(group)
+    v = _unwrap(value)
+    if g.nranks == 1:
+        return v
+    return _cached_program(g.mesh, g.axis, "all_reduce", False, False, op)(v)
+
+
 def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
                sync_op=True):
     """Two calling conventions (paddle): all_gather(list, tensor) fills the

@@ -71,9 +71,24 @@ class P2PTask:
         return True
 
 
+def _check_single_process(what: str) -> None:
+    """Eager p2p rendezvouses through an in-process mailbox; across OS
+    processes (launch CLI / spawn, each with its own mailbox) it would hang
+    until timeout. Fail fast with a pointer at the in-graph path instead."""
+    from .. import env
+
+    if env.get_world_size() > 1:
+        raise RuntimeError(
+            f"eager {what} is single-process only (the mailbox does not "
+            "cross process boundaries). In multi-process launches use "
+            "in-graph p2p: lax.ppermute over a mesh axis / "
+            "batch_isend_irecv with matched pairs / the pipeline engine.")
+
+
 def send(tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True, tag: int = 0):
     from ..collective import get_rank
+    _check_single_process("send")
     _mailbox.put((get_rank(), dst, tag), _unwrap(tensor))
     return P2PTask()
 
@@ -81,6 +96,7 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None,
 def recv(tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True, tag: int = 0):
     from ..collective import get_rank
+    _check_single_process("recv")
     val = _mailbox.take((src, get_rank(), tag))
     if isinstance(tensor, Tensor):
         tensor._value = jax.numpy.asarray(val).reshape(tensor._value.shape) \

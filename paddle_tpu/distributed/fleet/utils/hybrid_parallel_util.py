@@ -42,6 +42,11 @@ def fused_allreduce_gradients(parameter_list: Sequence, hcg=None,
         for p in buf._params:
             buf.add_grad(p)
         buf.comm()
+        if scale is not None:
+            # dp averaging (reference divides the reduced grads by the dp
+            # degree); done on the flat buffer before scatter so each param
+            # slice is written back exactly once.
+            buf.buffer = buf.buffer / scale
         buf.scatter_grads()
 
 
@@ -71,4 +76,7 @@ def sharding_reduce_gradients(parameter_list: Sequence, hcg) -> None:
     group = hcg.get_sharding_parallel_group() if hcg is not None else None
     if group is None or getattr(group, "nranks", 1) <= 1:
         return
-    fused_allreduce_gradients(parameter_list, group=group)
+    # comm() psums replicated copies (nranks * g under one controller);
+    # scale by the group size so the written-back grads stay the dp average.
+    fused_allreduce_gradients(parameter_list, group=group,
+                              scale=float(group.nranks))

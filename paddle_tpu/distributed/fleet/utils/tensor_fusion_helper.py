@@ -66,16 +66,22 @@ class FusedCommBuffer:
         return not self._pending
 
     def comm(self, collective_fn: Optional[Callable] = None) -> None:
-        """Run the bucketed collective on the flat buffer (default: dp
-        all_reduce through paddle_tpu.distributed.all_reduce)."""
+        """Run the bucketed collective on the flat buffer.
+
+        The buffer packs many params along dim 0, so the slab-view
+        ``all_reduce`` (which shards dim 0 per rank) must NOT be used — it
+        would sum different params' slices together. The default reduces
+        with replicated semantics: every device holds the whole buffer and
+        contributes it to a psum (result = nranks * buffer under one
+        controller, matching the reference where identical per-rank grads
+        sum to nranks·g; callers divide by the dp degree via ``scale``).
+        """
         assert self.all_grads_added, "bucket incomplete"
-        if collective_fn is None:
-            from ... import collective as C
-            t = Tensor(self.buffer)
-            C.all_reduce(t, group=self._group)
-            self.buffer = t._value
-        else:
+        if collective_fn is not None:
             self.buffer = collective_fn(self.buffer)
+            return
+        from ... import collective as C
+        self.buffer = C.all_reduce_replicated(self.buffer, group=self._group)
 
     def scatter_grads(self) -> None:
         """Write reduced slices back into each param's grad/main_grad."""

@@ -118,7 +118,10 @@ class GenerationEngine:
             (last, cache, _), toks = jax.lax.scan(
                 step, (tok, cache, key), jnp.arange(max_new - 1))
             toks = jnp.concatenate([toks, last[None]], axis=0)  # (max_new, B)
-            return jnp.swapaxes(toks, 0, 1)                     # (B, max_new)
+            # Return the final cache so the donated input cache buffers are
+            # actually aliasable (donating without returning produced
+            # "donated buffers were not usable" warnings and saved nothing).
+            return jnp.swapaxes(toks, 0, 1), cache              # (B, max_new)
 
         return jax.jit(run, donate_argnums=(3,))
 
@@ -144,8 +147,8 @@ class GenerationEngine:
             cache = cache.tree
         prompt_len = jnp.full((b,), t, jnp.int32)
         rng = jax.random.key(cfg.seed)
-        out = self._compiled[key](params, jnp.asarray(padded), prompt_len,
-                                  cache, rng)
+        out, _ = self._compiled[key](params, jnp.asarray(padded), prompt_len,
+                                     cache, rng)
         return np.asarray(out)
 
 

@@ -10,10 +10,22 @@ import jax.numpy as jnp
 from ..flags import flag_value
 
 
+# Platform strings that are NOT a TPU. The axon PJRT plugin registers the
+# real chip under platform "axon" (xla_bridge warns "Platform 'axon' is
+# experimental"), so membership is tested negatively: any accelerator that
+# is not a CPU/GPU-family backend is treated as a TPU for kernel selection.
+_NON_TPU_PLATFORMS = ("cpu", "gpu", "cuda", "rocm", "metal", "interpreter")
+
+
+def is_tpu_platform(platform: str) -> bool:
+    """Single source of the platform policy (bench.py reuses it)."""
+    return platform not in _NON_TPU_PLATFORMS
+
+
 @functools.lru_cache(maxsize=1)
 def on_tpu() -> bool:
     try:
-        return jax.devices()[0].platform == "tpu"
+        return is_tpu_platform(jax.devices()[0].platform)
     except Exception:
         return False
 

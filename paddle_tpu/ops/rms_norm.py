@@ -46,7 +46,10 @@ def _fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
     inv_ref[...] = jnp.broadcast_to(inv, inv_ref.shape)
 
 
-def _bwd_kernel(x_ref, w_ref, inv_ref, g_ref, dx_ref, dwp_ref):
+def _bwd_kernel(x_ref, w_ref, inv_ref, g_ref, dx_ref, dw_ref):
+    # dw is a (1, h) accumulator revisited by every grid step (TPU grid is
+    # sequential): Mosaic rejects a (1, h) block into an (nb, h) array
+    # (row-block 1 < 8), but a block equal to the whole array is legal.
     x = x_ref[...].astype(jnp.float32)
     w = w_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
@@ -56,7 +59,12 @@ def _bwd_kernel(x_ref, w_ref, inv_ref, g_ref, dx_ref, dwp_ref):
     dot = jnp.sum(wg * x, axis=-1, keepdims=True)
     dx = inv * wg - x * (inv ** 3) * (dot / h)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    dwp_ref[...] = jnp.sum(g * x * inv, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[...] += jnp.sum(g * x * inv, axis=0, keepdims=True)
 
 
 def _pick_block_rows(rows: int) -> int:
@@ -66,13 +74,14 @@ def _pick_block_rows(rows: int) -> int:
     return 0
 
 
-def _pallas_fwd(x2, w, eps):
+def _pallas_fwd(x2, w, eps, interpret=False):
     rows, h = x2.shape
     br = _pick_block_rows(rows)
     grid = (rows // br,)
     y, inv = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=grid,
+        interpret=interpret,
         in_specs=[
             pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
@@ -89,13 +98,14 @@ def _pallas_fwd(x2, w, eps):
     return y, inv
 
 
-def _pallas_bwd(x2, w, inv, g2):
+def _pallas_bwd(x2, w, inv, g2, interpret=False):
     rows, h = x2.shape
     br = _pick_block_rows(rows)
     nb = rows // br
     dx, dw_part = pl.pallas_call(
         _bwd_kernel,
         grid=(nb,),
+        interpret=interpret,
         in_specs=[
             pl.BlockSpec((br, h), lambda i: (i, 0)),
             pl.BlockSpec((1, h), lambda i: (0, 0)),
@@ -104,14 +114,14 @@ def _pallas_bwd(x2, w, inv, g2):
         ],
         out_specs=[
             pl.BlockSpec((br, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, h), x2.dtype),
-            jax.ShapeDtypeStruct((nb, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
         ],
     )(x2, w.reshape(1, h), inv, g2)
-    return dx, dw_part.sum(axis=0)
+    return dx, dw_part.reshape(h)
 
 
 # ---------------------------------------------------------------------------

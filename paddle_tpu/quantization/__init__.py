@@ -63,7 +63,12 @@ def weight_dequantize(q, scale, algo: str = "weight_only_int8"):
         # sign-extend 4-bit two's complement
         lo = jnp.where(lo > 7, lo - 16, lo)
         hi = jnp.where(hi > 7, hi - 16, hi)
-        full = jnp.stack([lo, hi], axis=1).reshape((-1,) + q.shape[1:])
+        # Packed axis is the INPUT dim (axis -2): row 2i came from lo[i],
+        # row 2i+1 from hi[i]. Interleave there so stacked (L, in/2, out)
+        # layouts unpack to (L, in, out) — stacking on axis 1 only worked
+        # for 2-D q.
+        full = jnp.stack([lo, hi], axis=-2)
+        full = full.reshape(q.shape[:-2] + (2 * q.shape[-2], q.shape[-1]))
         return full.astype(jnp.float32) * scale[..., None, :]
     raise ValueError(f"unknown algo {algo!r}")
 

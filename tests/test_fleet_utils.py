@@ -106,3 +106,57 @@ def test_expert_params_excluded():
     marker = np.asarray(net[0].weight.grad._value).copy()
     hpu.fused_allreduce_gradients(list(net.parameters()))
     np.testing.assert_allclose(np.asarray(net[0].weight.grad._value), marker)
+
+
+def test_fused_buffer_multirank_replicated_semantics():
+    """ADVICE round-1: the flat buffer must NOT be slab-sharded by the
+    collective (that summed different params together). Replicated psum over
+    a real multi-device group gives nranks*g; scale restores the average."""
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.distributed import collective as C
+
+    old = pmesh.get_global_mesh()
+    try:
+        m = pmesh.build_mesh({"dp": 8})
+        pmesh.set_global_mesh(m)
+        g = C.Group("dp", m)
+        assert g.nranks == 8
+        net = _tiny_net()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        net(x).sum().backward()
+        params = list(net.parameters())
+        before = {id(p): np.asarray(p.grad._value).copy() for p in params}
+        bufs = tfh.fused_parameters(params, comm_group=g)
+        for buf in bufs:
+            for p in buf._params:
+                buf.add_grad(p)
+            buf.comm()
+            buf.scatter_grads()
+        for p in params:
+            np.testing.assert_allclose(np.asarray(p.grad._value),
+                                       8 * before[id(p)], rtol=1e-5)
+
+        # fused_allreduce_gradients with scale=nranks -> dp average == g
+        net2 = _tiny_net()
+        net2(x).sum().backward()
+        params2 = list(net2.parameters())
+        before2 = {id(p): np.asarray(p.grad._value).copy() for p in params2}
+        hpu.fused_allreduce_gradients(params2, group=g, scale=8.0)
+        for p in params2:
+            np.testing.assert_allclose(np.asarray(p.grad._value),
+                                       before2[id(p)], rtol=1e-5)
+    finally:
+        pmesh.set_global_mesh(old)
+
+
+def test_eager_p2p_rejects_multiprocess(monkeypatch):
+    """ADVICE round-1: the mailbox cannot cross OS processes — fail fast."""
+    import pytest
+    from paddle_tpu.distributed.communication import p2p
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    t = paddle.to_tensor(np.ones((2,), np.float32))
+    with pytest.raises(RuntimeError, match="single-process"):
+        p2p.send(t, dst=1)
+    with pytest.raises(RuntimeError, match="single-process"):
+        p2p.recv(t, src=1)

@@ -122,3 +122,23 @@ def test_stacked_scale_dequant_broadcast():
     # stacked (L, in, out) with (L, out) scales dequantizes in one call
     wd = np.asarray(weight_dequantize(qp["wq"]["q"], qp["wq"]["scale"]))
     assert wd.shape == params["wq"].shape
+
+
+def test_int4_stacked_dequant_matches_per_layer():
+    # ADVICE round-1: int4 unpack must interleave along the INPUT axis so a
+    # stacked (L, in/2, out) buffer dequantizes layerwise-identically.
+    rng = np.random.RandomState(7)
+    ws = [rng.randn(8, 6).astype(np.float32) for _ in range(3)]
+    qs, ss = zip(*(weight_quantize(paddle.to_tensor(w), "weight_only_int4")
+                   for w in ws))
+    import jax.numpy as jnp
+    qst = jnp.stack([q._value if hasattr(q, "_value") else q for q in qs])
+    sst = jnp.stack([s._value if hasattr(s, "_value") else s for s in ss])
+    stacked = np.asarray(weight_dequantize(qst, sst, "weight_only_int4"))
+    for i, (q, s) in enumerate(zip(qs, ss)):
+        one = np.asarray(weight_dequantize(q._value if hasattr(q, "_value")
+                                           else q,
+                                           s._value if hasattr(s, "_value")
+                                           else s, "weight_only_int4"))
+        np.testing.assert_allclose(stacked[i], one, rtol=1e-6)
+        assert one.shape == (8, 6)
