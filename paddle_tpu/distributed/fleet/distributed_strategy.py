@@ -73,6 +73,11 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.heter_ccl_mode = False
         self.without_graph_optimization = True
 

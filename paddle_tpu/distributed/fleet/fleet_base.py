@@ -49,15 +49,48 @@ def get_strategy() -> Optional[DistributedStrategy]:
     return _state["strategy"]
 
 
+def _apply_recompute(model, checkpoints) -> None:
+    """Wrap the named sublayers' forward in fleet.recompute (jax.checkpoint).
+
+    ``checkpoints`` holds dotted sublayer paths (e.g. "llama.layers.0"); the
+    reference's recompute pass marks segment boundaries by variable name —
+    here the layer itself is the segment.
+    """
+    from .recompute import recompute as _rc
+
+    for path in checkpoints:
+        sub = model
+        for part in str(path).split("."):
+            sub = sub[int(part)] if part.isdigit() else getattr(sub, part)
+        if getattr(sub, "_fleet_recompute_wrapped", False):
+            continue
+        orig = sub.forward
+
+        def wrapped(*args, _orig=orig, **kwargs):
+            return _rc(_orig, *args, **kwargs)
+
+        sub.forward = wrapped
+        sub._fleet_recompute_wrapped = True
+
+
 def distributed_model(model):
     """Wrap per active parallelism (reference dispatch in fleet.py →
-    PipelineParallel / TensorParallel / ShardingParallel wrappers)."""
+    PipelineParallel / TensorParallel / ShardingParallel wrappers), applying
+    the strategy's model-side transforms (amp O2 cast, recompute)."""
     from ..meta_parallel.pipeline_parallel import PipelineParallel
     from ..meta_parallel.pp_layers import PipelineLayer
     from ..meta_parallel.parallel_wrapper import HybridParallelModel
 
     hcg = get_hybrid_communicate_group()
     strategy = _state["strategy"] or DistributedStrategy()
+    if strategy.amp and strategy.amp_configs.get("level") == "O2":
+        from ... import amp as _amp
+        _amp.decorate(models=model, level="O2",
+                      dtype=strategy.amp_configs.get("dtype", "bfloat16"))
+    if strategy.recompute:
+        ckpts = strategy.recompute_configs.get("checkpoints", [])
+        if ckpts:
+            _apply_recompute(model, ckpts)
     if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
         if not isinstance(model, PipelineLayer):
             raise TypeError(
@@ -68,10 +101,32 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    """Compose the strategy-selected meta-optimizers around the hybrid
+    wrapper (reference: fleet.py _select_meta_optimizer over the registered
+    meta-optimizer list)."""
     from .hybrid_optimizer import HybridParallelOptimizer
+    from . import meta_optimizers as MO
+
     hcg = get_hybrid_communicate_group()
-    return HybridParallelOptimizer(optimizer, hcg,
-                                   strategy or _state["strategy"])
+    strategy = strategy or _state["strategy"] or DistributedStrategy()
+    opt = optimizer
+    if getattr(strategy, "lamb", False):
+        opt = MO.LambOptimizer(opt, getattr(strategy, "lamb_configs", None))
+    # sharding (stage 1 wrap) + hybrid-aware grad clip
+    opt = HybridParallelOptimizer(opt, hcg, strategy)
+    if strategy.amp:
+        opt = MO.AMPOptimizer(opt, strategy.amp_configs)
+    if strategy.recompute:
+        opt = MO.RecomputeOptimizer(opt, strategy.recompute_configs)
+    if getattr(strategy, "gradient_merge", False):
+        c = getattr(strategy, "gradient_merge_configs", {})
+        opt = MO.GradientMergeOptimizer(opt, k_steps=c.get("k_steps", 1),
+                                        avg=c.get("avg", True))
+    if getattr(strategy, "localsgd", False):
+        c = getattr(strategy, "localsgd_configs", {})
+        opt = MO.LocalSGDOptimizer(opt, k_steps=c.get("k_steps", 1),
+                                   begin_step=c.get("begin_step", 1))
+    return opt
 
 
 def get_hybrid_communicate_group_():

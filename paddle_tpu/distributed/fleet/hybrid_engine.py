@@ -88,6 +88,12 @@ class HybridTrainStep(TrainStep):
             slots = {}
             state = self.optimizer._accumulators[id(p)]
             for slot, v in state.items():
+                cur = getattr(v, "sharding", None)
+                if isinstance(cur, NamedSharding) and cur.mesh == mesh:
+                    # state already placed (eager stage-1/2 wrapper): the jit
+                    # in_shardings must match the actual placement exactly
+                    slots[slot] = cur
+                    continue
                 vshape = getattr(v, "shape", ())
                 if tuple(vshape) == tuple(p._value.shape) and self.zero_stage >= 1:
                     nd = len(vshape)

@@ -50,11 +50,14 @@ class HybridParallelOptimizer:
     """Delegating wrapper: swaps the inner clip for the hybrid-aware clip and
     keeps the reference's API (step/clear_grad/state_dict/…)."""
 
-    def __init__(self, optimizer: Optimizer, hcg, strategy):
+    def __init__(self, optimizer, hcg, strategy):
+        from .meta_optimizers import unwrap_optimizer
+
         # reference: when sharding_degree > 1 the inner optimizer is wrapped
         # in DygraphShardingOptimizer (stage 1) before the hybrid wrapper
         if hcg is not None and hcg.get_sharding_parallel_world_size() > 1 and \
-                isinstance(optimizer, Optimizer):
+                isinstance(unwrap_optimizer(optimizer), Optimizer) and \
+                not self._already_sharded(optimizer):
             from .dygraph_sharding_optimizer import DygraphShardingOptimizer
             optimizer = DygraphShardingOptimizer(optimizer, hcg)
         self._inner_opt = optimizer
@@ -62,12 +65,24 @@ class HybridParallelOptimizer:
         self._strategy = strategy
         # reference behaviour: only ClipGradByGlobalNorm is swapped for the
         # hybrid-aware variant; other clip types keep their own semantics.
-        inner = getattr(optimizer, "inner_opt", optimizer)
+        inner = unwrap_optimizer(optimizer)
         if isinstance(inner._grad_clip, ClipGradByGlobalNorm) and \
                 not isinstance(inner._grad_clip, HybridParallelClipGrad) and \
                 hcg is not None:
             inner._grad_clip = HybridParallelClipGrad(
                 inner._grad_clip, hcg)
+
+    @staticmethod
+    def _already_sharded(optimizer) -> bool:
+        from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+        o = optimizer
+        seen = set()
+        while o is not None and id(o) not in seen:
+            seen.add(id(o))
+            if isinstance(o, DygraphShardingOptimizer):
+                return True
+            o = getattr(o, "_inner_opt", None) or getattr(o, "inner_opt", None)
+        return False
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

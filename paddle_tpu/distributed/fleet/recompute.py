@@ -71,6 +71,14 @@ def recompute(function: Callable, *args, use_reentrant=True,
 
     np_ = len(ptensors)
 
+    # Side-channel attributes (MoE gate aux losses) written onto sublayers
+    # DURING the call would escape the checkpoint region as tracers; instead
+    # they are threaded out as extra checkpoint outputs and written back
+    # outside. aux_subs is populated at trace time (dict dedupes the
+    # fwd + remat-bwd traces).
+    aux_subs: dict = {}
+    meta: dict = {}
+
     def pure(*vals):
         pvals_flat = vals[:np_]
         tvals = vals[np_:]
@@ -80,7 +88,17 @@ def recompute(function: Callable, *args, use_reentrant=True,
 
         def run():
             out = function(*full, **kwargs)
-            return tree_unwrap(out)
+            auxvals = []
+            for layer in layers:
+                for name, sub in layer.named_sublayers(include_self=True):
+                    la = getattr(sub, "l_aux", None)
+                    if isinstance(la, Tensor):
+                        aux_subs[(id(layer), name)] = sub
+                        auxvals.append(la._value)
+            leaves, treedef = jax.tree_util.tree_flatten(tree_unwrap(out))
+            meta["treedef"] = treedef
+            meta["n_out"] = len(leaves)
+            return tuple(leaves) + tuple(auxvals)
 
         import contextlib
         with contextlib.ExitStack() as stack:
@@ -92,7 +110,15 @@ def recompute(function: Callable, *args, use_reentrant=True,
             return run()
 
     ck = jax.checkpoint(pure)
-    return apply(lambda *v: ck(*v), *ptensors, *tensor_args, op_name="recompute")
+    outs = apply(lambda *v: ck(*v), *ptensors, *tensor_args,
+                 op_name="recompute")
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    n_out = meta["n_out"]
+    out = jax.tree_util.tree_unflatten(meta["treedef"], outs[:n_out])
+    for sub, av in zip(aux_subs.values(), outs[n_out:]):
+        sub.l_aux = av if isinstance(av, Tensor) else Tensor(av)
+    return out
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

@@ -37,13 +37,38 @@ class HybridParallelModel(Layer):
 
     def compile_train_step(self, loss_fn: Callable, optimizer):
         """loss_fn(model, *batch) -> scalar. Returns the compiled hybrid step
-        (cached)."""
+        (cached). Strategy amp wraps the loss in auto_cast inside the traced
+        program (the compiled analog of the reference's amp pass)."""
         from ..fleet.hybrid_engine import HybridTrainStep
+        from ..fleet.meta_optimizers import unwrap_optimizer
         if self._train_step is None:
-            inner_opt = getattr(optimizer, "_inner_opt", optimizer)
+            if self._strategy is not None and (
+                    getattr(self._strategy, "gradient_merge", False)
+                    or getattr(self._strategy, "localsgd", False)):
+                # these compose as eager step-loop wrappers; unwrapping to the
+                # base update rule here would silently drop them
+                raise ValueError(
+                    "strategy.gradient_merge / strategy.localsgd are eager "
+                    "step-loop transforms and are not applied inside the "
+                    "compiled hybrid step — drive training through "
+                    "opt.step()/clear_grad() (or use micro-batching via the "
+                    "pipeline engine's accumulate_steps) instead")
+            inner_opt = unwrap_optimizer(optimizer)
             stage = 1
             if self._strategy is not None and self._strategy.sharding:
                 stage = int(self._strategy.sharding_configs.get("stage", 1))
+            if self._strategy is not None and self._strategy.amp:
+                from ... import amp as _amp
+                c = self._strategy.amp_configs
+                base_loss = loss_fn
+
+                def loss_fn(model, *batch, _base=base_loss, _c=c):
+                    with _amp.auto_cast(
+                            enable=True, level=_c.get("level", "O1"),
+                            dtype=_c.get("dtype", "bfloat16"),
+                            custom_white_list=_c.get("custom_white_list"),
+                            custom_black_list=_c.get("custom_black_list")):
+                        return _base(model, *batch)
             self._train_step = HybridTrainStep(
                 self._layers, loss_fn, inner_opt,
                 mesh=self._hcg.mesh if self._hcg else None,

@@ -103,8 +103,19 @@ class GroupShardedOptimizerStage2:
 
         def sharded_init(p: Parameter):
             state = orig_init(p)
+            pspec = getattr(p, "_sharding_spec", None)
             for k, v in state.items():
-                spec = shard_spec_for(v.shape, axis, deg)
+                if pspec is not None and tuple(v.shape) == tuple(p._value.shape):
+                    # param-shaped slot of an mp/tp-sharded param: compose the
+                    # sharding axis INTO the param's spec so eager placement
+                    # agrees with the compiled step's derivation (a bare
+                    # P(axis) here conflicted with jit in_shardings)
+                    from ..fleet.hybrid_engine import _spec_with_axis0
+                    nd = len(v.shape)
+                    d0 = v.shape[0] if nd else 1
+                    spec = _spec_with_axis0(pspec, axis, nd, d0, deg)
+                else:
+                    spec = shard_spec_for(v.shape, axis, deg)
                 state[k] = _place(v, mesh, spec, offload=off)
             return state
 

@@ -139,6 +139,51 @@ def moe_combine(expert_out, combine_mask):
 # Expert-parallel execution inside shard_map (the ragged alltoall of
 # global_scatter/global_gather over an ICI 'expert' axis — SURVEY §2.4 EP)
 # ---------------------------------------------------------------------------
+def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
+                          w1_local, w2_local, axis_name: str,
+                          num_experts: int, capacity: int, act=None,
+                          b1_local=None, b2_local=None):
+    """Expert-parallel MoE FFN with PRE-COMPUTED gating (any gate works:
+    naive/GShard/Switch indices with -1 = pruned token drop out of the
+    dispatch masks). Call inside shard_map; see :func:`expert_parallel_ffn`
+    for the data-path description.
+    """
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    if num_experts % n:
+        raise ValueError(f"num_experts {num_experts} must be divisible by "
+                         f"'{axis_name}' axis size {n}")
+    e_local = num_experts // n
+    if act is None:
+        act = jax.nn.gelu
+
+    disp, comb = dispatch_combine_topk(gate_idx_local, gate_prob_local,
+                                       num_experts, capacity)
+    in_dtype = x_local.dtype
+    slots = moe_dispatch(x_local.astype(jnp.float32), disp)  # (E, C, d)
+
+    d_model = x_local.shape[-1]
+    z = slots.reshape(n, e_local, capacity, d_model)
+    # chunk i (this device's dispatch FOR expert-group i) goes to device i;
+    # received leading dim then indexes the SOURCE device
+    z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0)
+    z = jnp.swapaxes(z, 0, 1).reshape(e_local, n * capacity, d_model)
+
+    h = jnp.einsum("ecd,edf->ecf", z.astype(in_dtype), w1_local)
+    if b1_local is not None:
+        h = h + b1_local[:, None, :]
+    h = act(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2_local)              # (E_local, nC, d)
+    if b2_local is not None:
+        y = y + b2_local[:, None, :]
+
+    y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    y = y.reshape(num_experts, capacity, d_model)
+    return moe_combine(y.astype(jnp.float32), comb).astype(in_dtype)
+
+
 def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
                         axis_name: str, num_experts: int, capacity: int,
                         topk: int = 1, act=None):
@@ -158,35 +203,12 @@ def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
     """
     from jax import lax
 
-    n = lax.axis_size(axis_name)
-    if num_experts % n:
-        raise ValueError(f"num_experts {num_experts} must be divisible by "
-                         f"'{axis_name}' axis size {n}")
-    e_local = num_experts // n
-    if act is None:
-        act = jax.nn.gelu
-
     probs = jax.nn.softmax(gate_logits_local.astype(jnp.float32), axis=-1)
     if topk == 1:
         gate_idx = jnp.argmax(probs, axis=-1)[:, None]       # (T, 1)
         gate_prob = jnp.take_along_axis(probs, gate_idx, axis=-1)
     else:
         gate_prob, gate_idx = lax.top_k(probs, topk)
-    disp, comb = dispatch_combine_topk(gate_idx, gate_prob, num_experts,
-                                       capacity)
-    slots = moe_dispatch(x_local, disp)                      # (E, C, d)
-
-    d_model = x_local.shape[-1]
-    z = slots.reshape(n, e_local, capacity, d_model)
-    # chunk i (this device's dispatch FOR expert-group i) goes to device i;
-    # received leading dim then indexes the SOURCE device
-    z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0)
-    z = jnp.swapaxes(z, 0, 1).reshape(e_local, n * capacity, d_model)
-
-    h = act(jnp.einsum("ecd,edf->ecf", z, w1_local))
-    y = jnp.einsum("ecf,efd->ecd", h, w2_local)              # (E_local, nC, d)
-
-    y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
-    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
-    y = y.reshape(num_experts, capacity, d_model)
-    return moe_combine(y, comb)
+    return expert_parallel_apply(x_local, gate_idx, gate_prob, w1_local,
+                                 w2_local, axis_name, num_experts, capacity,
+                                 act=act)

@@ -1,0 +1,188 @@
+"""Strategy-driven transform composition through the Fleet facade.
+
+VERDICT round-1 item 3: ``fleet.init(strategy)`` + ``distributed_model`` +
+``distributed_optimizer`` must actually compose amp / recompute / sharding /
+hybrid machinery, ending in the compiled HybridTrainStep — verified here by
+driving Llama training purely through the fleet API and matching the serial
+loss. Reference surface: python/paddle/distributed/fleet/meta_optimizers/
+(SURVEY.md §2.5)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import topology as topo
+from paddle_tpu.parallel import mesh as pmesh
+from paddle_tpu.models import llama as L
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    pmesh.set_global_mesh(None)
+    topo.set_hybrid_communicate_group(None)
+
+
+def _batch(cfg, b=4, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=-1).astype(np.int64)
+    return paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+
+def _loss_fn(model, ids, labels):
+    return model.compute_loss(ids, labels)
+
+
+def _serial_llama_losses(cfg, init_sd, ids, labels, n=3):
+    pmesh.set_global_mesh(None)
+    topo.set_hybrid_communicate_group(None)
+    net = L.LlamaForCausalLM(cfg)
+    net.set_state_dict(init_sd)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, _loss_fn, opt)
+    return [float(step(ids, labels)) for _ in range(n)]
+
+
+def test_llama_via_fleet_api_matches_serial():
+    """dp×mp×sharding Llama driven ONLY through fleet.init /
+    distributed_model / distributed_optimizer matches single-device loss."""
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sharding_degree": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(11)
+    net = L.LlamaForCausalLM(cfg)
+    init_sd = {k: paddle.to_tensor(np.asarray(v._value).copy())
+               for k, v in net.state_dict().items()}
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    dm = fleet.distributed_model(net)
+    dopt = fleet.distributed_optimizer(opt)
+    step = dm.compile_train_step(_loss_fn, dopt)
+    ids, labels = _batch(cfg, b=8)
+    fleet_losses = [float(step(ids, labels)) for _ in range(3)]
+
+    serial = _serial_llama_losses(cfg, init_sd, ids, labels)
+    np.testing.assert_allclose(fleet_losses, serial, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_fleet_recompute_same_loss():
+    """strategy.recompute wraps the named decoder layers in jax.checkpoint;
+    remat must not change numerics."""
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    strategy.recompute = True
+    strategy.recompute_configs = {
+        "checkpoints": ["llama.layers.0", "llama.layers.1"]}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(11)
+    net = L.LlamaForCausalLM(cfg)
+    init_sd = {k: paddle.to_tensor(np.asarray(v._value).copy())
+               for k, v in net.state_dict().items()}
+    dm = fleet.distributed_model(net)
+    assert net.llama.layers[0]._fleet_recompute_wrapped
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    assert dopt.recompute_configs["checkpoints"]
+    step = dm.compile_train_step(_loss_fn, dopt)
+    ids, labels = _batch(cfg, b=8)
+    rc_losses = [float(step(ids, labels)) for _ in range(3)]
+
+    serial = _serial_llama_losses(cfg, init_sd, ids, labels)
+    np.testing.assert_allclose(rc_losses, serial, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_fleet_amp_o1_trains():
+    """strategy.amp (O1 bf16) composes auto_cast into the compiled step and
+    provides a (disabled-for-bf16) scaler via the AMP meta-optimizer."""
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O1", "dtype": "bfloat16"}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(11)
+    net = L.LlamaForCausalLM(cfg)
+    init_sd = {k: paddle.to_tensor(np.asarray(v._value).copy())
+               for k, v in net.state_dict().items()}
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    scaler = dopt.get_loss_scaler()
+    assert not scaler._enable  # bf16 needs no loss scaling
+    dm = fleet.distributed_model(net)
+    step = dm.compile_train_step(_loss_fn, dopt)
+    ids, labels = _batch(cfg, b=8)
+    amp_losses = [float(step(ids, labels)) for _ in range(3)]
+    assert all(np.isfinite(v) for v in amp_losses)
+    assert amp_losses[-1] < amp_losses[0]
+    # bf16 compute tracks the fp32 losses loosely
+    serial = _serial_llama_losses(cfg, init_sd, ids, labels)
+    np.testing.assert_allclose(amp_losses, serial, rtol=0.1, atol=0.05)
+
+
+def test_gradient_merge_optimizer_accumulates():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.5, parameters=net.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    w0 = np.asarray(net.weight._value).copy()
+
+    net(x).sum().backward()
+    dopt.step()          # call 1/2: accumulate only
+    dopt.clear_grad()    # must NOT clear mid-accumulation
+    np.testing.assert_allclose(np.asarray(net.weight._value), w0)
+    assert net.weight.grad is not None
+
+    net(x).sum().backward()
+    dopt.step()          # call 2/2: averaged update fires
+    dopt.clear_grad()
+    assert net.weight.grad is None
+    # avg of two identical grads == single grad -> same as one SGD step
+    ref = nn.Linear(4, 4)
+    ref.set_state_dict({"weight": paddle.to_tensor(w0),
+                        "bias": paddle.to_tensor(
+                            np.zeros_like(np.asarray(net.bias._value)))})
+    # compute the expected update directly: w - lr * x^T @ ones
+    g = np.ones((2, 4), np.float32).T @ np.ones((2, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(net.weight._value), w0 - 0.5 * g,
+                               rtol=1e-5)
+
+
+def test_localsgd_and_lamb_meta_optimizers():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    strategy.lamb = True
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    from paddle_tpu.distributed.fleet.meta_optimizers import unwrap_optimizer
+    from paddle_tpu.optimizer import Lamb
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=net.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    assert isinstance(unwrap_optimizer(dopt), Lamb)  # lamb swap happened
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for _ in range(2):  # second step triggers the localsgd param averaging
+        net(x).sum().backward()
+        dopt.step()
+        dopt.clear_grad()
+    assert np.isfinite(np.asarray(net.weight._value)).all()

@@ -222,3 +222,45 @@ def test_expert_parallel_ffn_matches_dense():
         hidden = np.asarray(jax.nn.gelu(x[t] @ w1[e]))
         ref[t] = (hidden @ w2[e]) * probs[t, e]
     np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_moe_layer_expert_parallel_matches_dense():
+    """MoELayer with a multi-device moe_group routes through the all_to_all
+    expert_parallel_apply path (VERDICT round-1 item 4) and must match the
+    dense (N,E,C)-einsum path with ample capacity — forward AND grads."""
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.distributed import collective as C
+
+    d, E, N = 8, 8, 32
+    old = pmesh.get_global_mesh()
+    try:
+        mesh = pmesh.build_mesh({"dp": 8})
+        pmesh.set_global_mesh(mesh)
+        group = C.Group("dp", mesh)
+
+        paddle.seed(0)
+        dense = MoELayer(d, [_expert(d, i) for i in range(E)], gate="naive",
+                         topk=2, capacity_factor=(100.0, 100.0))
+        paddle.seed(0)
+        ep = MoELayer(d, [_expert(d, i) for i in range(E)], gate="naive",
+                      topk=2, capacity_factor=(100.0, 100.0),
+                      moe_group=group)
+        assert ep._ep_parts is not None  # the parallel path engaged
+
+        x = np.random.RandomState(3).randn(N, d).astype(np.float32)
+        out_d = dense(paddle.to_tensor(x))
+        out_p = ep(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out_p._value),
+                                   np.asarray(out_d._value),
+                                   rtol=1e-4, atol=1e-5)
+
+        # grads through stack + shard_map (all_to_all transpose)
+        out_d.sum().backward()
+        out_p.sum().backward()
+        gd = [np.asarray(p._grad_value) for p in dense.experts.parameters()]
+        gp = [np.asarray(p._grad_value) for p in ep.experts.parameters()]
+        assert len(gd) == len(gp)
+        for a, b in zip(gd, gp):
+            np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+    finally:
+        pmesh.set_global_mesh(old)
