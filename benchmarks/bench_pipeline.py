@@ -1,0 +1,119 @@
+"""Pipeline-schedule comparison on the 8-device CPU mesh (VERDICT round-1
+item 6): step time + compiled temp memory + analytic bubble fraction for
+fill-drain, interleaved (vpp=2), and true 1F1B.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     PYTHONPATH=. python benchmarks/bench_pipeline.py
+"""
+
+import os
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.parallel import pipeline as ppipe  # noqa: E402
+
+S, H, MB, M = 4, 256, 8, 32
+V = 2  # interleave chunks
+
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def loss_fn(y, lab):
+    return jnp.mean((y - lab) ** 2)
+
+
+def setup(chunked=False):
+    rng = np.random.RandomState(0)
+    n = S * V if chunked else S
+    params = {"w": (rng.randn(n, H, H) / np.sqrt(H)).astype(np.float32),
+              "b": np.zeros((n, H), np.float32)}
+    x = rng.randn(M, MB, H).astype(np.float32)
+    lab = rng.randn(M, MB, H).astype(np.float32)
+    return params, x, lab
+
+
+def strip(p):
+    return jax.tree_util.tree_map(lambda a: a[0], p)
+
+
+def build(kind, mesh):
+    if kind == "1f1b":
+        def prog(params, x, lab):
+            loss, grads = ppipe.pipeline_1f1b(stage_fn, params, x, lab,
+                                              loss_fn, axis_name="pp")
+            return ppipe.last_stage_broadcast(loss, "pp"), grads
+    elif kind == "fill-drain":
+        def prog(params, x, lab):
+            def loss_of(params):
+                out = ppipe.pipeline_spmd(
+                    lambda p, xm: stage_fn(strip(p), xm), params, x, "pp")
+                out = ppipe.last_stage_broadcast(out, "pp")
+                return jnp.mean(jax.vmap(loss_fn)(out, lab))
+            return jax.value_and_grad(loss_of)(params)
+    else:  # interleaved vpp=V
+        order = ppipe.interleave_chunk_order(S, V)
+
+        def prog(params, x, lab):
+            def loss_of(params):
+                out = ppipe.pipeline_spmd_interleaved(
+                    stage_fn, params, x, num_chunks=V, axis_name="pp")
+                out = ppipe.last_stage_broadcast(out, "pp")
+                return jnp.mean(jax.vmap(loss_fn)(out, lab))
+            return jax.value_and_grad(loss_of)(params)
+
+    return jax.jit(jax.shard_map(
+        prog, mesh=mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
+        out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+        check_vma=False))
+
+
+def main():
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+    rows = []
+    bubbles = {
+        # chunk-tick bubble fractions of the three schedules
+        "fill-drain": (S - 1) / (M + S - 1),
+        "interleaved": (S - 1) / (M * V + S - 1),
+        "1f1b": (2 * (S - 1)) / (M + 2 * S - 2),
+    }
+    for kind in ("fill-drain", "interleaved", "1f1b"):
+        chunked = kind == "interleaved"
+        params, x, lab = setup(chunked=chunked)
+        if chunked:
+            order = ppipe.interleave_chunk_order(S, V)
+            params = jax.tree_util.tree_map(
+                lambda a: np.ascontiguousarray(a[order]), params)
+        f = build(kind, mesh)
+        lowered = f.lower(params, x, lab)
+        compiled = lowered.compile()
+        temp = compiled.memory_analysis().temp_size_in_bytes
+        loss, grads = f(params, x, lab)  # warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            loss, grads = f(params, x, lab)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / 10
+        rows.append((kind, float(loss), dt * 1e3, temp / 1024,
+                     bubbles[kind]))
+    print(f"{'schedule':<12} {'loss':>8} {'ms/step':>8} {'tempKiB':>9} "
+          f"{'bubble':>7}")
+    for kind, loss, ms, kib, bub in rows:
+        print(f"{kind:<12} {loss:8.4f} {ms:8.2f} {kib:9.0f} {bub:7.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of AphelionGroup/Paddle (PaddlePaddle), rebuilt on jax/XLA/Pallas.
+
+Design (SURVEY.md §7): imperative paddle-shaped API over jax.Array + vjp tape;
+Fleet-shaped hybrid parallelism over one jax.sharding.Mesh; Pallas kernels for
+the fused-CUDA-kernel corpus; XLA replaces executors/CINN/PIR wholesale.
+"""
+
+__version__ = "0.1.0"
+
+from . import flags  # noqa: F401  (registers flag corpus first)
+from .flags import get_flags, set_flags  # noqa: F401
+
+from .core import (  # noqa: F401
+    Tensor, Parameter, CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
+    set_device, get_device, is_compiled_with_tpu,
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+)
+from .core.dtype import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128,
+    set_default_dtype, get_default_dtype,
+)
+from .core.math_ops import *  # noqa: F401,F403
+from .core.math_ops import sum, max, min, abs, all, any, pow, round  # noqa: F401
+from .creation import (  # noqa: F401
+    to_tensor, zeros, ones, full, empty, zeros_like, ones_like, full_like,
+    empty_like, arange, linspace, logspace, eye, meshgrid, diag_embed,
+    rand, randn, randint, randperm, uniform, normal, multinomial, bernoulli,
+    create_parameter,
+)
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .nn.layer import ParamAttr  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import ops  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io_save import save, load  # noqa: F401
+
+# subpackages imported lazily by user code: distributed, vision, hapi, parallel,
+# incubate, profiler (kept out of the base import to keep import time low)
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("distributed", "vision", "hapi", "parallel", "incubate",
+                "profiler", "models", "inference", "static", "quantization",
+                "linalg"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("Model", "summary"):
+        from .hapi import Model, summary
+        globals().update(Model=Model, summary=summary)
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def is_grad_enabled_():  # internal alias guard
+    return is_grad_enabled()

@@ -1,0 +1,209 @@
+"""Automatic mixed precision.
+
+Parity with python/paddle/amp/{auto_cast,grad_scaler}.py of the reference
+(SURVEY.md §2.5 AMP row). TPU-first: bf16 is the native half type, needs no
+loss scaling; ``GradScaler`` keeps the full dynamic-loss-scale state machine
+for fp16 parity and becomes a transparent passthrough for bf16/disabled.
+
+O1: ops on an allow-list compute in low precision (inputs cast at dispatch).
+O2: ``decorate`` casts model params to low precision and (via optimizer
+``multi_precision``) keeps fp32 master weights — the main_grad idiom.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+
+# ops that benefit from low precision (matmul-class); parity with the
+# reference's white list (paddle/fluid/imperative/amp_auto_cast.cc)
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv2d", "conv1d", "conv3d",
+              "einsum", "flash_attention", "attention_masked"}
+# ops kept in fp32 (reductions/normalizations/losses)
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
+              "batch_norm", "rms_norm", "mean", "sum", "norm", "logsumexp",
+              "exp", "log", "cosine_similarity"}
+
+_amp_state = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1",
+              "custom_white": set(), "custom_black": set()}
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_amp_state)
+    _amp_state.update(
+        enabled=enable, dtype=convert_dtype(dtype), level=level,
+        custom_white=set(custom_white_list or ()),
+        custom_black=set(custom_black_list or ()))
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def _target_dtype(op_name, cur_dtype):
+    """Return the dtype an input should be cast to under the active amp mode,
+    or None for no cast."""
+    level = _amp_state["level"]
+    dt = _amp_state["dtype"]
+    white = (WHITE_LIST | _amp_state["custom_white"]) - _amp_state["custom_black"]
+    black = BLACK_LIST | _amp_state["custom_black"]
+    if level == "O2":
+        if op_name in black and cur_dtype in (jnp.bfloat16, jnp.float16):
+            return jnp.float32
+        return None
+    if op_name in white and cur_dtype == jnp.float32:
+        return dt
+    return None
+
+
+# hook into the dispatcher (dispatch.apply consults amp_cast_hook per call)
+def _amp_hook(op_name, args):
+    if not _amp_state["enabled"]:
+        return args
+    cast_args = []
+    for a in args:
+        if isinstance(a, Tensor):
+            tgt = _target_dtype(op_name, a._value.dtype)
+            if tgt is not None:
+                # real recorded cast op so the tape transposes dtypes correctly
+                a = a.astype(tgt)
+        cast_args.append(a)
+    return tuple(cast_args)
+
+
+_dispatch.amp_cast_hook = _amp_hook
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Parity with paddle.amp.decorate: cast model to low precision (O2) and
+    turn on optimizer master weights."""
+    d = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = [optimizers] if single_opt or optimizers is None else list(optimizers)
+    if level == "O2":
+        for m in model_list:
+            if m is not None:
+                m.to(dtype=d)
+        for o in opt_list:
+            if o is not None:
+                o._multi_precision = True
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)
+
+
+class GradScaler:
+    """Dynamic loss scaling, parity with paddle.amp.GradScaler.
+
+    On TPU with bf16 this is effectively identity (enable=False default when
+    dtype is bf16), but the fp16 state machine is implemented faithfully:
+    scale *= incr_ratio every incr_every_n_steps good steps; on inf/nan skip
+    the step and scale *= decr_ratio.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad_value is None:
+                continue
+            g = p._grad_value.astype(jnp.float32) * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found = True
+            p._grad_value = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._found_inf:
+            self.unscale_(optimizer)
+        if self._found_inf:
+            self._update_on_inf()
+            self._found_inf = False
+            return
+        optimizer.step()
+        self._update_on_good()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass
+
+    def _update_on_good(self):
+        if not self._dynamic:
+            return
+        self._good += 1
+        self._bad = 0
+        if self._good >= self._incr_every:
+            self._scale *= self._incr_ratio
+            self._good = 0
+
+    def _update_on_inf(self):
+        if not self._dynamic:
+            return
+        self._bad += 1
+        self._good = 0
+        if self._bad >= self._decr_every:
+            self._scale = max(self._scale * self._decr_ratio, 1.0)
+            self._bad = 0
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def load_state_dict(self, s):
+        self._scale = s["scale"]
+        self._good = s["good"]
+        self._bad = s["bad"]
+
+
+from . import debugging  # noqa: E402,F401

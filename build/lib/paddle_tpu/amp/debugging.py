@@ -1,0 +1,113 @@
+"""Numerics debugging: nan/inf detection in eager AND compiled code.
+
+Rebuild of paddle.amp.debugging + FLAGS_check_nan_inf
+(paddle/fluid/framework/details/nan_inf_utils_detail.{cc,cu}:§0,
+python/paddle/amp/debugging.py:§0 — SURVEY.md §5.2). The reference scans
+every op output on device with a CUDA kernel; the TPU-native equivalents:
+
+* eager: ``check_numerics`` / the dispatch-level hook armed by
+  ``FLAGS_check_nan_inf`` (core/dispatch.py) — host-side scans.
+* compiled: ``checkify_wrap`` functionalizes a jitted function with
+  ``jax.experimental.checkify`` float checks, so nan/inf *inside* an XLA
+  program is caught with the generating primitive named — the
+  checkify/debug_callback pass SURVEY §5.2 calls for.
+"""
+
+from __future__ import annotations
+
+import functools
+from enum import Enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..flags import set_flags, flag_value
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """Parity with paddle.amp.debugging.TensorCheckerConfig."""
+
+    def __init__(self, enable: bool = True,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
+    """Arm the dispatch-level nan/inf scan (FLAGS_check_nan_inf), honouring
+    debug_mode (abort vs report-only) and the op include/skip lists."""
+    from ..core import dispatch as _d
+    set_flags({"check_nan_inf": bool(checker_config.enable)})
+    _d.nan_inf_abort[0] = (checker_config.debug_mode
+                           == DebugMode.CHECK_NAN_INF_AND_ABORT)
+    _d.nan_inf_skip_ops = set(checker_config.skipped_op_list or ())
+    _d.nan_inf_check_ops = set(checker_config.checked_op_list or ())
+
+
+def disable_tensor_checker() -> None:
+    from ..core import dispatch as _d
+    set_flags({"check_nan_inf": False})
+    _d.nan_inf_abort[0] = True
+    _d.nan_inf_skip_ops = set()
+    _d.nan_inf_check_ops = set()
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Eager scan; raises FloatingPointError on nan/inf (abort mode) or
+    returns (num_nan, num_inf) counts."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return 0, 0
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"nan/inf in {op_type or 'tensor'} {var_name}: "
+            f"{n_nan} nan, {n_inf} inf (shape {tuple(v.shape)})")
+    return n_nan, n_inf
+
+
+def checkify_wrap(fn: Callable, *, jit: bool = True) -> Callable:
+    """Wrap a (jittable) array function so nan/inf produced INSIDE the
+    compiled program raises FloatingPointError naming the primitive.
+
+    This is how ``FLAGS_check_nan_inf`` extends into jit-world: the host
+    scan in dispatch can't see intermediate values of a fused XLA program,
+    checkify can. Cost: checks compile into the program — debug builds
+    only, like the reference's flag.
+    """
+    from jax.experimental import checkify
+
+    target = jax.jit(fn) if jit else fn
+    checked = checkify.checkify(target, errors=checkify.float_checks)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        msg = err.get()
+        if msg is not None:
+            raise FloatingPointError(f"nan/inf inside compiled fn: {msg}")
+        return out
+
+    return wrapper
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "tensor-dump comparison is a GPU-workflow tool; on TPU use "
+        "checkify_wrap plus jax.debug.print for in-program inspection")

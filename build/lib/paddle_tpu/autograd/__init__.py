@@ -1,0 +1,79 @@
+"""``paddle_tpu.autograd`` — public autograd surface.
+
+Parity with python/paddle/autograd/ of the reference (backward, grad, PyLayer
+— SURVEY.md §2.1 eager autograd row).
+"""
+
+from ..core.autograd import backward, grad, no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from ..core.dispatch import apply as _apply
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom differentiable op, parity with paddle.autograd.PyLayer.
+
+    Subclasses define ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    operating on Tensors. Implemented over jax.custom_vjp-free tape nodes:
+    the backward is recorded directly as a GradNode.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as ag
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+        needs_grad = ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not needs_grad:
+            return outs if single else list(outs_t)
+
+        import jax
+        avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in outs_t]
+
+        def vjp_fn(cots):
+            gs = cls.backward(ctx, *[Tensor(c) for c in cots])
+            gs = (gs,) if isinstance(gs, Tensor) else tuple(gs)
+            out = []
+            gi = 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = gs[gi] if gi < len(gs) else None
+                    gi += 1
+                    out.append(None if g is None else g._value)
+            return tuple(out)
+
+        node = ag.GradNode(vjp_fn, tensor_inputs, avals, name=cls.__name__)
+        wrapped = tuple(
+            Tensor(o._value, stop_gradient=False, _grad_node=node, _out_index=i)
+            for i, o in enumerate(outs_t))
+        return wrapped[0] if single else list(wrapped)

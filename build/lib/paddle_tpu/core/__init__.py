@@ -1,0 +1,8 @@
+from .tensor import Tensor, Parameter  # noqa: F401
+from .place import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, XLAPlace, CUDAPlace, set_device, get_device,
+    current_place, is_compiled_with_tpu,
+)
+from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from . import dtype  # noqa: F401
+from . import math_ops  # noqa: F401  (installs Tensor methods)

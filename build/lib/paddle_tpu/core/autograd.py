@@ -1,0 +1,210 @@
+"""Imperative autograd over jax vjps.
+
+TPU-native rebuild of the reference's eager autograd engine
+(paddle/fluid/eager/backward.cc, grad_node_info.h — SURVEY.md §2.1): instead of
+generated C++ GradNodes, every op application records one ``GradNode`` holding
+the ``jax.vjp`` residual closure. ``backward()`` walks the node graph in
+reverse-topological order exactly like ``egr::RunBackward``'s queue.
+
+The graph is owned by output tensors (node refs live on the Tensor), so eager
+loops that never call backward free their graphs with the tensors.  The whole
+mechanism composes with ``jax.jit``: under trace, vjp residuals are tracers and
+the backward walk happens at trace time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# Grad mode
+# --------------------------------------------------------------------------
+_grad_enabled = [True]
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled[-1]
+
+
+@contextlib.contextmanager
+def no_grad():
+    _grad_enabled.append(False)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+@contextlib.contextmanager
+def enable_grad():
+    _grad_enabled.append(True)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager form, parity with paddle.set_grad_enabled."""
+    cm = enable_grad() if mode else no_grad()
+    return cm
+
+
+# --------------------------------------------------------------------------
+# Node graph
+# --------------------------------------------------------------------------
+class GradNode:
+    """One recorded op: holds the vjp closure and edges to input tensors."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "name", "released", "out_avals")
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals: Sequence[Any],
+                 name: str = "op"):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensor objects (strong refs keep graph alive)
+        self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
+        self.n_outputs = len(self.out_avals)
+        self.name = name
+        self.released = False
+
+    def _zero_cots(self):
+        # jax.vjp requires float0 cotangents for non-differentiable (int/bool)
+        # outputs; zeros of the output dtype would raise a cotangent-type error.
+        import numpy as _np
+        out = []
+        for a in self.out_avals:
+            if jnp.issubdtype(a.dtype, jnp.floating) or jnp.issubdtype(a.dtype, jnp.complexfloating):
+                out.append(jnp.zeros(a.shape, a.dtype))
+            else:
+                out.append(_np.zeros(a.shape, jax.dtypes.float0))
+        return tuple(out)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.released = True
+
+
+def _toposort(root: GradNode) -> List[GradNode]:
+    order: List[GradNode] = []
+    seen = set()
+    stack: List[tuple] = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            n = getattr(t, "_grad_node", None)
+            if n is not None and id(n) not in seen:
+                stack.append((n, False))
+    return order  # children before parents; reverse pass iterates reversed()
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False,
+             capture: Optional[dict] = None) -> None:
+    """Run reverse accumulation from ``tensor``, filling ``.grad`` on leaves.
+
+    Parity with ``paddle.autograd.backward`` / ``Tensor.backward()``.
+
+    ``capture``: optional {id(tensor): None} map used by :func:`grad` — when
+    given, cotangents routed into those tensors (leaf OR intermediate) are
+    collected there and **no** ``.grad`` fields are mutated anywhere.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    root = getattr(tensor, "_grad_node", None)
+    if root is None:
+        if capture is not None and id(tensor) in capture:
+            seed = jnp.ones_like(tensor._value) if grad_tensor is None else (
+                grad_tensor._value if isinstance(grad_tensor, Tensor)
+                else jnp.asarray(grad_tensor))
+            capture[id(tensor)] = _accumulate(capture[id(tensor)], seed)
+        return
+    if root.released:
+        raise RuntimeError(
+            "Trying to backward through the graph a second time, but the "
+            "graph buffers have already been released. Specify "
+            "retain_graph=True on the first backward call.")
+    if grad_tensor is None:
+        seed = grad_tensor = jnp.ones_like(tensor._value)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if capture is not None and id(tensor) in capture:
+        capture[id(tensor)] = _accumulate(capture[id(tensor)], seed)
+
+    # cotangents pending per node, keyed by id(node), a list per output index
+    pending = {id(root): [None] * root.n_outputs}
+    pending[id(root)][tensor._out_index] = seed
+
+    order = _toposort(root)
+    for node in reversed(order):
+        cots = pending.pop(id(node), None)
+        if cots is None or node.released:
+            continue
+        # jax.vjp requires a cotangent for every output; fill zeros.
+        # We need output shapes: vjp_fn handles symbolic zeros poorly, so the
+        # dispatcher stores output avals on the node via a closure default.
+        full = tuple(c if c is not None else z for c, z in zip(cots, node._zero_cots()))
+        in_grads = node.vjp_fn(full)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or not isinstance(t, Tensor):
+                continue
+            if getattr(g, "dtype", None) == jax.dtypes.float0:
+                continue
+            if capture is not None and id(t) in capture:
+                capture[id(t)] = _accumulate(capture[id(t)], g)
+            if t.stop_gradient:
+                continue
+            n = getattr(t, "_grad_node", None)
+            if n is None:
+                if capture is None:
+                    # leaf: accumulate into .grad
+                    t._grad_value = _accumulate(t._grad_value, g)
+            else:
+                lst = pending.setdefault(id(n), [None] * n.n_outputs)
+                lst[t._out_index] = _accumulate(lst[t._out_index], g)
+        if not retain_graph:
+            node.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         allow_unused=True):
+    """Functional gradient query, parity with ``paddle.grad``.
+
+    Implemented by running the tape backward and reading leaf grads without
+    mutating ``.grad`` on parameters (grads are captured and restored).
+    """
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    capture = {id(t): None for t in inputs}
+    for i, o in enumerate(outputs):
+        g = None if grad_outputs is None else grad_outputs[i]
+        backward(o, g, retain_graph=retain_graph or create_graph, capture=capture)
+    results = []
+    for t in inputs:
+        got = capture[id(t)]
+        if got is None:
+            if not allow_unused:
+                raise ValueError("an input tensor is unused in the graph")
+            results.append(None)
+        else:
+            results.append(Tensor(got, stop_gradient=True))
+    return results

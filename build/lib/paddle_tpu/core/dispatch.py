@@ -1,0 +1,137 @@
+"""Op dispatch: the Phi-dispatcher equivalent.
+
+In the reference, every op goes pybind → generated dygraph forward →
+``KernelFactory::SelectKernel`` → CUDA kernel (SURVEY.md §3.3,
+paddle/phi/core/kernel_factory.cc). Here "selecting a kernel" means tracing a
+jax function: XLA is the kernel library. :func:`apply` is the single funnel —
+it unwraps Tensors, runs (or vjp-records) the jax function, and wraps outputs.
+
+Pallas kernels register through the same funnel: an op's ``fn`` may internally
+branch to a Pallas call on TPU (see paddle_tpu.ops).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from ..flags import flag_value
+from ..profiler.record import RecordEvent, host_recorder
+
+
+def _is_tensor(x) -> bool:
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def unwrap(x):
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+# AMP hook: paddle_tpu.amp installs a caster here (op_name, vals) -> vals.
+# Kept as a mutable slot so the dispatcher has no import-time dependency on amp.
+amp_cast_hook = None
+
+
+def apply(fn: Callable, *args, op_name: str = "op", n_outputs: int = None, **static):
+    """Run ``fn(*arrays, **static)`` over Tensor args with tape recording.
+
+    Positional args may be Tensors, jax arrays, or python scalars (scalars are
+    passed through untraced w.r.t. grad). Returns Tensor(s) mirroring fn's
+    output structure (a single array or a tuple of arrays).
+    """
+    # Profiler hook (reference: RecordEvent inside eager op dispatch,
+    # SURVEY.md §5.1) — armed only during a capture window.
+    if host_recorder.enabled:
+        with RecordEvent(op_name, "Operator"):
+            return _apply_impl(fn, args, op_name, static)
+    return _apply_impl(fn, args, op_name, static)
+
+
+def _apply_impl(fn: Callable, args, op_name: str, static):
+    from .tensor import Tensor
+
+    if amp_cast_hook is not None:
+        args = amp_cast_hook(op_name, args)
+    vals = tuple(unwrap(a) for a in args)
+    tensor_inputs = [a for a in args if _is_tensor(a)]
+    needs_grad = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_inputs
+    )
+
+    if not needs_grad:
+        out = fn(*vals, **static)
+        if flag_value("check_nan_inf"):
+            _check_nan_inf(op_name,
+                           out if isinstance(out, (tuple, list)) else (out,))
+        return _wrap_outputs(out, stop_gradient=True)
+
+    # Differentiate only w.r.t. Tensor positional args; close over the rest.
+    tensor_pos = [i for i, a in enumerate(args) if _is_tensor(a)]
+    tensor_vals = tuple(vals[i] for i in tensor_pos)
+
+    def closed(*tvals):
+        full = list(vals)
+        for i, v in zip(tensor_pos, tvals):
+            full[i] = v
+        return fn(*full, **static)
+
+    out_vals, vjp_fn = jax.vjp(closed, *tensor_vals)
+    is_tuple = isinstance(out_vals, (tuple, list))
+    outs = tuple(out_vals) if is_tuple else (out_vals,)
+    avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+
+    def vjp_adapter(cots):
+        c = tuple(cots) if is_tuple else cots[0]
+        return vjp_fn(c)
+
+    node = autograd.GradNode(vjp_adapter, tensor_inputs, avals, name=op_name)
+    wrapped = tuple(
+        Tensor(o, stop_gradient=False, _grad_node=node, _out_index=i)
+        for i, o in enumerate(outs)
+    )
+    result = wrapped if is_tuple else wrapped[0]
+    if flag_value("check_nan_inf"):
+        _check_nan_inf(op_name, outs)
+    return result
+
+
+def _wrap_outputs(out, stop_gradient: bool):
+    from .tensor import Tensor
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+# nan/inf checker policy, configured by paddle_tpu.amp.debugging
+nan_inf_abort = [True]          # False: report (log) instead of raising
+nan_inf_skip_ops: set = set()   # op names excluded from the scan
+nan_inf_check_ops: set = set()  # when non-empty, ONLY these ops are scanned
+
+
+def _check_nan_inf(op_name: str, outs: Sequence[Any]) -> None:
+    """Debug pass: reference FLAGS_check_nan_inf / nan_inf_utils_detail.cc
+    (SURVEY.md §5.2). Host-side check; only valid outside jit (for values
+    inside compiled fns use amp.debugging.checkify_wrap)."""
+    if op_name in nan_inf_skip_ops:
+        return
+    if nan_inf_check_ops and op_name not in nan_inf_check_ops:
+        return
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer):
+            return  # under trace: skip (use checkify-style tools instead)
+        if jnp.issubdtype(o.dtype, jnp.floating):
+            bad = ~jnp.isfinite(o)
+            if bool(jnp.any(bad)):
+                msg = f"nan/inf detected in output {i} of op '{op_name}'"
+                if nan_inf_abort[0]:
+                    raise FloatingPointError(msg)
+                import logging
+                logging.getLogger("paddle_tpu.debugging").warning(msg)
+                return

@@ -1,0 +1,75 @@
+"""Data types.
+
+Parity with the reference's dtype surface (paddle/phi/common/data_type.h,
+python/paddle — `paddle.float32` etc., see SURVEY.md §2.1). Dtypes are jax/numpy
+dtypes directly; this module provides the paddle-shaped names and helpers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {uint8, int8, int16, int32, int64}
+
+_default_dtype = [jnp.float32]
+
+
+def convert_dtype(dtype) -> "np.dtype":
+    """Normalise str/np/jnp dtype spellings to a canonical numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        dtype = _STR_TO_DTYPE[dtype]
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def set_default_dtype(d) -> None:
+    _default_dtype[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype[0]

@@ -1,0 +1,736 @@
+"""Public op surface (the ``paddle.*`` tensor-math namespace).
+
+Kernel-library equivalent of the reference's Phi op corpus
+(paddle/phi/kernels/{cpu,gpu}/, python/paddle/tensor/{math,linalg,manipulation,
+logic,search,stat}.py — SURVEY.md §2.1). Every op funnels through
+``dispatch.apply`` so it is tape-recorded, jit-traceable, and XLA-lowered.
+
+Paddle calling conventions are preserved (``axis`` kwargs, ``keepdim``,
+``transpose_x/transpose_y`` on matmul, list-of-sections ``split`` …).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply, unwrap
+from .dtype import convert_dtype
+from .tensor import Tensor
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply(fn, _t(x) if not _scalar(x) else x,
+                     _t(y) if not _scalar(y) else y, op_name=name_)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def _scalar(x):
+    return isinstance(x, (int, float, bool, complex))
+
+
+add = _binary("add", lambda x, y: jnp.add(x, y))
+subtract = _binary("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binary("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _binary("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binary("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+remainder = _binary("remainder", lambda x, y: jnp.remainder(x, y))
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", lambda x, y: jnp.power(x, y))
+maximum = _binary("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binary("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = maximum
+fmin = minimum
+atan2 = _binary("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binary("hypot", lambda x, y: jnp.hypot(x, y))
+
+logical_and = _binary("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _binary("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _binary("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _binary("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _binary("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+
+equal = _binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binary("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _binary("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _binary("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _binary("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _binary("less_equal", lambda x, y: jnp.less_equal(x, y))
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply(fn, _t(x), op_name=name_)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+square = _unary("square", jnp.square)
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+logical_not = _unary("logical_not", jnp.logical_not)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if bias_after_scale:
+        out = apply(lambda v: v * scale + bias, _t(x), op_name="scale")
+    else:
+        out = apply(lambda v: (v + bias) * scale, _t(x), op_name="scale")
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if isinstance(min, Tensor) else min
+    hi = unwrap(max) if isinstance(max, Tensor) else max
+    return apply(lambda v: jnp.clip(v, lo, hi), _t(x), op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), _t(x), _t(y), op_name="lerp")
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduction(name, fn, has_dtype=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        if has_dtype and dtype is not None:
+            d = convert_dtype(dtype)
+            return apply(lambda v: fn(v.astype(d), axis=ax, keepdims=keepdim),
+                         _t(x), op_name=name_)
+        return apply(lambda v: fn(v, axis=ax, keepdims=keepdim), _t(x), op_name=name_)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+sum = _reduction("sum", jnp.sum, has_dtype=True)
+mean = _reduction("mean", jnp.mean, has_dtype=True)
+prod = _reduction("prod", jnp.prod, has_dtype=True)
+max = _reduction("max", jnp.max)
+min = _reduction("min", jnp.min)
+amax = max
+amin = min
+all = _reduction("all", jnp.all)
+any = _reduction("any", jnp.any)
+logsumexp = _reduction("logsumexp", jax.scipy.special.logsumexp)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim),
+                 _t(x), op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim),
+                 _t(x), op_name="var")
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return apply(lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim).astype(d),
+                 _t(x), op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return apply(lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim).astype(d),
+                 _t(x), op_name="argmin")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=convert_dtype(dtype))
+        return jnp.cumsum(v, axis=axis, dtype=convert_dtype(dtype))
+    return apply(fn, _t(x), op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda v: jnp.cumprod(v, axis=dim, dtype=convert_dtype(dtype)),
+                 _t(x), op_name="cumprod")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim),
+                 _t(x), op_name="count_nonzero")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.median(v, axis=axis, keepdims=keepdim),
+                 _t(x), op_name="median")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.quantile(v, q, axis=axis, keepdims=keepdim),
+                 _t(x), op_name="quantile")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        idx = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sorted_v, k - 1, axis=axis)
+        inds = jnp.take(idx, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds.astype(jnp.int64)
+    return apply(fn, _t(x), op_name="kthvalue")
+
+
+# ---------------------------------------------------------------------------
+# matmul / linalg
+# ---------------------------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(fn, _t(x), _t(y), op_name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, _t(x), _t(y), op_name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y), op_name="dot")
+
+
+def outer(x, y, name=None):
+    return apply(jnp.outer, _t(x), _t(y), op_name="outer")
+
+
+def t(x, name=None):
+    return apply(lambda v: v.T, _t(x), op_name="t")
+
+
+def einsum(equation, *operands):
+    tensors = [_t(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *tensors, op_name="einsum")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+
+    def fn(v):
+        if p in ("fro", 2, 2.0):
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p in (np.inf, "inf", float("inf")):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim), 1.0 / p)
+
+    return apply(fn, _t(x), op_name="norm")
+
+
+def matmul_nt(x, y):
+    """matmul(x, y.T) convenience used by parallel layers."""
+    return matmul(x, y, transpose_y=True)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+def reshape(x, shape, name=None):
+    shape = [int(s) for s in (shape.tolist() if isinstance(shape, (Tensor, np.ndarray)) else shape)]
+    return apply(lambda v: jnp.reshape(v, shape), _t(x), op_name="reshape")
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), _t(x), op_name="transpose")
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _norm_axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def fn(v):
+        if ax is None:
+            return jnp.squeeze(v)
+        keep = [a for a in ax if v.shape[a] == 1]
+        return jnp.squeeze(v, axis=tuple(keep)) if keep else v
+
+    return apply(fn, _t(x), op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _norm_axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    def fn(v):
+        for a in sorted(ax):
+            v = jnp.expand_dims(v, a)
+        return v
+    return apply(fn, _t(x), op_name="unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return apply(fn, _t(x), op_name="flatten")
+
+
+def concat(x: Sequence, axis=0, name=None):
+    tensors = [_t(e) for e in x]
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *tensors, op_name="concat")
+
+
+def stack(x: Sequence, axis=0, name=None):
+    tensors = [_t(e) for e in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *tensors, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+
+    def fn(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        sections = list(num_or_sections)
+        total = v.shape[axis]
+        known = builtins.sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else total - known for s in sections]
+        idx = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(v, idx, axis=axis))
+
+    return list(apply(fn, _t(x), op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = _t(x).shape[axis]
+    return list(apply(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+                      _t(x), op_name="unbind"))
+
+
+def tile(x, repeat_times, name=None):
+    reps = [int(r) for r in repeat_times]
+    return apply(lambda v: jnp.tile(v, reps), _t(x), op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = [int(s) for s in shape]
+
+    def fn(v):
+        tgt = list(shape)
+        src = list(v.shape)
+        # paddle expand: -1 keeps dim
+        off = len(tgt) - len(src)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = src[i - off] if i >= off else 1
+        return jnp.broadcast_to(v, tgt)
+
+    return apply(fn, _t(x), op_name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, _t(y).shape)
+
+
+def flip(x, axis, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda v: jnp.flip(v, axis=ax), _t(x), op_name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), _t(x), op_name="roll")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return apply(lambda v: jnp.repeat(v, repeats, axis=axis), _t(x), op_name="repeat_interleave")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), _t(x), op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), _t(x), op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v), k=offset) == 0
+                out = jnp.where(mask, padding_value, out)
+            return out
+        return jnp.diagonal(v, offset=offset)
+    return apply(fn, _t(x), op_name="diag")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x), op_name="diagonal")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _t(x), _t(y), op_name="kron")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), _t(x), op_name="moveaxis")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError("as_strided has no XLA analog; use reshape/slice ops")
+
+
+# ---------------------------------------------------------------------------
+# indexing / search
+# ---------------------------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis),
+                 _t(x), _t(index), op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        idx = idx.astype(jnp.int32)
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply(fn, _t(x), _t(index), op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+    return apply(fn, _t(x), _t(index), _t(updates), op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(fn, _t(x), _t(index), _t(updates), op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    def fn(v, i):
+        i = i.astype(jnp.int32)
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i]
+    return apply(fn, _t(x), _t(index), op_name="index_sample")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+                 _t(arr), _t(indices), op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        idx = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(v.ndim)])
+               for d, s in enumerate(i.shape)]
+        idx[axis] = i
+        if reduce == "add":
+            return v.at[tuple(idx)].add(u)
+        if reduce == "multiply":
+            return v.at[tuple(idx)].multiply(u)
+        return v.at[tuple(idx)].set(u)
+    return apply(fn, _t(arr), _t(indices), _t(values), op_name="put_along_axis")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply(lambda c, a, b: jnp.where(c, a, b), _t(condition), _t(x), _t(y),
+                 op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: host-side only (parity with reference's CPU sync)
+    v = np.asarray(unwrap(_t(x)))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(z.astype(np.int64)) for z in nz)
+    return Tensor(np.stack(nz, axis=-1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    v = np.asarray(unwrap(_t(x)))
+    m = np.asarray(unwrap(_t(mask))).astype(bool)
+    return Tensor(v[m])
+
+
+def masked_fill(x, mask, value, name=None):
+    val = unwrap(value) if isinstance(value, Tensor) else value
+    return apply(lambda v, m: jnp.where(m, val, v), _t(x), _t(mask), op_name="masked_fill")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return apply(fn, _t(x), op_name="topk")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        s = jnp.sort(v, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(fn, _t(x), op_name="sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        i = jnp.argsort(v, axis=axis)
+        i = jnp.flip(i, axis=axis) if descending else i
+        return i.astype(jnp.int64)
+    return apply(fn, _t(x), op_name="argsort")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+                 _t(sorted_sequence), _t(values), op_name="searchsorted")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    v = np.asarray(unwrap(_t(x)))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes),
+                 _t(x), op_name="one_hot")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply(lambda v, w: jnp.bincount(v.astype(jnp.int32), w, minlength=minlength,
+                                               length=None),
+                     _t(x), _t(weights), op_name="bincount")
+    v = np.asarray(unwrap(_t(x)))
+    return Tensor(np.bincount(v, minlength=minlength))
+
+
+# ---------------------------------------------------------------------------
+# comparisons returning scalars / misc
+# ---------------------------------------------------------------------------
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 _t(x), _t(y), op_name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 _t(x), _t(y), op_name="isclose")
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y), op_name="equal_all")
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda v: v + value, _t(x), op_name="increment")
+    if isinstance(x, Tensor):
+        x._replace(out)
+        return x
+    return out
+
+
+def assign(x, output=None):
+    src = _t(x)
+    out = apply(lambda v: v + 0, src, op_name="assign")
+    if output is not None:
+        output._replace(out)
+        return output
+    return out
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(_t(x).size))
+
+
+def shape(x):
+    return Tensor(np.asarray(_t(x).shape, dtype=np.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def iinfo(dtype):
+    return np.iinfo(np.dtype(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    d = convert_dtype(dtype)
+    return jnp.finfo(d)
+
+
+# ---------------------------------------------------------------------------
+# install Tensor methods + operators
+# ---------------------------------------------------------------------------
+_METHODS = dict(
+    add=add, subtract=subtract, multiply=multiply, divide=divide, pow=pow,
+    matmul=matmul, mm=mm, bmm=bmm, dot=dot, t=t, floor_divide=floor_divide,
+    remainder=remainder, mod=mod, maximum=maximum, minimum=minimum,
+    exp=exp, log=log, sqrt=sqrt, rsqrt=rsqrt, abs=abs, floor=floor, ceil=ceil,
+    round=round, sin=sin, cos=cos, tan=tan, tanh=tanh, sigmoid=sigmoid, erf=erf,
+    square=square, sign=sign, reciprocal=reciprocal, isnan=isnan, isinf=isinf,
+    isfinite=isfinite, scale=scale, clip=clip, lerp=lerp,
+    sum=sum, mean=mean, prod=prod, max=max, min=min, all=all, any=any,
+    logsumexp=logsumexp, std=std, var=var, argmax=argmax, argmin=argmin,
+    cumsum=cumsum, cumprod=cumprod, median=median,
+    reshape=reshape, transpose=transpose, squeeze=squeeze, unsqueeze=unsqueeze,
+    flatten=flatten, split=split, chunk=chunk, unbind=unbind, tile=tile,
+    expand=expand, expand_as=expand_as, broadcast_to=broadcast_to, flip=flip,
+    roll=roll, repeat_interleave=repeat_interleave, tril=tril, triu=triu,
+    gather=gather, gather_nd=gather_nd, scatter=scatter, index_select=index_select,
+    take_along_axis=take_along_axis, put_along_axis=put_along_axis,
+    masked_fill=masked_fill, masked_select=masked_select, where=where,
+    nonzero=nonzero, topk=topk, sort=sort, argsort=argsort, unique=unique,
+    allclose=allclose, isclose=isclose, equal_all=equal_all, equal=equal,
+    not_equal=not_equal, greater_than=greater_than, greater_equal=greater_equal,
+    less_than=less_than, less_equal=less_equal, logical_and=logical_and,
+    logical_or=logical_or, logical_xor=logical_xor, logical_not=logical_not,
+    norm=norm, one_hot=one_hot, moveaxis=moveaxis, diagonal=diagonal,
+    count_nonzero=count_nonzero, kthvalue=kthvalue, bincount=bincount,
+)
+
+for _name, _fn in _METHODS.items():
+    setattr(Tensor, _name, _fn)
+
+Tensor.__add__ = lambda self, o: add(self, o)
+Tensor.__radd__ = lambda self, o: add(o, self)
+Tensor.__sub__ = lambda self, o: subtract(self, o)
+Tensor.__rsub__ = lambda self, o: subtract(o, self)
+Tensor.__mul__ = lambda self, o: multiply(self, o)
+Tensor.__rmul__ = lambda self, o: multiply(o, self)
+Tensor.__truediv__ = lambda self, o: divide(self, o)
+Tensor.__rtruediv__ = lambda self, o: divide(o, self)
+Tensor.__floordiv__ = lambda self, o: floor_divide(self, o)
+Tensor.__mod__ = lambda self, o: remainder(self, o)
+Tensor.__pow__ = lambda self, o: pow(self, o)
+Tensor.__rpow__ = lambda self, o: pow(o, self)
+Tensor.__matmul__ = lambda self, o: matmul(self, o)
+Tensor.__neg__ = lambda self: neg(self)
+Tensor.__abs__ = lambda self: abs(self)
+Tensor.__invert__ = lambda self: logical_not(self)
+Tensor.__eq__ = lambda self, o: equal(self, o)
+Tensor.__ne__ = lambda self, o: not_equal(self, o)
+Tensor.__gt__ = lambda self, o: greater_than(self, o)
+Tensor.__ge__ = lambda self, o: greater_equal(self, o)
+Tensor.__lt__ = lambda self, o: less_than(self, o)
+Tensor.__le__ = lambda self, o: less_equal(self, o)
+Tensor.__and__ = lambda self, o: logical_and(self, o)
+Tensor.__or__ = lambda self, o: logical_or(self, o)
+Tensor.__xor__ = lambda self, o: logical_xor(self, o)

@@ -1,0 +1,52 @@
+"""Native (C++) runtime components, built on demand.
+
+The reference keeps its runtime plumbing in C++ (SURVEY.md §2.7); here the
+pieces that remain host-side (rendezvous store, …) are C++ compiled lazily
+with g++ into a per-repo build dir and loaded via ctypes. Every native
+component has a pure-Python fallback so the framework works without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_lock = threading.Lock()
+
+
+def ensure_built(stem: str) -> str | None:
+    """Compile ``<stem>.cc`` into ``_build/lib<stem>.so`` (cached by mtime).
+
+    Returns the .so path, or None when no C++ toolchain is available or the
+    build fails (callers fall back to Python implementations).
+    """
+    src = os.path.join(_HERE, stem + ".cc")
+    out = os.path.join(_BUILD_DIR, "lib" + stem + ".so")
+    if not os.path.exists(src):
+        return None
+    with _lock:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        gxx = shutil.which("g++")
+        if gxx is None:
+            return None
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        # per-pid temp + atomic replace: concurrent processes may race to
+        # build (single-host multi-process launch); last writer wins cleanly
+        tmp = f"{out}.tmp.{os.getpid()}"
+        cmd = [gxx, "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+               src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
+        except (subprocess.SubprocessError, OSError):
+            if os.path.exists(out):  # another process won the race
+                return out
+            return None
+        return out

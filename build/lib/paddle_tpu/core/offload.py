@@ -1,0 +1,88 @@
+"""Host-memory offload utilities.
+
+Reference context: the recompute_hybrid offload option and the CUDA
+pinned-memory staging in the allocator stack (SURVEY.md §2.4 recompute
+row, §2.7 #11). On TPU, XLA owns HBM; what the framework controls is
+*placement*: arrays can live in the host-side pinned buffer
+(``memory_kind="pinned_host"``) and stream back over PCIe when needed —
+activation offload for long-sequence training, optimizer-state offload
+for memory-bound fine-tuning.
+
+CPU backend has no memory kinds; there the offload degrades to a host
+numpy copy (still releases the "device" buffer), keeping tests and the
+API portable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _memory_kind_supported(device) -> bool:
+    try:
+        return any(m.kind == "pinned_host"
+                   for m in device.addressable_memories())
+    except Exception:
+        return False
+
+
+def offload_to_host(x):
+    """Move an array/Tensor to host memory, releasing its HBM footprint.
+
+    TPU: device_put onto the pinned_host memory space of the same device
+    (stays addressable by later device_puts without re-pinning).
+    CPU/fallback: materialise to numpy.
+    """
+    v = x._value if isinstance(x, Tensor) else x
+    if isinstance(v, jax.Array):
+        dev = list(v.devices())[0]
+        if _memory_kind_supported(dev):
+            sharding = v.sharding.with_memory_kind("pinned_host")
+            out = jax.device_put(v, sharding)
+        else:
+            # copy=True: np.asarray may alias the device buffer on the CPU
+            # backend, and delete() below frees it
+            out = np.array(v, copy=True)
+            v.delete()
+    else:
+        out = np.asarray(v)
+    if isinstance(x, Tensor):
+        # numpy fallback stays host-side until reload_to_device; Tensor ops
+        # on it would transparently re-device via jnp.asarray
+        x._value = out
+        return x
+    return out
+
+
+def reload_to_device(x, sharding: Optional[Any] = None):
+    """Bring an offloaded array back to device HBM (optionally with a
+    target sharding)."""
+    v = x._value if isinstance(x, Tensor) else x
+    if isinstance(v, jax.Array) and sharding is None:
+        try:
+            sharding = v.sharding.with_memory_kind("device")
+        except Exception:
+            sharding = None
+    out = jax.device_put(v, sharding) if sharding is not None \
+        else jax.device_put(v)
+    if isinstance(x, Tensor):
+        x._value = out
+        return x
+    return out
+
+
+def offload_checkpoint_policy():
+    """jax.checkpoint policy offloading matmul results to host instead of
+    rematerialising them — the activation-offload variant of recompute
+    (reference: recompute_hybrid(offload=True)). Falls back to plain
+    dots-saveable when the offload policy is unavailable."""
+    cp = jax.checkpoint_policies
+    try:
+        return cp.offload_dot_products_saveable
+    except AttributeError:
+        return cp.dots_saveable

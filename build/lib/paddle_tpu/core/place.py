@@ -1,0 +1,108 @@
+"""Device identity ("Place").
+
+TPU-native rebuild of the reference's Place/device abstraction
+(paddle/phi/common/place.h, paddle/fluid/pybind/place.cc — SURVEY.md §2.1).
+The north-star asked for an ``XLAPlace`` beside ``CUDAPlace``; here the whole
+framework is the XLA backend, so ``TPUPlace`` (aliased ``XLAPlace``) is the
+accelerator place and maps onto a ``jax.Device``. A Place may also carry the
+notion of "current mesh" implicitly via paddle_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """Base device identity. Equality is by (kind, index)."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self._device_id})"
+
+    # -- jax bridge ---------------------------------------------------------
+    def jax_device(self) -> Optional[jax.Device]:
+        devs = [d for d in jax.devices() if _platform_of(d) == self.kind]
+        if not devs:
+            devs = jax.devices()  # fall back to whatever the host has
+        return devs[self._device_id % len(devs)]
+
+
+def _platform_of(dev: jax.Device) -> str:
+    p = dev.platform
+    return {"cpu": "cpu", "tpu": "tpu", "gpu": "gpu"}.get(p, p)
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+# The north-star name: an XLA-backed accelerator place.
+XLAPlace = TPUPlace
+
+
+class CUDAPlace(Place):
+    """Accepted for API compatibility; resolves to whatever accelerator exists."""
+
+    kind = "gpu"
+
+
+_current_place: list = [None]
+
+
+@functools.lru_cache(maxsize=None)
+def _default_place() -> Place:
+    platforms = {d.platform for d in jax.devices()}
+    if "tpu" in platforms:
+        return TPUPlace(0)
+    if "gpu" in platforms:
+        return CUDAPlace(0)
+    return CPUPlace(0)
+
+
+def set_device(device: str) -> Place:
+    """``set_device("tpu")`` / ``"tpu:0"`` / ``"cpu"`` — parity with paddle.set_device."""
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    cls = {"cpu": CPUPlace, "tpu": TPUPlace, "xla": TPUPlace, "gpu": CUDAPlace}.get(name)
+    if cls is None:
+        raise ValueError(f"Unknown device {device!r}")
+    _current_place[0] = cls(idx)
+    return _current_place[0]
+
+
+def get_device() -> str:
+    p = _current_place[0] or _default_place()
+    return f"{p.kind}:{p.get_device_id()}"
+
+
+def current_place() -> Place:
+    return _current_place[0] or _default_place()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())

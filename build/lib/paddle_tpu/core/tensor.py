@@ -1,0 +1,240 @@
+"""The framework Tensor: a thin imperative wrapper over ``jax.Array``.
+
+Rebuild of the reference's DenseTensor + eager Tensor surface
+(paddle/phi/core/dense_tensor.cc, paddle/fluid/pybind/eager_method.cc —
+SURVEY.md §2.1). Storage IS a jax.Array (or a tracer under jit); autograd
+metadata (``stop_gradient``, ``.grad``, grad-node edge) lives on this wrapper,
+mirroring AutogradMeta.
+
+Paddle semantics preserved:
+ - fresh tensors default ``stop_gradient=True``; Parameters default False.
+ - ``.backward()`` accumulates into ``.grad`` on leaves.
+ - ``.shape`` is a python list; ``.numpy()`` materialises to host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .place import Place, current_place
+from .dtype import convert_dtype
+
+_tensor_count = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad_node",
+        "_out_index",
+        "_grad_value",
+        "name",
+        "persistable",
+        "_sharding_spec",
+        "is_distributed",
+        "__weakref__",
+    )
+
+    def __init__(
+        self,
+        value,
+        stop_gradient: bool = True,
+        name: Optional[str] = None,
+        _grad_node=None,
+        _out_index: int = 0,
+    ):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad_node = _grad_node
+        self._out_index = _out_index
+        self._grad_value = None
+        if name is None:
+            _tensor_count[0] += 1
+            name = f"generated_tensor_{_tensor_count[0]}"
+        self.name = name
+        self.persistable = False
+        self._sharding_spec = None  # PartitionSpec hint for pjit paths
+        self.is_distributed = False
+
+    # -- basic meta ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        return current_place()
+
+    def numel(self) -> int:
+        return self.size
+
+    # -- host bridge --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_value is None:
+            return None
+        return Tensor(self._grad_value, stop_gradient=True, name=self.name + "@GRAD")
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad_value = None
+        else:
+            self._grad_value = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad_value = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name + "@detached")
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .dispatch import apply
+        return apply(lambda x: x + 0, self, op_name="clone")
+
+    # -- dtype/shape sugar (full op surface installed by tensor_methods) ----
+    def astype(self, dtype) -> "Tensor":
+        from .dispatch import apply
+        dtype = convert_dtype(dtype)
+        return apply(lambda x: x.astype(dtype), self, op_name="cast")
+
+    cast = astype
+
+    def _replace(self, new: "Tensor") -> "Tensor":
+        """In-place rebind used by setitem/inplace ops: keep identity, new value."""
+        self._value = new._value
+        self._grad_node = new._grad_node
+        self._out_index = new._out_index
+        self.stop_gradient = new.stop_gradient
+        return self
+
+    def __getitem__(self, idx) -> "Tensor":
+        from .dispatch import apply
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        from .dispatch import apply
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = apply(
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)), self, value,
+                op_name="setitem",
+            )
+        else:
+            out = apply(lambda x: x.at[idx].set(value), self, op_name="setitem")
+        self._replace(out)
+
+    def __repr__(self):
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=6, separator=", ")
+        except Exception:
+            body = repr(self._value)  # tracer
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+            f"stop_gradient={self.stop_gradient},\n       {body})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor; ``stop_gradient=False`` by default (reference:
+    python/paddle — framework Parameter; SURVEY.md §2.1 AutogradMeta)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed_param", "expert", "is_sequence_parallel",
+                 "main_grad")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed_param = False
+        self.expert = False  # expert-parallel param (MoE): excluded from dp sync
+        self.is_sequence_parallel = False  # SP-marked (grad allreduced over mp)
+        self.main_grad = None  # fp32 accumulation buffer (mix_precision_utils)
+
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        self._value = v.astype(self._value.dtype) if hasattr(v, "astype") else v

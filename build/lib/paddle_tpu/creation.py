@@ -1,0 +1,202 @@
+"""Tensor creation ops (``paddle.to_tensor``, ``zeros``, ``rand`` …).
+
+Parity with python/paddle/tensor/creation.py + random.py of the reference
+(SURVEY.md §2.1 op corpus). Random ops draw from the framework PRNG state
+(paddle_tpu.random), so ``paddle_tpu.seed`` makes runs reproducible and the
+jit machinery can thread traced keys through.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply, unwrap
+from .core.dtype import convert_dtype, get_default_dtype
+from .core.tensor import Tensor, Parameter
+from . import random as _random
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(unwrap(shape)))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        v = data
+    d = convert_dtype(dtype)
+    if d is None and isinstance(v, (list, tuple, int, float)):
+        probe = np.asarray(v)
+        if probe.dtype == np.float64:
+            d = get_default_dtype()
+    arr = jnp.asarray(v, dtype=d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    fill_value = unwrap(fill_value) if isinstance(fill_value, Tensor) else fill_value
+    return Tensor(jnp.full(_shape(shape), fill_value,
+                           dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype)), x,
+                 op_name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype)), x,
+                 op_name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)), x,
+                 op_name="full_like")
+
+
+empty_like = zeros_like
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    end = unwrap(end) if isinstance(end, Tensor) else end
+    step = unwrap(step) if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        d = jnp.int64 if builtins_all_int(start, end, step) else get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def builtins_all_int(*xs) -> bool:
+    return all(isinstance(x, (int, np.integer)) for x in xs)
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num),
+                               dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns),
+                          dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = [x if isinstance(x, Tensor) else Tensor(x) for x in
+               (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *tensors,
+                 op_name="meshgrid")
+    return list(outs)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def fn(v):
+        n = v.shape[-1]
+        out = jnp.zeros(v.shape + (n,), v.dtype)
+        idx = jnp.arange(n)
+        return out.at[..., idx, idx].set(v)
+    return apply(fn, x, op_name="diag_embed")
+
+
+# ---------------------------------------------------------------------------
+# random creation
+# ---------------------------------------------------------------------------
+def rand(shape, dtype=None, name=None) -> Tensor:
+    k = _random.next_key()
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(k, _shape(shape), dtype=d))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    k = _random.next_key()
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(k, _shape(shape), dtype=d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    k = _random.next_key() if seed == 0 else jax.random.key(seed)
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(k, _shape(shape), dtype=d, minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        k = _random.next_key()
+        return Tensor(jax.random.normal(k, shp) * s + m)
+    k = _random.next_key()
+    return Tensor(jax.random.normal(k, _shape(shape)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    k = _random.next_key()
+    return Tensor(jax.random.randint(k, _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    k = _random.next_key()
+    return Tensor(jax.random.permutation(k, int(n)).astype(convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    k = _random.next_key()
+
+    def fn(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement or num_samples == 1:
+            return jax.random.categorical(k, logits, axis=-1,
+                                          shape=v.shape[:-1] + (num_samples,)).astype(jnp.int64)
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(k, v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+
+    return apply(fn, x, op_name="multinomial")
+
+
+def bernoulli(x, name=None) -> Tensor:
+    k = _random.next_key()
+    return apply(lambda v: jax.random.bernoulli(k, v).astype(v.dtype), x,
+                 op_name="bernoulli")
+
+
+def create_parameter(shape, dtype=None, default_initializer=None, is_bias=False,
+                     attr=None, name=None) -> Parameter:
+    from .nn import initializer as I
+    d = convert_dtype(dtype) or get_default_dtype()
+    init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    value = init(_shape(shape), d)
+    return Parameter(value, name=name)

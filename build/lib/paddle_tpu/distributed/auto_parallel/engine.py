@@ -1,0 +1,132 @@
+"""Auto-parallel Engine: fit/evaluate/predict over a sharded model.
+
+Rebuild of python/paddle/distributed/auto_parallel/static/engine.py
+(SURVEY.md §2.4 auto-parallel row). The reference Engine drives the static
+completion → partition → reshard pipeline; on TPU that pipeline IS
+jit + GSPMD, so the Engine here: (1) honours parameter/tensor placements
+installed by ``shard_tensor``/``shard_layer``, (2) compiles one donated
+train step (jit.TrainStep) and reuses it across the epoch loop, (3) keeps
+the reference's fit/evaluate/predict surface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+class Engine:
+    def __init__(self, model: Layer, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self._train_step = None
+        self.history: list = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _loader(self, data, batch_size):
+        from ...io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=False)
+
+    def _build_train_step(self):
+        from ...jit import TrainStep
+        loss_fn = self.loss
+
+        def step_loss(model, *batch):
+            *xs, y = batch
+            out = model(*xs)
+            return loss_fn(out, y)
+
+        self._train_step = TrainStep(self.model, step_loss, self.optimizer)
+
+    # -- public surface (reference Engine) -----------------------------------
+
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
+            steps_per_epoch: Optional[int] = None, log_freq: int = 10,
+            verbose: int = 0):
+        assert self.loss is not None and self.optimizer is not None, \
+            "Engine.fit needs loss and optimizer"
+        if self._train_step is None:
+            self._build_train_step()
+        loader = self._loader(train_data, batch_size)
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self._train_step(*batch)
+                losses.append(float(loss))
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss {losses[-1]:.5f}")
+            self.history.append({"epoch": epoch,
+                                 "loss": float(np.mean(losses))})
+        return self.history
+
+    def evaluate(self, valid_data, batch_size: int = 1,
+                 steps: Optional[int] = None):
+        assert self.loss is not None
+        from ...core import autograd as _ag
+        loader = self._loader(valid_data, batch_size)
+        losses = []
+        with _ag.no_grad():
+            self.model.eval()
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                *xs, y = batch
+                out = self.model(*[x if isinstance(x, Tensor) else Tensor(x)
+                                   for x in xs])
+                losses.append(float(self.loss(out, y if isinstance(y, Tensor)
+                                              else Tensor(y))))
+            self.model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size: int = 1,
+                steps: Optional[int] = None):
+        from ...core import autograd as _ag
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        with _ag.no_grad():
+            self.model.eval()
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                xs = batch[:-1] if len(batch) > 1 else batch
+                out = self.model(*[x if isinstance(x, Tensor) else Tensor(x)
+                                   for x in xs])
+                outs.append(np.asarray(out._value))
+            self.model.train()
+        return outs
+
+
+def shard_layer(layer: Layer, process_mesh, shard_fn: Optional[Callable] = None,
+                input_fn=None, output_fn=None) -> Layer:
+    """Parity with paddle.distributed.shard_layer: place every parameter
+    according to ``shard_fn(name, layer, process_mesh) -> placements`` (or
+    replicate when no fn is given)."""
+    from .api import Replicate, shard_tensor
+
+    for name, param in layer.named_parameters():
+        placements = None
+        if shard_fn is not None:
+            placements = shard_fn(name, layer, process_mesh)
+        if placements is None:
+            placements = [Replicate() for _ in process_mesh.shape]
+        sharded = shard_tensor(param, process_mesh, placements,
+                               stop_gradient=param.stop_gradient)
+        param._value = sharded._value
+        param._sharding_spec = sharded._sharding_spec
+    return layer

@@ -1,0 +1,172 @@
+"""Async checkpointing + full train-state capture.
+
+SURVEY.md §5.4 calls for the TPU equivalent to go beyond the reference:
+"async, multi-host GDA checkpoint with reshard-on-load, plus
+optimizer-state + dataloader-position capture". This module adds:
+
+* ``async_save_state_dict`` — snapshot device arrays to host (blocking
+  only for the device→host copy), then write shard files on a background
+  thread; ``AsyncSaveFuture.result()`` joins. Training resumes while IO
+  runs — the orbax-style async pattern.
+* ``TrainState`` capture/restore — model params, optimizer state, LR
+  scheduler, global step and dataloader position in one state_dict, so an
+  elastic restart (launch controller, SURVEY §3.6) resumes mid-epoch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .save_state_dict import save_state_dict
+
+
+class AsyncSaveFuture:
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.path: Optional[str] = None
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("async checkpoint still writing")
+        if self._exc is not None:
+            raise self._exc
+        return self.path
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+
+_last_save = [None]  # serialize overlapping async saves
+
+
+def async_save_state_dict(state_dict: Dict[str, Any], path: str,
+                          process_group=None, coordinator_rank: int = 0
+                          ) -> AsyncSaveFuture:
+    """Device→host snapshot now; file writes on a background thread.
+
+    A second async save issued while one is in flight waits for the first
+    to *finish* (ordering must be preserved for resume correctness) but
+    does not re-raise its error — that belongs to the caller holding that
+    future, and a failed save must not wedge subsequent ones.
+    """
+    prev = _last_save[0]
+    if prev is not None and prev._thread is not None:
+        prev._thread.join()
+
+    def to_host(v):
+        if isinstance(v, dict):
+            return {k: to_host(x) for k, x in v.items()}
+        if hasattr(v, "_value"):
+            v = v._value
+        return np.asarray(v)  # materialises device→host NOW
+
+    snapshot = to_host(state_dict)
+    fut = AsyncSaveFuture()
+    fut.path = path
+
+    def writer():
+        try:
+            save_state_dict(snapshot, path, process_group=process_group,
+                            coordinator_rank=coordinator_rank)
+        except BaseException as e:  # surfaced at result()
+            fut._exc = e
+
+    fut._thread = threading.Thread(target=writer, daemon=True)
+    fut._thread.start()
+    _last_save[0] = fut
+    return fut
+
+
+def _wrap_leaves(tree):
+    """Checkpoint IO wants Tensor leaves; wrap scalars/arrays (e.g. the
+    optimizer's python-int @step, LR-scheduler floats)."""
+    from ...core.tensor import Tensor
+    if isinstance(tree, dict):
+        return {k: _wrap_leaves(v) for k, v in tree.items()}
+    if isinstance(tree, Tensor):
+        return tree
+    return Tensor(np.asarray(tree))
+
+
+def _unwrap_leaves(tree):
+    """Back to python/numpy scalars for consumers like optimizer
+    set_state_dict (0-d arrays become python scalars)."""
+    from ...core.tensor import Tensor
+    if isinstance(tree, dict):
+        return {k: _unwrap_leaves(v) for k, v in tree.items()}
+    if isinstance(tree, Tensor):
+        v = np.asarray(tree._value)
+        return v.item() if v.ndim == 0 else v
+    return tree
+
+
+class TrainState:
+    """One-call capture/restore of everything resume needs."""
+
+    def __init__(self, model=None, optimizer=None, lr_scheduler=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.lr_scheduler = lr_scheduler
+        self.global_step = 0
+        self.epoch = 0
+        self.batch_in_epoch = 0  # dataloader position
+
+    def step(self, batches: int = 1) -> None:
+        self.global_step += batches
+        self.batch_in_epoch += batches
+
+    def next_epoch(self) -> None:
+        self.epoch += 1
+        self.batch_in_epoch = 0
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "progress": {
+                "global_step": np.asarray(self.global_step, np.int64),
+                "epoch": np.asarray(self.epoch, np.int64),
+                "batch_in_epoch": np.asarray(self.batch_in_epoch, np.int64),
+            }
+        }
+        if self.model is not None:
+            out["model"] = self.model.state_dict()
+        if self.optimizer is not None:
+            out["optimizer"] = self.optimizer.state_dict()
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler,
+                                                     "state_dict"):
+            out["lr_scheduler"] = self.lr_scheduler.state_dict()
+        return _wrap_leaves(out)
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        state = _unwrap_leaves(state)
+        prog = state.get("progress", {})
+        self.global_step = int(prog.get("global_step", 0))
+        self.epoch = int(prog.get("epoch", 0))
+        self.batch_in_epoch = int(prog.get("batch_in_epoch", 0))
+        if self.model is not None and "model" in state:
+            self.model.set_state_dict(state["model"])
+        if self.optimizer is not None and "optimizer" in state:
+            self.optimizer.set_state_dict(state["optimizer"])
+        if self.lr_scheduler is not None and "lr_scheduler" in state and \
+                hasattr(self.lr_scheduler, "set_state_dict"):
+            self.lr_scheduler.set_state_dict(state["lr_scheduler"])
+
+    def skip_batches(self, loader):
+        """Fast-forward a dataloader to the captured mid-epoch position.
+
+        Correct under shuffle only when the sampler's order is a pure
+        function of the epoch: the loader's batch_sampler is pinned to the
+        captured epoch first (RandomSampler/DistributedBatchSampler both
+        derive their permutation from (seed, epoch))."""
+        bs = getattr(loader, "batch_sampler", None)
+        if bs is not None and hasattr(bs, "set_epoch"):
+            bs.set_epoch(self.epoch)
+        it = iter(loader)
+        for _ in range(self.batch_in_epoch):
+            next(it)
+        return it

@@ -1,0 +1,149 @@
+"""Sharded checkpoint load with reshard-on-load.
+
+Rebuild of python/paddle/distributed/checkpoint/load_state_dict.py:§0
+(SURVEY.md §5.4): the saved shard set (from ``.metadata``) is matched against
+the *target* state dict's current shapes/shardings; every saved piece is
+copied into its slice of the target tensor ("ReadItems" in the reference),
+then placed with the target's NamedSharding — so checkpoints written under
+one TP×PP×sharding topology load under any other.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .metadata import Metadata
+from .save_state_dict import _BF16
+from .utils import flatten_state_dict
+
+
+def _read_metadata(path: str, unique_id=None) -> Metadata:
+    files = sorted(glob.glob(os.path.join(path, "*.metadata")))
+    if not files:
+        raise FileNotFoundError(f"no .metadata file under {path!r}")
+
+    def uid_of(f):
+        stem = os.path.basename(f)[: -len(".metadata")]
+        # "{rank}_{uid}" (current) or bare "{uid}" (coordinator-style)
+        return int(stem.rsplit("_", 1)[-1])
+
+    if unique_id is None:
+        unique_id = max(uid_of(f) for f in files)  # latest checkpoint wins
+    files = [f for f in files if uid_of(f) == unique_id]
+    if not files:
+        raise FileNotFoundError(
+            f"no .metadata for unique_id={unique_id} under {path!r}")
+    merged = Metadata()
+    for f in files:
+        with open(f, "rb") as fh:
+            m = pickle.load(fh)
+        # shard lists must EXTEND across ranks (each rank records only the
+        # shards it owns), deduped by offset
+        for key, shards in m.state_dict_metadata.items():
+            have = merged.state_dict_metadata.setdefault(key, [])
+            seen = {s.global_offset for s in have}
+            have.extend(s for s in shards if s.global_offset not in seen)
+        merged.storage_metadata.update(m.storage_metadata)
+        merged.flat_mapping.update(m.flat_mapping)
+        merged.aux.update(getattr(m, "aux", {}))
+    return merged
+
+
+class _DataFiles:
+    """Lazy npz readers, one per data file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: Dict[str, "np.lib.npyio.NpzFile"] = {}
+        self._dtypes: Dict[str, Dict[str, str]] = {}
+
+    def read(self, ref: str) -> np.ndarray:
+        fname, name = ref.split("::", 1)
+        if fname not in self._files:
+            self._files[fname] = np.load(os.path.join(self.path, fname + ".npz"))
+            dt_path = os.path.join(self.path, fname + ".dtypes")
+            with open(dt_path, "rb") as f:
+                self._dtypes[fname] = pickle.load(f)
+        arr = self._files[fname][name]
+        if self._dtypes[fname].get(name) == _BF16:
+            arr = arr.view(jnp.bfloat16)
+        return arr
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    offload: bool = False) -> None:
+    """Fill ``state_dict``'s tensors in place from the checkpoint at
+    ``path``, resharding saved pieces into each target tensor's current
+    global shape and sharding."""
+    meta = _read_metadata(path, unique_id)
+    data = _DataFiles(path)
+    flat, mapping = flatten_state_dict(state_dict)
+    storage = {(i.tensor_key, i.global_offset): ref
+               for i, ref in meta.storage_metadata.items()}
+
+    def _assign_nested(key, value):
+        path_keys = mapping.get(key, (key,))
+        d = state_dict
+        for p in path_keys[:-1]:
+            d = d[p]
+        d[path_keys[-1]] = value
+
+    for key, target in flat.items():
+        if not isinstance(target, Tensor) and not hasattr(target, "shape"):
+            # non-tensor state rides in metadata aux (step counters, lr state)
+            if key in meta.aux:
+                _assign_nested(key, meta.aux[key])
+                continue
+            raise KeyError(f"non-tensor key {key!r} not in checkpoint aux")
+        shards = meta.state_dict_metadata.get(key)
+        if shards is None:
+            raise KeyError(
+                f"{key!r} not found in checkpoint {path!r} "
+                f"(available: {sorted(meta.state_dict_metadata)[:8]}...)")
+        is_tensor = isinstance(target, Tensor)
+        if not is_tensor:
+            # fail fast before any shard IO: in-place fill needs a Tensor
+            raise TypeError(
+                f"load_state_dict target {key!r} must be a Tensor "
+                f"(got {type(target).__name__})")
+        tv = target._value
+        # global shape = max over shards of offset+local_shape
+        ndim = len(shards[0].local_shape)
+        gshape = [0] * ndim
+        for s in shards:
+            for d in range(ndim):
+                gshape[d] = max(gshape[d], s.global_offset[d] + s.local_shape[d])
+        gshape = tuple(gshape)
+        if tuple(tv.shape) != gshape:
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {gshape}, "
+                f"target {tuple(tv.shape)}")
+        # assemble the global array from saved pieces (reshard-on-load:
+        # pieces may come from any source topology)
+        first = data.read(storage[(key, shards[0].global_offset)])
+        out = np.empty(gshape, dtype=first.dtype)
+        for s in shards:
+            piece = data.read(storage[(key, s.global_offset)])
+            idx = tuple(slice(o, o + l)
+                        for o, l in zip(s.global_offset, s.local_shape))
+            out[idx] = piece.reshape(s.local_shape)
+        # place with the target's sharding (this is where the new topology's
+        # partitioning happens — XLA scatters slices to devices). Targets
+        # without an explicit mesh placement stay uncommitted so they keep
+        # composing with any mesh downstream.
+        sharding = getattr(tv, "sharding", None)
+        arr = jnp.asarray(out)
+        if arr.dtype != tv.dtype:
+            arr = arr.astype(tv.dtype)
+        if isinstance(sharding, jax.sharding.NamedSharding) and not offload:
+            arr = jax.device_put(arr, sharding)
+        target._value = arr

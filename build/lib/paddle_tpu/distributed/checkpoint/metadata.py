@@ -1,0 +1,41 @@
+"""Checkpoint metadata schema.
+
+Rebuild of python/paddle/distributed/checkpoint/metadata.py:§0
+(SURVEY.md §5.4 tier 3): a global ``Metadata`` maps every tensor key to the
+list of saved shards (offset + shape + dtype) and each shard to the data file
+holding it — the information load-time resharding needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved shard of a tensor: where it sits in the global tensor."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Key of a saved shard (tensor name + its offset)."""
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # tensor key -> all shards saved for it
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # shard -> file name (relative to checkpoint dir) and array name inside it
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    # original (possibly nested) key -> flat key mapping
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # non-tensor state (step counters, lr-scheduler scalars, …), stored
+    # directly in the metadata pickle
+    aux: Dict[str, object] = field(default_factory=dict)

@@ -1,0 +1,100 @@
+"""Sharded checkpoint save.
+
+Rebuild of python/paddle/distributed/checkpoint/save_state_dict.py:§0
+(SURVEY.md §5.4): each rank writes the shards it owns into its own data file
+plus a global ``.metadata`` describing every shard — load can then reshard to
+any topology. Single-controller jax: "this process" owns every addressable
+shard; replicas are deduped by shard index so a fully-replicated tensor is
+written exactly once. On multi-host deployments each host writes only the
+shards whose first replica it holds (same dedup rule keyed by process index).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .utils import flatten_state_dict, offsets_from_index, to_array
+
+_BF16 = "bfloat16"
+
+
+def _np_payload(arr: np.ndarray):
+    """bf16 arrays round-trip as uint16 views (npz has no bf16)."""
+    if arr.dtype == jax.numpy.bfloat16:
+        return arr.view(np.uint16), _BF16
+    return arr, str(arr.dtype)
+
+
+def save_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None) -> None:
+    """Write ``state_dict`` (possibly nested; values Tensor/jax arrays) as a
+    sharded checkpoint directory: ``<rank>_<id>.distcp`` data files +
+    ``<id>.metadata``."""
+    from .. import env as _env
+    os.makedirs(path, exist_ok=True)
+    rank = _env.get_rank()
+    uid = 0 if unique_id is None else int(unique_id)
+
+    flat, mapping = flatten_state_dict(state_dict)
+    meta = Metadata(flat_mapping=mapping)
+    data_file = f"{rank}_{uid}.distcp"
+    payload: Dict[str, np.ndarray] = {}
+    dtypes: Dict[str, str] = {}
+
+    for key, value in flat.items():
+        v = value._value if isinstance(value, Tensor) else value
+        if not isinstance(value, Tensor) and not hasattr(v, "shape"):
+            # non-tensor state (ints, floats, strings): rides in metadata
+            meta.aux[key] = v
+            continue
+        shards_meta = []
+        if hasattr(v, "addressable_shards") and v.addressable_shards:
+            seen = set()
+            gshape = tuple(v.shape)
+            for shard in v.addressable_shards:
+                # multi-host dedup: a shard is written by the process holding
+                # its first replica; single-controller sees replica 0 of
+                # every shard, so the offset set below also dedups locally
+                if getattr(shard, "replica_id", 0) != 0:
+                    continue
+                off, lshape = offsets_from_index(shard.index, gshape)
+                if off in seen:
+                    continue  # replica of an already-recorded shard
+                seen.add(off)
+                arr = np.asarray(shard.data)
+                name = f"{key}|{'_'.join(map(str, off)) or 'scalar'}"
+                arr2, dt = _np_payload(arr)
+                payload[name] = arr2
+                dtypes[name] = dt
+                lm = LocalTensorMetadata(off, tuple(lshape) or gshape, dt)
+                shards_meta.append(lm)
+                meta.storage_metadata[LocalTensorIndex(key, off)] = \
+                    f"{data_file}::{name}"
+        else:
+            arr = to_array(v)
+            off = tuple([0] * arr.ndim)
+            name = f"{key}|{'_'.join(map(str, off)) or 'scalar'}"
+            arr2, dt = _np_payload(arr)
+            payload[name] = arr2
+            dtypes[name] = dt
+            shards_meta.append(LocalTensorMetadata(off, tuple(arr.shape), dt))
+            meta.storage_metadata[LocalTensorIndex(key, off)] = \
+                f"{data_file}::{name}"
+        meta.state_dict_metadata[key] = shards_meta
+
+    np.savez(os.path.join(path, data_file), **payload)
+    with open(os.path.join(path, f"{data_file}.dtypes"), "wb") as f:
+        pickle.dump(dtypes, f)
+    # every rank writes its own metadata covering the shards it owns; the
+    # loader merges all *.metadata files, so multi-host checkpoints stay
+    # complete without a gather step
+    with open(os.path.join(path, f"{rank}_{uid}.metadata"), "wb") as f:
+        pickle.dump(meta, f)

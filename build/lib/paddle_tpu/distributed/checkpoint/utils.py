@@ -1,0 +1,63 @@
+"""Checkpoint helpers (reference:
+python/paddle/distributed/checkpoint/utils.py:§0)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def flatten_state_dict(state_dict: Dict) -> Tuple[Dict[str, Any], Dict[str, Tuple[str, ...]]]:
+    """Flatten a nested state dict into {joined_key: value}; returns the flat
+    dict and the mapping flat_key -> original key path."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple[str, ...]] = {}
+
+    def rec(prefix: Tuple[str, ...], d):
+        if isinstance(d, dict):
+            for k, v in d.items():
+                rec(prefix + (str(k),), v)
+        else:
+            key = ".".join(prefix)
+            if key in flat:
+                raise ValueError(f"duplicate flat key {key!r}")
+            flat[key] = d
+            mapping[key] = prefix
+    rec((), state_dict)
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any],
+                         mapping: Dict[str, Tuple[str, ...]]) -> Dict:
+    out: Dict = {}
+    for key, value in flat.items():
+        path = mapping.get(key, (key,))
+        d = out
+        for p in path[:-1]:
+            d = d.setdefault(p, {})
+        d[path[-1]] = value
+    return out
+
+
+def to_array(value):
+    """numpy view of a Tensor / jax array / scalar (bf16-safe)."""
+    if isinstance(value, Tensor):
+        value = value._value
+    return np.asarray(value)
+
+
+def offsets_from_index(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """(global_offset, local_shape) from a jax shard ``index`` (tuple of
+    slices over the global shape)."""
+    if not shape:
+        return (), ()
+    offs, lshape = [], []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        offs.append(start)
+        lshape.append(stop - start)
+    return tuple(offs), tuple(lshape)

@@ -1,0 +1,265 @@
+"""Collective communication API — ProcessGroupICI.
+
+Rebuild of the reference's ProcessGroup/ProcessGroupNCCL + Python functional
+collectives (paddle/fluid/distributed/collective/process_group_nccl.cc,
+python/paddle/distributed/communication/* — SURVEY.md §2.3).
+
+TPU-native semantics: a Group is a handle onto a mesh axis. Collectives are
+*program-level* — tiny jitted shard_map programs over the global mesh whose
+ops lower to XLA ICI collectives (psum / all_gather / reduce_scatter /
+ppermute / all_to_all). They operate on GLOBAL arrays (sharded or replicated
+jax values), which is the single-controller analog of the reference's
+per-rank eager tensors. Inside a compiled hybrid step the same axis names are
+used directly via jax.lax collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.tensor import Tensor
+from ..parallel import mesh as _mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = (mesh, axis name). Parity surface of the
+    reference's ``Group`` (python/paddle/distributed/communication/group.py)."""
+
+    def __init__(self, axis: str, mesh=None, ranks: Optional[List[int]] = None):
+        self.axis = axis
+        self.mesh = mesh if mesh is not None else _mesh.get_global_mesh()
+        self._ranks = ranks
+
+    @property
+    def nranks(self) -> int:
+        if self.mesh is None or self.axis not in self.mesh.shape:
+            return 1
+        return self.mesh.shape[self.axis]
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single-controller; per-device rank exists only in-program
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+_WORLD: List[Optional[Group]] = [None]
+
+
+def _world_group() -> Group:
+    m = _mesh.ensure_mesh()
+    if _WORLD[0] is None or _WORLD[0].mesh is not m:
+        _WORLD[0] = Group("dp", m)  # rebuilt whenever the global mesh changes
+    return _WORLD[0]
+
+
+def get_group(group: Optional[Group]) -> Group:
+    return group if group is not None else _world_group()
+
+
+def new_group(ranks=None, backend=None, axis: Optional[str] = None,
+              timeout=None) -> Group:
+    """Reference creates an NCCL ring per group; here groups alias mesh axes.
+    ``axis`` selects the mesh dimension; default 'dp'."""
+    return Group(axis or "dp", _mesh.ensure_mesh(), ranks)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_program(mesh, axis: str, kind: str, in_sharded: bool,
+                    out_sharded: bool, op: str = "sum"):
+    """One compiled shard_map program per (mesh, axis, collective) — eager
+    collectives in a loop must not recompile per call."""
+
+    def make(fn):
+        return jax.jit(shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(axis) if in_sharded else P(),),
+            out_specs=P(axis) if out_sharded else P(),
+            check_vma=False))
+
+    if kind == "all_reduce":
+        red = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+               "avg": lax.pmean}[op]
+        return make(lambda x: red(x, axis))
+    if kind == "all_gather_tiled":
+        return make(lambda x: lax.all_gather(x, axis, tiled=True))
+    if kind == "all_gather_stacked":
+        return make(lambda x: lax.all_gather(x, axis, tiled=False))
+    if kind == "reduce_scatter":
+        return make(lambda x: lax.psum_scatter(x, axis, scatter_dimension=0,
+                                               tiled=True))
+    if kind == "alltoall":
+        n = mesh.shape[axis]
+
+        def fn(x):
+            xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+            return lax.all_to_all(xs, axis, split_axis=0, concat_axis=0,
+                                  tiled=False).reshape(x.shape)
+        return make(fn)
+    raise ValueError(kind)
+
+
+# ---------------------------------------------------------------------------
+# collectives on global arrays
+# ---------------------------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """All-reduce a *replicated-per-rank view*: the global tensor is treated
+    as stacked per-rank slabs along dim 0 (reference semantics: every rank
+    holds one tensor). For a tensor NOT stacked per-rank, this reduces the
+    dim-0 shards. Result replaces the tensor in-place (paddle semantics)."""
+    g = get_group(group)
+    v = _unwrap(tensor)
+    if g.nranks == 1:
+        return tensor
+    if op not in ("sum", "max", "min", "avg"):
+        raise ValueError(f"unsupported reduce op {op}")
+    out = _cached_program(g.mesh, g.axis, "all_reduce", True, True, op)(v)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def all_reduce_replicated(value, op=ReduceOp.SUM,
+                          group: Optional[Group] = None):
+    """Reduce a REPLICATED array over the group: every device contributes
+    its (identical, under one controller) copy — result = nranks * value for
+    sum. This is the per-rank-tensor all_reduce without the dim-0 slab view;
+    flat fused-grad buffers need it because their dim 0 packs many params
+    and must not be sharded."""
+    g = get_group(group)
+    v = _unwrap(value)
+    if g.nranks == 1:
+        return v
+    return _cached_program(g.mesh, g.axis, "all_reduce", False, False, op)(v)
+
+
+def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
+               sync_op=True):
+    """Two calling conventions (paddle): all_gather(list, tensor) fills the
+    list; or all_gather(tensor, group=g) returns the gathered tensor when the
+    first arg is a Tensor."""
+    g = get_group(group)
+    if tensor is None or isinstance(tensor_list, Tensor):
+        src = tensor_list if isinstance(tensor_list, Tensor) else tensor
+        v = _unwrap(src)
+        out = _cached_program(g.mesh, g.axis, "all_gather_tiled", True, False)(v)
+        return Tensor(out)
+
+    v = _unwrap(tensor)
+    gathered = _cached_program(g.mesh, g.axis, "all_gather_stacked", False, False)(v)
+    tensor_list.clear()
+    for i in range(g.nranks):
+        tensor_list.append(Tensor(gathered[i]))
+    return tensor_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    """Each rank holds the (replicated) input tensor; the output is the
+    summed tensor scattered along dim 0 — returned as a global array sharded
+    over the group axis (rank i's slab = sum slice i)."""
+    g = get_group(group)
+    v = _unwrap(tensor)
+    if g.nranks == 1:
+        return Tensor(v) if not isinstance(tensor, Tensor) else tensor
+    return Tensor(_cached_program(g.mesh, g.axis, "reduce_scatter",
+                                  False, True)(v))
+
+
+def broadcast(tensor, src=0, group: Optional[Group] = None, sync_op=True):
+    """Single-controller: global arrays are already consistent; broadcast is
+    the identity (kept for API parity)."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group: Optional[Group] = None,
+           sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        from ..core.math_ops import concat
+        return concat([t for t in tensor_list], axis=0)
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list=None, group: Optional[Group] = None,
+             sync_op=True):
+    """paddle alltoall: rank i sends in_tensor_list[j] to rank j. Global-array
+    semantics: input stacked (nranks*..., ...) along dim 0, returns the
+    transposed exchange."""
+    g = get_group(group)
+    if isinstance(out_tensor_list, Tensor):
+        v = _unwrap(out_tensor_list)
+        out = _cached_program(g.mesh, g.axis, "alltoall", True, True)(v)
+        return Tensor(out)
+    raise NotImplementedError("list-form alltoall: pass a stacked Tensor")
+
+
+def all_to_all(*args, **kwargs):
+    return alltoall(*args, **kwargs)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    from .communication.p2p import send as _send
+    return _send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    from .communication.p2p import recv as _recv
+    return _recv(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    from . import env
+    return max(env.get_world_size(), len(jax.devices()))
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    from . import env
+    return env.get_rank()
+
+
+# in-program helpers (used by model code inside shard_map)
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis, perm)

@@ -1,0 +1,158 @@
+"""Point-to-point communication: send/recv, isend/irecv, batch_isend_irecv.
+
+Reference: python/paddle/distributed/communication/{send,recv,
+batch_isend_irecv}.py over ProcessGroupNCCL::Send/Recv with batched
+GroupStart/End (SURVEY.md §2.3, §3.2 pipeline p2p).
+
+TPU-first: *in-graph* p2p is ``lax.ppermute`` over a mesh axis — that is
+what the pipeline engine uses on the hot path (paddle_tpu/parallel/
+pipeline.py), and what a batch of matched isend/irecv pairs lowers to here
+(one compiled ppermute per batch). *Eager* p2p in the single-controller
+model is a host-mediated exchange: the sender parks the array in a mailbox
+keyed by (src, dst, tag), the receiver copies it out — the TCPStore-era
+"separate comm stream" has no analog because XLA owns scheduling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ..collective import Group, get_group, _unwrap
+
+
+class _Mailbox:
+    """Host-side rendezvous for eager send/recv within one controller."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._slots: Dict[Tuple[int, int, int], object] = {}
+
+    def put(self, key, value, timeout: float = 60.0):
+        with self._lock:
+            if key in self._slots and not self._lock.wait_for(
+                    lambda: key not in self._slots, timeout):
+                raise TimeoutError(f"send slot {key} still occupied")
+            self._slots[key] = value
+            self._lock.notify_all()
+
+    def take(self, key, timeout: float = 60.0):
+        with self._lock:
+            if not self._lock.wait_for(lambda: key in self._slots, timeout):
+                raise TimeoutError(f"recv: nothing sent for {key}")
+            val = self._slots.pop(key)
+            self._lock.notify_all()
+            return val
+
+
+_mailbox = _Mailbox()
+
+
+class P2PTask:
+    """Completed-on-creation task handle (parity with ProcessGroup::Task).
+
+    Eager exchanges resolve synchronously under the single controller, so
+    ``wait()`` is trivially satisfied; ``tensor`` carries the received value
+    for irecv tasks.
+    """
+
+    def __init__(self, tensor: Optional[Tensor] = None):
+        self.tensor = tensor
+
+    def wait(self) -> bool:
+        return True
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def _check_single_process(what: str) -> None:
+    """Eager p2p rendezvouses through an in-process mailbox; across OS
+    processes (launch CLI / spawn, each with its own mailbox) it would hang
+    until timeout. Fail fast with a pointer at the in-graph path instead."""
+    from .. import env
+
+    if env.get_world_size() > 1:
+        raise RuntimeError(
+            f"eager {what} is single-process only (the mailbox does not "
+            "cross process boundaries). In multi-process launches use "
+            "in-graph p2p: lax.ppermute over a mesh axis / "
+            "batch_isend_irecv with matched pairs / the pipeline engine.")
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True, tag: int = 0):
+    from ..collective import get_rank
+    _check_single_process("send")
+    _mailbox.put((get_rank(), dst, tag), _unwrap(tensor))
+    return P2PTask()
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True, tag: int = 0):
+    from ..collective import get_rank
+    _check_single_process("recv")
+    val = _mailbox.take((src, get_rank(), tag))
+    if isinstance(tensor, Tensor):
+        tensor._value = jax.numpy.asarray(val).reshape(tensor._value.shape) \
+            .astype(tensor._value.dtype)
+        return P2PTask(tensor)
+    return P2PTask(Tensor(val))
+
+
+def isend(tensor, dst: int = 0, group: Optional[Group] = None, tag: int = 0):
+    return send(tensor, dst, group, sync_op=False, tag=tag)
+
+
+def irecv(tensor, src: int = 0, group: Optional[Group] = None, tag: int = 0):
+    return recv(tensor, src, group, sync_op=False, tag=tag)
+
+
+class P2POp:
+    """One batched p2p operation (parity: paddle.distributed.P2POp).
+
+    ``op`` is the isend/irecv function; ``peer`` the remote rank.
+    """
+
+    def __init__(self, op, tensor, peer: int, group: Optional[Group] = None,
+                 tag: int = 0):
+        if op not in (isend, irecv):
+            raise ValueError("op must be paddle_tpu.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.tag = tag
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[P2PTask]:
+    """Execute a batch of matched isend/irecv pairs.
+
+    When every send has a matching receive *within the batch* (the pipeline
+    pattern: reference GroupStart/End), the batch lowers to ONE compiled
+    ``lax.ppermute`` over the group's mesh axis — the ICI-native form.
+    Unmatched ops fall back to the eager mailbox exchange.
+    """
+    if not p2p_op_list:
+        return []
+    # Sends post first so the receives in the same batch can't deadlock —
+    # the GroupStart/End ordering guarantee of the reference.
+    tasks: List[P2PTask] = []
+    for op in p2p_op_list:
+        if op.op is isend:
+            tasks.append(send(op.tensor, op.peer, op.group, tag=op.tag))
+    for op in p2p_op_list:
+        if op.op is irecv:
+            tasks.append(recv(op.tensor, op.peer, op.group, tag=op.tag))
+    return tasks
+
+
+def ppermute_exchange(x, axis: str, perm: List[Tuple[int, int]]):
+    """In-graph batched p2p: the compiled path used by pipeline schedules.
+    Call inside shard_map; ``perm`` is [(src, dst), ...] as in lax.ppermute."""
+    return lax.ppermute(x, axis, perm)

@@ -1,0 +1,74 @@
+"""Distributed environment bootstrap.
+
+Rebuild of init_parallel_env / ParallelEnv (python/paddle/distributed/
+parallel.py) + TCPStore rendezvous (paddle/fluid/distributed/store/
+tcp_store.cc) — SURVEY.md §2.3. On TPU the coordination service of
+``jax.distributed`` replaces TCPStore+NCCL-id exchange; env vars keep the
+reference's names (PADDLE_TRAINER_ID etc.) with JAX equivalents honoured too.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = [False]
+
+
+def init_parallel_env(strategy=None) -> "ParallelEnv":
+    """Parity with paddle.distributed.init_parallel_env.
+
+    Single-host: no-op beyond device discovery. Multi-host (launcher sets
+    PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_MASTER): initialises the jax
+    coordination service.
+    """
+    if _initialized[0]:
+        return ParallelEnv()
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nprocs > 1 and jax.process_count() == 1:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        master = os.environ.get("PADDLE_MASTER") or \
+            os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" + \
+            os.environ.get("MASTER_PORT", "8639")
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=nprocs, process_id=rank)
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    """Process rank (reference: trainer id)."""
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size() -> int:
+    """Number of processes (reference: trainer count)."""
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+class ParallelEnv:
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank

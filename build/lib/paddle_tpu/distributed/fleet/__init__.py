@@ -1,0 +1,19 @@
+"""Fleet — hybrid-parallel training facade.
+
+Rebuild of python/paddle/distributed/fleet/ (fleet.init / distributed_model /
+distributed_optimizer, DistributedStrategy.hybrid_configs — SURVEY.md §2.4,
+§2.5). The strategy keys match the reference; the execution substrate is one
+jax Mesh + the compiled hybrid engine.
+"""
+
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    worker_index, worker_num, is_first_worker, barrier_worker,
+)
+from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad  # noqa: F401
+from . import utils  # noqa: F401
+from . import meta_optimizers  # noqa: F401

@@ -1,0 +1,47 @@
+"""Stage-1 sharding optimizer (Fleet dygraph path).
+
+Rebuild of python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py:§0 (SURVEY.md §2.4 Sharding row): ZeRO stage 1 —
+each sharding rank owns the optimizer state (and update) of a size-balanced
+subset of parameters, then broadcasts updated params over the sharding group.
+
+TPU-native mechanism: the rank→param partition is kept for parity (and for
+the distributed checkpointer), but the actual state sharding is expressed as
+NamedSharding placement over the ``sharding`` mesh axis — the broadcast is
+XLA's job. ``split_param`` (stage-1 v2: shard *within* each tensor) is the
+placement default here, since dim-splitting is the natural mesh expression.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...optimizer.optimizer import Optimizer
+from ..collective import Group
+from ..sharding.group_sharded import (GroupShardedOptimizerStage2,
+                                      _greedy_partition)
+
+
+class DygraphShardingOptimizer(GroupShardedOptimizerStage2):
+    """Parity class name; behaviour = stage-1 (opt-state only — grads stay
+    replicated, matching the reference's stage 1)."""
+
+    def __init__(self, optimizer: Optimizer, hcg=None):
+        group = None
+        if hcg is not None:
+            group = hcg.get_sharding_parallel_group()
+        params = list(optimizer._parameter_list)
+        super().__init__(params, optimizer, group=group, shard_grads=False)
+
+    # reference helpers used by callers/tests
+    def _partition_parameters(self):
+        rank2params = {}
+        for name, r in self.param2rank.items():
+            rank2params.setdefault(r, []).append(name)
+        return {r: sorted(v) for r, v in rank2params.items()}
+
+    @property
+    def _rank2params(self):
+        return self._partition_parameters()

@@ -1,0 +1,122 @@
+"""Compiled hybrid-parallel train step (GSPMD path).
+
+This is the heart of the Fleet rebuild: ONE jitted XLA program implementing
+forward + backward + clip + optimizer update, partitioned over the global
+mesh via NamedShardings:
+
+* **dp**: batch dim sharded over ('dp','sharding') — gradient psums are
+  inserted by XLA (replaces EagerReducer bucketed allreduce, SURVEY.md §2.3).
+* **mp (TP)**: weights carry specs from meta_parallel.mp_layers; XLA inserts
+  the c_identity/mp_allreduce collectives the reference codes by hand.
+* **sharding (ZeRO)**: stage 1/2 shard optimizer state (and grads via XLA's
+  reduce-scatter dataflow); stage 3 additionally shards parameters (FSDP) —
+  reference: DygraphShardingOptimizer / GroupShardedStage2/3 (SURVEY.md §2.4).
+* **sp (sequence parallel)**: activation specs via sequence_parallel_utils.
+
+Pipeline parallelism uses the shard_map engine instead (pipeline_parallel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...jit import TrainStep
+from ...jit.functional import param_arrays, buffer_arrays, tree_unwrap
+from ...core.tensor import Tensor
+from ...parallel import mesh as _mesh
+
+
+def _spec_with_axis0(spec: P, axis: str, ndim: int, dim0: int, degree: int) -> P:
+    """Add `axis` to dim 0 of spec if free and divisible."""
+    dims = list(spec) + [None] * (ndim - len(list(spec)))
+    used = set()
+    for d in dims:
+        if d is None:
+            continue
+        for a in (d if isinstance(d, tuple) else (d,)):
+            used.add(a)
+    if axis in used or ndim == 0 or degree <= 1 or dim0 % degree != 0:
+        return P(*dims) if dims else P()
+    if dims[0] is None:
+        dims[0] = axis
+    elif isinstance(dims[0], tuple):
+        dims[0] = tuple(list(dims[0]) + [axis])
+    else:
+        dims[0] = (dims[0], axis)
+    return P(*dims)
+
+
+class HybridTrainStep(TrainStep):
+    """TrainStep + mesh shardings. Used directly or via
+    fleet.distributed_model(...).compile_train_step(...)."""
+
+    def __init__(self, model, loss_fn: Callable, optimizer, mesh=None,
+                 zero_stage: int = 1, batch_axes=("dp", "sharding"),
+                 donate: bool = True):
+        super().__init__(model, loss_fn, optimizer, donate=donate)
+        self.mesh = mesh if mesh is not None else _mesh.ensure_mesh()
+        self.zero_stage = int(zero_stage)
+        self.batch_axes = tuple(ax for ax in batch_axes
+                                if ax in self.mesh.shape and self.mesh.shape[ax] > 1)
+        self._shardings_built = False
+
+    # -- sharding derivation -------------------------------------------------
+    def _param_spec(self, p) -> P:
+        spec = p._sharding_spec if p._sharding_spec is not None else P()
+        if self.zero_stage >= 3 and p.trainable:
+            deg = self.mesh.shape.get("sharding", 1)
+            nd = len(p._value.shape)
+            d0 = p._value.shape[0] if nd else 1
+            spec = _spec_with_axis0(spec, "sharding", nd, d0, deg)
+        return spec
+
+    def _build_shardings(self, batch):
+        mesh = self.mesh
+        ns = lambda spec: NamedSharding(mesh, spec)
+        params_sh = {}
+        by_name = dict(self.model.named_parameters())
+        for name, p in by_name.items():
+            params_sh[name] = ns(self._param_spec(p))
+        opt_sh = {}
+        deg = mesh.shape.get("sharding", 1)
+        for name, p in self._trainable:
+            pspec = self._param_spec(p)
+            slots = {}
+            state = self.optimizer._accumulators[id(p)]
+            for slot, v in state.items():
+                cur = getattr(v, "sharding", None)
+                if isinstance(cur, NamedSharding) and cur.mesh == mesh:
+                    # state already placed (eager stage-1/2 wrapper): the jit
+                    # in_shardings must match the actual placement exactly
+                    slots[slot] = cur
+                    continue
+                vshape = getattr(v, "shape", ())
+                if tuple(vshape) == tuple(p._value.shape) and self.zero_stage >= 1:
+                    nd = len(vshape)
+                    d0 = vshape[0] if nd else 1
+                    slots[slot] = ns(_spec_with_axis0(pspec, "sharding", nd, d0, deg))
+                else:
+                    slots[slot] = ns(P())
+            opt_sh[name] = slots
+        buf_sh = {name: ns(P()) for name in buffer_arrays(self.model)}
+        batch_spec = P(self.batch_axes if self.batch_axes else None)
+        batch_sh = jax.tree_util.tree_map(
+            lambda v: ns(batch_spec if getattr(v, "ndim", 0) > 0 else P()), batch)
+        rep = ns(P())
+        self._in_sh = (params_sh, opt_sh, buf_sh, batch_sh, rep, rep, rep)
+        self._out_sh = (rep, params_sh, opt_sh, buf_sh)
+        self._shardings_built = True
+
+    # override: derive shardings from the first batch, then jit with them
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._build_shardings(tree_unwrap(batch))
+            donate = (0, 1, 2) if self._donate else ()
+            self._jitted = jax.jit(self._make_step_fn(), donate_argnums=donate,
+                                   in_shardings=self._in_sh,
+                                   out_shardings=self._out_sh)
+        return super().__call__(*batch)

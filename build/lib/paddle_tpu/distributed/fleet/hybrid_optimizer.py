@@ -1,0 +1,109 @@
+"""Hybrid-parallel optimizer wrapper.
+
+Rebuild of python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py (HybridParallelOptimizer + HybridParallelClipGrad
+— SURVEY.md §2.4 hybrid row).
+
+In the reference, clip must psum squared norms across mp/pp/sharding NCCL
+groups because each process sees only its shard. In the single-controller
+rebuild, *eager* state is global (norms are already global), and in the
+*compiled* hybrid step GSPMD computes global norms automatically from sharded
+values — so HybridParallelClipGrad degenerates to ClipGradByGlobalNorm with
+distributed-parameter awareness kept for the manual (shard_map) path, where it
+psums over the active axes exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...optimizer.clip import ClipGradByGlobalNorm
+from ...optimizer.optimizer import Optimizer
+from ...parallel import pcontext
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    def __init__(self, clip, hcg):
+        clip_norm = getattr(clip, "clip_norm", clip)
+        super().__init__(float(clip_norm))
+        self._hcg = hcg
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return jnp.asarray(0.0, jnp.float32)
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        # manual mode: shards are per-device → psum over every active axis the
+        # parameters are split across (mp + sharding + pp)
+        if pcontext.in_manual_mode():
+            for kind in ("mp", "sharding", "pp"):
+                ax = pcontext.manual_axis(kind)
+                if ax is not None:
+                    total = lax.psum(total, ax)
+        return jnp.sqrt(total)
+
+
+class HybridParallelOptimizer:
+    """Delegating wrapper: swaps the inner clip for the hybrid-aware clip and
+    keeps the reference's API (step/clear_grad/state_dict/…)."""
+
+    def __init__(self, optimizer, hcg, strategy):
+        from .meta_optimizers import unwrap_optimizer
+
+        # reference: when sharding_degree > 1 the inner optimizer is wrapped
+        # in DygraphShardingOptimizer (stage 1) before the hybrid wrapper
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1 and \
+                isinstance(unwrap_optimizer(optimizer), Optimizer) and \
+                not self._already_sharded(optimizer):
+            from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+            optimizer = DygraphShardingOptimizer(optimizer, hcg)
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        # reference behaviour: only ClipGradByGlobalNorm is swapped for the
+        # hybrid-aware variant; other clip types keep their own semantics.
+        inner = unwrap_optimizer(optimizer)
+        if isinstance(inner._grad_clip, ClipGradByGlobalNorm) and \
+                not isinstance(inner._grad_clip, HybridParallelClipGrad) and \
+                hcg is not None:
+            inner._grad_clip = HybridParallelClipGrad(
+                inner._grad_clip, hcg)
+
+    @staticmethod
+    def _already_sharded(optimizer) -> bool:
+        from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+        o = optimizer
+        seen = set()
+        while o is not None and id(o) not in seen:
+            seen.add(id(o))
+            if isinstance(o, DygraphShardingOptimizer):
+                return True
+            o = getattr(o, "_inner_opt", None) or getattr(o, "inner_opt", None)
+        return False
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

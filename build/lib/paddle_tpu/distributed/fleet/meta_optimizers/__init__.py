@@ -1,0 +1,203 @@
+"""Strategy-driven meta-optimizers.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ — static-graph
+rewrite passes (AMP, recompute, sharding, pipeline, gradient-merge, localsgd,
+lamb, ...) selected by DistributedStrategy flags (SURVEY.md §2.5). jax has no
+separate static graph to rewrite, so each optimizer here performs the
+TPU-native form of its transform directly: wrapping the optimizer (AMP master
+weights + loss scaler, gradient merge accumulation, localsgd periodic
+averaging, Lamb swap) or carrying the config the model-side transform reads
+(recompute). ``fleet.distributed_optimizer`` composes them in the reference's
+order; ``unwrap_optimizer`` reaches the base optimizer through any stack of
+wrappers (the compiled HybridTrainStep needs the raw update rule).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def unwrap_optimizer(opt):
+    """Follow the wrapper chain (_inner_opt / inner_opt) to the base
+    Optimizer carrying the actual update rule and accumulators."""
+    seen = set()
+    while id(opt) not in seen:
+        seen.add(id(opt))
+        nxt = getattr(opt, "_inner_opt", None) or getattr(opt, "inner_opt", None)
+        if nxt is None:
+            return opt
+        opt = nxt
+    return opt
+
+
+class _DelegatingMetaOptimizer:
+    """Wraps an inner optimizer; subclasses attach their transform."""
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+
+    def __getattr__(self, item):
+        if item == "inner_opt":  # not yet set (unpickling) → no recursion
+            raise AttributeError(item)
+        return getattr(self.inner_opt, item)
+
+    def step(self):
+        self.inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self.inner_opt.clear_grad(*a, **k)
+
+    def clear_gradients(self, *a, **k):
+        # dynamic dispatch so subclass clear_grad overrides (gradient merge)
+        # are honoured through the legacy alias too
+        return self.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_opt.set_state_dict(sd)
+
+
+class AMPOptimizer(_DelegatingMetaOptimizer):
+    """amp strategy → master weights (O2) + a configured GradScaler.
+
+    bf16 (TPU default) needs no loss scaling, so the scaler enables dynamic
+    scaling only for float16 — same decision the reference encodes in its
+    amp pass defaults (fp16 lineage).
+    """
+
+    def __init__(self, optimizer, configs: Optional[dict] = None):
+        super().__init__(optimizer)
+        c = dict(configs or {})
+        self.amp_level = c.get("level", "O1")
+        self.amp_dtype = c.get("dtype", "bfloat16")
+        base = unwrap_optimizer(optimizer)
+        if self.amp_level == "O2":
+            base._multi_precision = True
+        from ....amp import GradScaler
+
+        self.scaler = GradScaler(
+            enable=(self.amp_dtype == "float16"
+                    and c.get("use_dynamic_loss_scaling", True)),
+            init_loss_scaling=c.get("init_loss_scaling", 2.0 ** 15),
+            incr_ratio=c.get("incr_ratio", 2.0),
+            decr_ratio=c.get("decr_ratio", 0.5),
+            incr_every_n_steps=c.get("incr_every_n_steps", 1000),
+            decr_every_n_nan_or_inf=c.get("decr_every_n_nan_or_inf", 2),
+        )
+
+    def get_loss_scaler(self):
+        return self.scaler
+
+
+class RecomputeOptimizer(_DelegatingMetaOptimizer):
+    """recompute strategy: the transform is model-side (jax.checkpoint via
+    fleet.recompute, applied by fleet.distributed_model on the layers named
+    in recompute_configs['checkpoints']); this wrapper carries the config."""
+
+    def __init__(self, optimizer, configs: Optional[dict] = None):
+        super().__init__(optimizer)
+        self.recompute_configs = dict(configs or {})
+
+
+class ShardingOptimizer(_DelegatingMetaOptimizer):
+    """sharding strategy → DygraphShardingOptimizer / group_sharded APIs
+    (selected inside HybridParallelOptimizer when sharding_degree > 1)."""
+
+
+class PipelineOptimizer(_DelegatingMetaOptimizer):
+    """pipeline strategy → meta_parallel.PipelineParallel engines."""
+
+
+class GradientMergeOptimizer(_DelegatingMetaOptimizer):
+    """k-step gradient accumulation: ``step`` applies the update only every
+    k-th call (grads keep accumulating on the tape between them), optionally
+    averaging; ``clear_grad`` drops grads only after a real update."""
+
+    def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
+        super().__init__(optimizer)
+        self._k = max(int(k_steps), 1)
+        self._avg = bool(avg)
+        self._calls = 0
+        self._stepped = False
+
+    def step(self):
+        self._calls += 1
+        if self._calls % self._k:
+            self._stepped = False
+            return
+        if self._avg and self._k > 1:
+            base = unwrap_optimizer(self.inner_opt)
+            for p in base._parameter_list:
+                if getattr(p, "_grad_value", None) is not None:
+                    p._grad_value = p._grad_value / self._k
+                mg = getattr(p, "main_grad", None)
+                if mg is not None:
+                    mg._value = mg._value / self._k
+        self.inner_opt.step()
+        self._stepped = True
+
+    def clear_grad(self, *a, **k):
+        if self._stepped:  # between accumulation steps grads must survive
+            self.inner_opt.clear_grad(*a, **k)
+
+
+class LambOptimizer(_DelegatingMetaOptimizer):
+    """lamb strategy → swap the update rule for paddle_tpu.optimizer.Lamb,
+    keeping the caller's lr/parameters/clip."""
+
+    def __init__(self, optimizer, configs: Optional[dict] = None):
+        from ....optimizer import Lamb
+
+        base = unwrap_optimizer(optimizer)
+        c = dict(configs or {})
+        exclude = list(c.get("exclude_from_weight_decay", []))
+        exclude_fn = None
+        if exclude:
+            def exclude_fn(p):
+                name = getattr(p, "name", "") or ""
+                return any(frag in name for frag in exclude)
+        lamb = Lamb(
+            learning_rate=base._learning_rate,
+            lamb_weight_decay=c.get("lamb_weight_decay", 0.01),
+            parameters=base._parameter_list,
+            grad_clip=base._grad_clip,
+            exclude_from_weight_decay_fn=exclude_fn,
+        )
+        super().__init__(lamb)
+
+
+class LocalSGDOptimizer(_DelegatingMetaOptimizer):
+    """localsgd: inner step every call; every k_steps the parameters are
+    averaged over the dp group (reference: paddle.distributed collectives on
+    params outside the hot loop)."""
+
+    def __init__(self, optimizer, k_steps: int = 1, group=None,
+                 begin_step: int = 1):
+        super().__init__(optimizer)
+        self._k = max(int(k_steps), 1)
+        self._group = group
+        self._begin = max(int(begin_step), 0)
+        self._calls = 0
+
+    def step(self):
+        self.inner_opt.step()
+        self._calls += 1
+        if self._calls >= self._begin and self._calls % self._k == 0:
+            self._average_parameters()
+
+    def _average_parameters(self):
+        from ... import collective as C
+
+        g = C.get_group(self._group)
+        if g.nranks <= 1:
+            return
+        base = unwrap_optimizer(self.inner_opt)
+        for p in base._parameter_list:
+            p._value = C.all_reduce_replicated(p._value, op="avg", group=g)
+
+
+class DGCOptimizer(_DelegatingMetaOptimizer):
+    """deep gradient compression: not applicable on ICI (collectives are
+    compiler-scheduled); kept for strategy-surface parity."""

@@ -1,0 +1,144 @@
+"""Activation recomputation (checkpointing).
+
+Rebuild of python/paddle/distributed/fleet/recompute/{recompute,
+recompute_hybrid}.py (SURVEY.md §2.5). The reference replays CUDA RNG state
+and re-runs forward in backward; on TPU this is ``jax.checkpoint`` — RNG
+replay is free because dropout keys are pure values.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...jit.functional import bind, tree_unwrap
+
+
+def _find_layers(function):
+    """Collect Layers whose parameters must be lifted into the checkpointed
+    region: the function itself, its bound self, partial args, and any Layer
+    captured in its closure (the `lambda x: self.block(x)` pattern)."""
+    import functools as _ft
+    from ...nn.layer import Layer
+
+    found = []
+
+    def add(obj):
+        if isinstance(obj, Layer) and all(obj is not l for l in found):
+            found.append(obj)
+
+    add(function)
+    add(getattr(function, "__self__", None))
+    if isinstance(function, _ft.partial):
+        for a in list(function.args) + list(function.keywords.values()):
+            add(a)
+        add(getattr(function.func, "__self__", None))
+    closure = getattr(function, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            add(v)
+            add(getattr(v, "__self__", None))
+    return [l for l in found if l is not None]
+
+
+def recompute(function: Callable, *args, use_reentrant=True,
+              preserve_rng_state=True, **kwargs):
+    """Run ``function(*args)`` under rematerialisation: activations inside are
+    not saved; they are recomputed in backward.
+
+    Parameters of any Layer reachable from ``function`` (itself, bound self,
+    partial args, closure cells) are lifted into the checkpointed region so
+    their gradients flow on the tape.
+    """
+    layers = _find_layers(function)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    plists = []  # per-layer (names, tensors)
+    ptensors = []
+    for layer in layers:
+        plist = [(n, p) for n, p in layer.named_parameters()]
+        plists.append([n for n, _ in plist])
+        ptensors.extend(p for _, p in plist)
+
+    np_ = len(ptensors)
+
+    # Side-channel attributes (MoE gate aux losses) written onto sublayers
+    # DURING the call would escape the checkpoint region as tracers; instead
+    # they are threaded out as extra checkpoint outputs and written back
+    # outside. aux_subs is populated at trace time (dict dedupes the
+    # fwd + remat-bwd traces).
+    aux_subs: dict = {}
+    meta: dict = {}
+
+    def pure(*vals):
+        pvals_flat = vals[:np_]
+        tvals = vals[np_:]
+        full = list(args)
+        for i, v in zip(tensor_pos, tvals):
+            full[i] = Tensor(v, stop_gradient=False)
+
+        def run():
+            out = function(*full, **kwargs)
+            auxvals = []
+            for layer in layers:
+                for name, sub in layer.named_sublayers(include_self=True):
+                    la = getattr(sub, "l_aux", None)
+                    if isinstance(la, Tensor):
+                        aux_subs[(id(layer), name)] = sub
+                        auxvals.append(la._value)
+            leaves, treedef = jax.tree_util.tree_flatten(tree_unwrap(out))
+            meta["treedef"] = treedef
+            meta["n_out"] = len(leaves)
+            return tuple(leaves) + tuple(auxvals)
+
+        import contextlib
+        with contextlib.ExitStack() as stack:
+            off = 0
+            for layer, names in zip(layers, plists):
+                pvals = dict(zip(names, pvals_flat[off:off + len(names)]))
+                off += len(names)
+                stack.enter_context(bind(layer, pvals))
+            return run()
+
+    ck = jax.checkpoint(pure)
+    outs = apply(lambda *v: ck(*v), *ptensors, *tensor_args,
+                 op_name="recompute")
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    n_out = meta["n_out"]
+    out = jax.tree_util.tree_unflatten(meta["treedef"], outs[:n_out])
+    for sub, av in zip(aux_subs.values(), outs[n_out:]):
+        sub.l_aux = av if isinstance(av, Tensor) else Tensor(av)
+    return out
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Parity with recompute_sequential: checkpoint each segment of a
+    Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    n = len(funcs)
+    seg_size = max(n // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+    i = 0
+    while i < n:
+        seg = funcs[i:i + seg_size]
+        for f in seg:
+            x = recompute(f, x)
+        i += seg_size
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp-aware recompute (reference offloads + per-mp-rank seeds). RNG keys
+    make seed replay automatic; offload maps to XLA remat/offload policies."""
+    return recompute(function, *args, **kwargs)

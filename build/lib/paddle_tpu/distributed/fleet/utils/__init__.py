@@ -1,0 +1,6 @@
+"""Fleet utility modules (reference: python/paddle/distributed/fleet/utils/)."""
+
+from . import sequence_parallel_utils  # noqa: F401
+from . import mix_precision_utils  # noqa: F401
+from . import tensor_fusion_helper  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401

@@ -1,0 +1,82 @@
+"""Gradient-sync helpers for hybrid parallelism.
+
+Rebuild of python/paddle/distributed/fleet/utils/hybrid_parallel_util.py
+(SURVEY.md §2.4 hybrid row): fused allreduce of grads over the dp/sharding
+group after backward, and parameter broadcast at init so replicas agree.
+
+Single-controller note: under one controller, parameters are global arrays —
+replicas agree by construction, so the broadcast_* functions are cheap
+parity shims; fused_allreduce_gradients is real work whenever a dp group
+spans a mesh axis (multi-slice DCN sync in particular).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .tensor_fusion_helper import fused_parameters
+
+
+def fused_allreduce_gradients(parameter_list: Sequence, hcg=None,
+                              group=None, scale=None,
+                              use_main_grad: bool = False) -> None:
+    """Bucketed allreduce of every param's grad over the dp group.
+
+    Reference behaviour: called at the end of backward for params not
+    covered by the sharding reducer; expert params (MoE) are excluded.
+    """
+    params = [p for p in parameter_list
+              if not getattr(p, "expert", False)]
+    grads_attr = "main_grad" if use_main_grad else "grad"
+    params = [p for p in params if getattr(p, grads_attr) is not None]
+    if not params:
+        return
+    if group is None and hcg is not None:
+        group = hcg.get_data_parallel_group()
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        # single controller, no multi-process dp group: grads are already
+        # globally reduced (they were computed from the global batch)
+        return
+    for buf in fused_parameters(params, comm_group=group,
+                                use_main_grad=use_main_grad):
+        for p in buf._params:
+            buf.add_grad(p)
+        buf.comm()
+        if scale is not None:
+            # dp averaging (reference divides the reduced grads by the dp
+            # degree); done on the flat buffer before scatter so each param
+            # slice is written back exactly once.
+            buf.buffer = buf.buffer / scale
+        buf.scatter_grads()
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Parity shim: inputs are global arrays under one controller."""
+    if kwargs:
+        return list(inputs) + [kwargs]
+    return inputs if len(inputs) != 1 else inputs[0]
+
+
+def broadcast_mp_parameters(model, hcg) -> None:
+    """No-op under single controller: mp replicas share the global array."""
+
+
+def broadcast_dp_parameters(model, hcg) -> None:
+    """No-op under single controller (reference: dp-group broadcast)."""
+
+
+def broadcast_sharding_parameters(model, hcg) -> None:
+    """No-op under single controller (reference: sharding-group broadcast)."""
+
+
+def sharding_reduce_gradients(parameter_list: Sequence, hcg) -> None:
+    """Reduce grads over the sharding group (stage-1 path). Under one
+    controller the grads are already global sums; kept for API parity and
+    used when a sharding axis maps to a real multi-process group."""
+    group = hcg.get_sharding_parallel_group() if hcg is not None else None
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        return
+    # comm() psums replicated copies (nranks * g under one controller);
+    # scale by the group size so the written-back grads stay the dp average.
+    fused_allreduce_gradients(parameter_list, group=group,
+                              scale=float(group.nranks))

@@ -1,0 +1,93 @@
+"""main_grad mixed-precision utilities.
+
+Rebuild of python/paddle/distributed/fleet/utils/mix_precision_utils.py
+(MixPrecisionLayer / MixPrecisionOptimizer / MixPrecisionScaler — SURVEY.md
+§2.5 AMP row). The reference accumulates each param's low-precision grads
+into an fp32 ``main_grad`` buffer (via a backward post-hook, fused on GPU by
+fused_linear_param_grad_add) so multi-microbatch accumulation and clipping
+run in fp32.
+
+TPU-first note: bf16 training needs no loss scaling, but fp32 *accumulation*
+still matters for long grad-accumulation chains; inside the compiled hybrid
+step the same effect comes from keeping the grad-accum buffer fp32 (XLA
+donation, ops/fused_linear.py). This module is the eager/dygraph surface.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+
+class MixPrecisionLayer:
+    """Wraps a Layer: after each ``backward()``, fold every param's grad into
+    its fp32 ``main_grad`` and clear the low-precision grad."""
+
+    def __init__(self, layers, dtype: str = "bfloat16"):
+        self._layers = layers
+        self._dtype = dtype
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def accumulate_main_grads(self) -> None:
+        """Fold ``.grad`` → ``.main_grad`` (fp32) for every parameter.
+
+        Call after each microbatch's backward (the reference does this in a
+        param backward post-hook; the eager tape here has no per-param hook
+        point, so it is one explicit sweep)."""
+        for p in self._layers.parameters():
+            g = p.grad
+            if g is None:
+                continue
+            g32 = g._value.astype(jnp.float32)
+            if p.main_grad is None:
+                p.main_grad = Tensor(g32)
+            else:
+                p.main_grad = Tensor(p.main_grad._value + g32)
+            p.clear_grad()
+
+
+class MixPrecisionOptimizer:
+    """Wraps an optimizer to step from ``main_grad`` instead of ``.grad``."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        params = self._inner_opt._parameter_list
+        saved = []
+        for p in params:
+            if p.main_grad is not None:
+                saved.append((p, p._grad_value))
+                p._grad_value = p.main_grad._value
+        try:
+            self._inner_opt.step()
+        finally:
+            for p, old in saved:
+                p._grad_value = old
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._inner_opt._parameter_list:
+            if set_to_zero and p.main_grad is not None:
+                p.main_grad = Tensor(jnp.zeros_like(p.main_grad._value))
+            else:
+                p.main_grad = None
+        self._inner_opt.clear_grad(set_to_zero=False)
+
+
+def unwrap_optimizer(optimizer):
+    opt = optimizer
+    while isinstance(opt, MixPrecisionOptimizer):
+        opt = opt._inner_opt
+    return opt

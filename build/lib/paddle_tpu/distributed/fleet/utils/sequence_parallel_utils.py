@@ -1,0 +1,293 @@
+"""Megatron sequence parallelism (SP, tied to TP).
+
+Rebuild of python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(SURVEY.md §2.4 SP row, §5.7): LN/dropout activations are sharded along the
+*sequence* dimension over the mp group; at TP-region boundaries the
+activations are re-partitioned with all_gather / reduce_scatter.
+
+TPU-first note: in GSPMD mode this whole file is unnecessary — annotating
+activations with a seq-axis NamedSharding makes XLA insert exactly these
+collectives (SURVEY §2.4: "GSPMD does this automatically"). These ops are
+the *manual* (shard_map) execution path, where the reference's comm pattern
+is written explicitly over the mp mesh axis, riding ICI. Outside manual
+mode every op is the identity.
+
+Gradient rules follow the reference's autograd functions exactly:
+
+=================  =======================  =========================
+op                 forward                  backward
+=================  =======================  =========================
+ScatterOp          local seq slice          all_gather over seq
+GatherOp           all_gather over seq      local seq slice
+AllGatherOp        all_gather over seq      reduce_scatter (psum_scatter)
+ReduceScatterOp    reduce_scatter           all_gather
+=================  =======================  =========================
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....core.dispatch import apply
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer import Layer
+from ....parallel import pcontext, mesh as _mesh
+from ...topology import get_hybrid_communicate_group
+from ...meta_parallel.mp_layers import (  # noqa: F401  (re-export parity)
+    mark_as_sequence_parallel_parameter,
+)
+
+SEQ_AXIS = 0  # [s, b, h] layout, as in the reference
+
+
+def _mp_degree() -> int:
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    return _mesh.axis_degree("mp")
+
+
+# ---------------------------------------------------------------------------
+# Array-level ops with the reference's custom gradients (jax.custom_vjp).
+# ``axis`` is the mesh axis name; these are only valid inside shard_map.
+# ---------------------------------------------------------------------------
+
+def _slice_to_rank(v, axis_name: str, dim: int):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    size = v.shape[dim] // n
+    return lax.dynamic_slice_in_dim(v, idx * size, size, dim)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_array(v, axis_name: str, dim: int = SEQ_AXIS):
+    return _slice_to_rank(v, axis_name, dim)
+
+
+def _scatter_fwd(v, axis_name, dim):
+    return scatter_array(v, axis_name, dim), None
+
+
+def _scatter_bwd(axis_name, dim, _res, g):
+    return (lax.all_gather(g, axis_name, axis=dim, tiled=True),)
+
+
+scatter_array.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_array(v, axis_name: str, dim: int = SEQ_AXIS):
+    return lax.all_gather(v, axis_name, axis=dim, tiled=True)
+
+
+def _gather_fwd(v, axis_name, dim):
+    return gather_array(v, axis_name, dim), None
+
+
+def _gather_bwd(axis_name, dim, _res, g):
+    return (_slice_to_rank(g, axis_name, dim),)
+
+
+gather_array.defvjp(_gather_fwd, _gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def all_gather_array(v, axis_name: str, dim: int = SEQ_AXIS):
+    return lax.all_gather(v, axis_name, axis=dim, tiled=True)
+
+
+def _all_gather_fwd(v, axis_name, dim):
+    return all_gather_array(v, axis_name, dim), None
+
+
+def _all_gather_bwd(axis_name, dim, _res, g):
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=dim, tiled=True),)
+
+
+all_gather_array.defvjp(_all_gather_fwd, _all_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_array(v, axis_name: str, dim: int = SEQ_AXIS):
+    return lax.psum_scatter(v, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def _reduce_scatter_fwd(v, axis_name, dim):
+    return reduce_scatter_array(v, axis_name, dim), None
+
+
+def _reduce_scatter_bwd(axis_name, dim, _res, g):
+    return (lax.all_gather(g, axis_name, axis=dim, tiled=True),)
+
+
+reduce_scatter_array.defvjp(_reduce_scatter_fwd, _reduce_scatter_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level ops (the reference's ScatterOp/GatherOp/... public surface)
+# ---------------------------------------------------------------------------
+
+def _tensor_op(array_op, x, op_name: str, dim: int = SEQ_AXIS):
+    ax = pcontext.manual_axis("mp")
+    if not pcontext.in_manual_mode() or ax is None:
+        return x  # GSPMD/eager mode: sharding annotations do the job
+    return apply(lambda v: array_op(v, ax, dim), x, op_name=op_name)
+
+
+class ScatterOp:
+    """Split the sequence dim onto mp ranks. bwd: all_gather."""
+
+    @staticmethod
+    def apply(x, axis: int = SEQ_AXIS):
+        return _tensor_op(scatter_array, x, "sp_scatter", axis)
+
+
+class GatherOp:
+    """Assemble the full sequence from mp ranks. bwd: slice."""
+
+    @staticmethod
+    def apply(x, axis: int = SEQ_AXIS):
+        return _tensor_op(gather_array, x, "sp_gather", axis)
+
+
+class AllGatherOp:
+    """all_gather entering a TP region. bwd: reduce_scatter."""
+
+    @staticmethod
+    def apply(x, axis: int = SEQ_AXIS):
+        return _tensor_op(all_gather_array, x, "sp_all_gather", axis)
+
+
+class ReduceScatterOp:
+    """reduce_scatter leaving a TP region. bwd: all_gather."""
+
+    @staticmethod
+    def apply(x, axis: int = SEQ_AXIS):
+        return _tensor_op(reduce_scatter_array, x, "sp_reduce_scatter", axis)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel linear layers
+# ---------------------------------------------------------------------------
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose input is sequence-sharded.
+
+    forward: all_gather(x) over seq → matmul with out-sharded weight.
+    The all_gather's bwd (reduce_scatter) returns the grad seq-sharded.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.world_size = _mp_degree()
+        self.gather_output = gather_output
+        assert out_features % max(self.world_size, 1) == 0
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight._sharding_spec = P(None, "mp")
+        self.weight.is_distributed_param = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P("mp")
+            self.bias.is_distributed_param = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            def fn(xv, wv, *rest):
+                full = all_gather_array(xv, ax, SEQ_AXIS)
+                y = jnp.matmul(full, wv)
+                if rest:
+                    y = y + rest[0]
+                if self.gather_output:
+                    y = lax.all_gather(y, ax, axis=y.ndim - 1, tiled=True)
+                return y
+            args = [x, self.weight] + (
+                [self.bias] if self.bias is not None else [])
+            return apply(fn, *args, op_name="col_sp_linear")
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear returning a sequence-sharded output.
+
+    forward: matmul with in-sharded weight → reduce_scatter over seq (the
+    psum of RowParallelLinear fused with the SP re-partition).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.world_size = _mp_degree()
+        self.input_is_parallel = input_is_parallel
+        assert in_features % max(self.world_size, 1) == 0
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight._sharding_spec = P("mp", None)
+        self.weight.is_distributed_param = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P()
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            def fn(xv, wv, *rest):
+                if not self.input_is_parallel:
+                    xv = _slice_to_rank(xv, ax, xv.ndim - 1)
+                y = jnp.matmul(xv, wv)
+                y = reduce_scatter_array(y, ax, SEQ_AXIS)
+                if rest:
+                    y = y + rest[0]
+                return y
+            args = [x, self.weight] + (
+                [self.bias] if self.bias is not None else [])
+            return apply(fn, *args, op_name="row_sp_linear")
+        return F.linear(x, self.weight, self.bias)
+
+
+# ---------------------------------------------------------------------------
+# Gradient-sync hooks for SP parameters (LN weights etc.)
+# ---------------------------------------------------------------------------
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Parity shim. In the reference, params marked with
+    ``mark_as_sequence_parallel_parameter`` get a backward hook allreducing
+    their grad over the mp group (their grads are computed from seq shards).
+
+    Here the same sync is applied by :func:`sequence_parallel_sync_gradients`
+    after backward in eager mode; inside the compiled hybrid step, marked
+    params are psum'd over mp by the engine. This function records the
+    marking so both paths find it.
+    """
+    marked = [p for p in model.parameters()
+              if getattr(p, "is_sequence_parallel", False)]
+    model._sequence_parallel_params = marked
+    return marked
+
+
+def sequence_parallel_sync_gradients(model, group=None):
+    """Eager-mode grad allreduce over the mp group for marked params."""
+    from ... import collective
+    params = getattr(model, "_sequence_parallel_params", None)
+    if params is None:
+        params = [p for p in model.parameters()
+                  if getattr(p, "is_sequence_parallel", False)]
+    for p in params:
+        if p.grad is not None:
+            collective.all_reduce(p.grad, group=group)

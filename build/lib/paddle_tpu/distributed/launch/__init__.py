@@ -1,0 +1,17 @@
+"""Distributed launcher CLI.
+
+Rebuild of ``python -m paddle.distributed.launch`` (reference:
+python/paddle/distributed/launch/ — main.py, context/, controllers/, job/;
+SURVEY.md §2.6, §3.1). TPU-first deltas:
+
+- One worker process per **host** is the natural TPU unit (all local chips
+  belong to one jax process); ``--nproc_per_node`` still allows per-device
+  processes for CPU fake-cluster tests (the reference's per-GPU model).
+- Rendezvous across nodes uses the native TCPStore
+  (paddle_tpu.distributed.store) instead of the reference's HTTP/etcd master.
+- Elastic recovery is restart-based (reference: fleet/elastic/manager.py):
+  the watcher notices a dead container and relaunches the local pod up to
+  ``--max_restart`` times.
+"""
+
+from .main import launch  # noqa: F401

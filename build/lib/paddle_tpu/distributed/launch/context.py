@@ -1,0 +1,136 @@
+"""Launch context: CLI args + environment + device discovery.
+
+Reference: python/paddle/distributed/launch/context/__init__.py,
+args_envs.py, device.py, node.py (SURVEY.md §2.6). Env vars keep the
+reference's ``PADDLE_*`` names so user scripts port unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def node_ip() -> str:
+    host = os.environ.get("POD_IP")
+    if host:
+        return host
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def detect_devices() -> int:
+    """Number of local accelerator processes to spawn by default.
+
+    TPU-first: one process per host (jax owns every local chip); honour
+    ``PADDLE_NPROC_PER_NODE`` / CUDA-style visibility for tests.
+    """
+    env = os.environ.get("PADDLE_NPROC_PER_NODE")
+    if env:
+        return max(1, int(env))
+    return 1
+
+
+@dataclass
+class Args:
+    devices: Optional[str] = None
+    nnodes: str = "1"
+    nproc_per_node: Optional[int] = None
+    master: Optional[str] = None
+    rank: int = -1
+    job_id: str = "default"
+    log_dir: str = "log"
+    log_level: str = "INFO"
+    run_mode: str = "collective"
+    max_restart: int = 3
+    elastic_level: int = -1
+    elastic_timeout: int = 30
+    training_script: str = ""
+    training_script_args: List[str] = field(default_factory=list)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Args:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training (TPU-native rebuild of "
+                    "paddle.distributed.launch)")
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices", default=None,
+                   help="comma-separated local device ids (per-process mode)")
+    p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES", "1"),
+                   help="node count, or elastic range 'min:max'")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="processes per node (default: one per host on TPU)")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="rendezvous endpoint ip:port (TCPStore)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_RANK", "-1")),
+                   help="node rank (optional; else assigned by master)")
+    p.add_argument("--job_id", default=os.environ.get("PADDLE_JOB_ID", "default"))
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective"],
+                   help="only collective mode (PS out of scope, SURVEY §2.7)")
+    p.add_argument("--max_restart", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", "3")))
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_LEVEL", "-1")),
+                   help="-1 off; >=1 restart local pod on worker fault")
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    ns = p.parse_args(argv)
+    return Args(**vars(ns))
+
+
+class Context:
+    """Parsed launch context (reference Context)."""
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        self.args = parse_args(argv)
+        self.envs = dict(os.environ)
+        if ":" in self.args.nnodes:
+            lo, hi = self.args.nnodes.split(":", 1)
+            self.nnodes_min, self.nnodes_max = int(lo), int(hi)
+            if self.args.elastic_level < 0:
+                self.args.elastic_level = 1
+        else:
+            self.nnodes_min = self.nnodes_max = int(self.args.nnodes)
+        if self.args.devices:
+            self.local_nproc = len([d for d in self.args.devices.split(",") if d])
+        elif self.args.nproc_per_node:
+            self.local_nproc = self.args.nproc_per_node
+        else:
+            self.local_nproc = detect_devices()
+        self.node_ip = node_ip() if self.nnodes_max > 1 else "127.0.0.1"
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.nnodes_max > 1

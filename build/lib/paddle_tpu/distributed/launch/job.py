@@ -1,0 +1,169 @@
+"""Job / Pod / Container process model.
+
+Reference: python/paddle/distributed/launch/job/{job,pod,container,status}.py
+(SURVEY.md §2.6, §3.1). A Container is one trainer subprocess with its
+``PADDLE_*`` env and a per-rank log file (``workerlog.N`` — the primary
+multi-process debugging surface, SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def build_trainer_env(rank: int, world: int, local_rank: int, local_size: int,
+                      endpoint: str, all_endpoints: List[str], master: str,
+                      node_rank: int = 0, job_id: str = "default",
+                      restart_count: int = 0,
+                      device: Optional[str] = None) -> Dict[str, str]:
+    """The PADDLE_* env contract every trainer process receives — single
+    source shared by the launch CLI and ``spawn`` so the two cannot drift."""
+    return {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_LOCAL_SIZE": str(local_size),
+        "PADDLE_NODE_RANK": str(node_rank),
+        "PADDLE_CURRENT_ENDPOINT": endpoint,
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(all_endpoints),
+        "PADDLE_MASTER": master,
+        "PADDLE_JOB_ID": job_id,
+        "PADDLE_RESTART_COUNT": str(restart_count),
+        "FLAGS_selected_devices": device if device is not None else str(local_rank),
+    }
+
+
+class Status:
+    UNINIT = "uninit"
+    READY = "ready"
+    RUNNING = "running"
+    FAILED = "failed"
+    TERMINATING = "terminating"
+    COMPLETED = "completed"
+
+
+class Container:
+    """One trainer subprocess + env + log redirection."""
+
+    def __init__(self, entrypoint: List[str], env: Dict[str, str],
+                 log_path: Optional[str] = None, rank: int = -1):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.log_path = log_path
+        self.rank = rank
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_fh = None
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env.update(self.env)
+        stdout = stderr = None
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+            self._log_fh = open(self.log_path, "ab", buffering=0)
+            stdout = stderr = self._log_fh
+        self.proc = subprocess.Popen(self.entrypoint, env=env,
+                                     stdout=stdout, stderr=stderr)
+
+    @property
+    def exit_code(self) -> Optional[int]:
+        return self.proc.poll() if self.proc else None
+
+    def status(self) -> str:
+        if self.proc is None:
+            return Status.UNINIT
+        code = self.proc.poll()
+        if code is None:
+            return Status.RUNNING
+        return Status.COMPLETED if code == 0 else Status.FAILED
+
+    def terminate(self, force: bool = False) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            self._close_log()
+            return
+        self.proc.send_signal(signal.SIGKILL if force else signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=8)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        self._close_log()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        if self.proc is None:
+            return None
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def _close_log(self):
+        if self._log_fh is not None:
+            try:
+                self._log_fh.close()
+            finally:
+                self._log_fh = None
+
+    def logs(self, tail: int = 4096) -> str:
+        if not self.log_path or not os.path.exists(self.log_path):
+            return ""
+        with open(self.log_path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail))
+            return f.read().decode(errors="replace")
+
+
+class Pod:
+    """The set of local containers on this node (reference Pod)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name or f"pod-{os.getpid()}"
+        self.containers: List[Container] = []
+        self.restart_count = 0
+
+    def add_container(self, entrypoint, env, log_path=None, rank=-1):
+        self.containers.append(Container(entrypoint, env, log_path, rank))
+
+    def deploy(self) -> None:
+        for c in self.containers:
+            c.start()
+
+    def status(self) -> str:
+        stats = [c.status() for c in self.containers]
+        if any(s == Status.FAILED for s in stats):
+            return Status.FAILED
+        if any(s == Status.RUNNING for s in stats):
+            return Status.RUNNING
+        if stats and all(s == Status.COMPLETED for s in stats):
+            return Status.COMPLETED
+        return Status.UNINIT
+
+    def join(self, poll_interval: float = 0.2) -> str:
+        """Block until every container exits or one fails."""
+        while True:
+            s = self.status()
+            if s in (Status.FAILED, Status.COMPLETED):
+                return s
+            time.sleep(poll_interval)
+
+    def stop(self, force: bool = False) -> None:
+        for c in self.containers:
+            c.terminate(force=force)
+
+    def reset(self) -> None:
+        """Drop dead containers so the pod can be rebuilt for a restart."""
+        self.stop(force=True)
+        self.containers = []
+        self.restart_count += 1
+
+
+class Job:
+    def __init__(self, job_id: str = "default"):
+        self.id = job_id
+        self.pod = Pod()

@@ -1,0 +1,39 @@
+"""Entry: ``python -m paddle_tpu.distributed.launch [opts] train.py ...``.
+
+Reference: python/paddle/distributed/launch/main.py (SURVEY.md §2.6);
+console-script ``fleetrun`` equivalent.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+from typing import List, Optional
+
+from .context import Context
+from .controller import CollectiveController
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    ctx = Context(argv)
+    logging.basicConfig(
+        level=getattr(logging, ctx.args.log_level.upper(), logging.INFO),
+        format="LAUNCH %(levelname)s %(asctime)s %(message)s")
+    ctrl = CollectiveController(ctx)
+
+    def _sig(_signum, _frame):
+        ctrl.stop()
+        sys.exit(130)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    return ctrl.run()
+
+
+def main() -> None:
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

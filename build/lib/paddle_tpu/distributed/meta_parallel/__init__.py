@@ -1,0 +1,11 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, mark_as_sequence_parallel_parameter,
+    mp_all_gather_last_dim, mp_all_reduce,
+)
+from .random_state import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .parallel_wrapper import HybridParallelModel  # noqa: F401

@@ -1,0 +1,234 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Rebuild of python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py (VocabParallelEmbedding / ColumnParallelLinear /
+RowParallelLinear / ParallelCrossEntropy — SURVEY.md §2.4 TP row).
+
+Dual execution modes with ONE weight layout (global shapes + PartitionSpec):
+
+* **GSPMD mode** (default, pp==1 path): weights carry NamedSharding specs;
+  forwards are plain math; XLA inserts the mp collectives (this replaces the
+  reference's c_identity/mp_allreduce_sum ops).
+* **Manual mode** (inside shard_map, pcontext.manual_parallel active): the
+  engine hands each device its weight shard; forwards issue explicit
+  lax collectives over the mp axis — exactly the reference's comm pattern,
+  lowered to ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...parallel import pcontext, mesh as _mesh
+from ..topology import get_hybrid_communicate_group
+
+
+def _mp_degree() -> int:
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    return _mesh.axis_degree("mp")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out (P(None, 'mp'))."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mp_degree()
+        assert out_features % max(self.world_size, 1) == 0, (
+            f"out_features {out_features} not divisible by mp degree "
+            f"{self.world_size}")
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight._sharding_spec = P(None, "mp")
+        self.weight.is_distributed_param = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P("mp")
+            self.bias.is_distributed_param = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            def fn(xv, wv, *rest):
+                y = jnp.matmul(xv, wv)
+                if rest:
+                    y = y + rest[0]
+                if self.gather_output:
+                    y = lax.all_gather(y, ax, axis=y.ndim - 1, tiled=True)
+                return y
+            args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+            return apply(fn, *args, op_name="col_parallel_linear")
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in (P('mp', None)); input expected sharded
+    on the feature dim when input_is_parallel."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mp_degree()
+        assert in_features % max(self.world_size, 1) == 0
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight._sharding_spec = P("mp", None)
+        self.weight.is_distributed_param = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P()
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            def fn(xv, wv, *rest):
+                if not self.input_is_parallel:
+                    # split the full activation to this rank's slice
+                    n = lax.axis_size(ax)
+                    idx = lax.axis_index(ax)
+                    size = xv.shape[-1] // n
+                    xv = lax.dynamic_slice_in_dim(xv, idx * size, size, xv.ndim - 1)
+                y = jnp.matmul(xv, wv)
+                y = lax.psum(y, ax)
+                if rest:
+                    y = y + rest[0]
+                return y
+            args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+            return apply(fn, *args, op_name="row_parallel_linear")
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Weight [vocab, emb] sharded on vocab (P('mp', None))."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.world_size = _mp_degree()
+        assert num_embeddings % max(self.world_size, 1) == 0
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight._sharding_spec = P("mp", None)
+        self.weight.is_distributed_param = True
+
+    def forward(self, x):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            def fn(ids, wv):
+                n = lax.axis_size(ax)
+                idx = lax.axis_index(ax)
+                per = wv.shape[0]  # local vocab size
+                start = idx * per
+                ids32 = ids.astype(jnp.int32)
+                local = ids32 - start
+                in_range = (local >= 0) & (local < per)
+                safe = jnp.where(in_range, local, 0)
+                emb = jnp.take(wv, safe, axis=0)
+                emb = jnp.where(in_range[..., None], emb, 0.0)
+                return lax.psum(emb, ax)
+            return apply(fn, x, self.weight, op_name="vocab_parallel_embedding")
+        return F.embedding(x, self.weight)
+
+
+def vocab_parallel_ce_array(lg, lab, axis: str, ignore_index: Optional[int] = None):
+    """Array-level CE over vocab-sharded logits inside shard_map (shared by
+    ParallelCrossEntropy and the llama hybrid step). lg: (..., V_local) fp32;
+    lab: (...) int. Returns per-token loss; ignored positions get 0."""
+    lg = lg.astype(jnp.float32)
+    idx = lax.axis_index(axis)
+    per = lg.shape[-1]
+    start = idx * per
+    # stability shift; input detached because pmax has no AD rule and the
+    # shift's gradient contributions cancel exactly
+    gmax = lax.pmax(lax.stop_gradient(jnp.max(lg, axis=-1)), axis)
+    ex = jnp.exp(lg - gmax[..., None])
+    denom = lax.psum(jnp.sum(ex, axis=-1), axis)
+    li = lab.astype(jnp.int32)
+    local = li - start
+    ok = (local >= 0) & (local < per)
+    picked = jnp.take_along_axis(lg, jnp.where(ok, local, 0)[..., None],
+                                 axis=-1)[..., 0]
+    target = lax.psum(jnp.where(ok, picked, 0.0), axis)
+    loss = jnp.log(denom) + gmax - target
+    if ignore_index is not None:
+        loss = jnp.where(li != ignore_index, loss, 0.0)
+    return loss
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over vocab-sharded logits.
+
+    Manual mode mirrors the reference's c_softmax_with_cross_entropy: pmax for
+    the global max, psum for the denominator, masked pick + psum for the
+    target logit — no all_gather of the [.., vocab] logits.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            ignore = self.ignore_index
+
+            def fn(logits, lab):
+                li = lab
+                if li.ndim == logits.ndim:
+                    li = li[..., 0]
+                return vocab_parallel_ce_array(logits, li, ax,
+                                               ignore_index=ignore)
+
+            return apply(fn, input, label, op_name="parallel_cross_entropy")
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.is_sequence_parallel = True
+
+
+# functional helpers used inside manual-mode model code -----------------------
+def mp_all_gather_last_dim(x: Tensor) -> Tensor:
+    ax = pcontext.manual_axis("mp")
+    if ax is None:
+        return x
+    return apply(lambda v: lax.all_gather(v, ax, axis=v.ndim - 1, tiled=True),
+                 x, op_name="mp_all_gather")
+
+
+def mp_all_reduce(x: Tensor) -> Tensor:
+    ax = pcontext.manual_axis("mp")
+    if ax is None:
+        return x
+    return apply(lambda v: lax.psum(v, ax), x, op_name="mp_allreduce_sum")

@@ -1,0 +1,86 @@
+"""Model wrappers returned by fleet.distributed_model.
+
+Rebuild of the reference's TensorParallel / ShardingParallel wrappers
+(python/paddle/distributed/fleet/meta_parallel/{tensor_parallel,
+sharding_parallel}.py — SURVEY.md §2.4). Forward stays imperative; the
+compiled path is obtained with ``compile_train_step`` which returns the
+GSPMD HybridTrainStep over the fleet mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ...nn.layer import Layer
+
+
+class HybridParallelModel(Layer):
+    def __init__(self, model: Layer, hcg, strategy):
+        super().__init__()
+        self._layers = model
+        self._hcg = hcg
+        self._strategy = strategy
+        self._train_step = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    @property
+    def inner_model(self):
+        return self._layers
+
+    def compile_train_step(self, loss_fn: Callable, optimizer):
+        """loss_fn(model, *batch) -> scalar. Returns the compiled hybrid step
+        (cached). Strategy amp wraps the loss in auto_cast inside the traced
+        program (the compiled analog of the reference's amp pass)."""
+        from ..fleet.hybrid_engine import HybridTrainStep
+        from ..fleet.meta_optimizers import unwrap_optimizer
+        if self._train_step is None:
+            if self._strategy is not None and (
+                    getattr(self._strategy, "gradient_merge", False)
+                    or getattr(self._strategy, "localsgd", False)):
+                # these compose as eager step-loop wrappers; unwrapping to the
+                # base update rule here would silently drop them
+                raise ValueError(
+                    "strategy.gradient_merge / strategy.localsgd are eager "
+                    "step-loop transforms and are not applied inside the "
+                    "compiled hybrid step — drive training through "
+                    "opt.step()/clear_grad() (or use micro-batching via the "
+                    "pipeline engine's accumulate_steps) instead")
+            inner_opt = unwrap_optimizer(optimizer)
+            stage = 1
+            if self._strategy is not None and self._strategy.sharding:
+                stage = int(self._strategy.sharding_configs.get("stage", 1))
+            if self._strategy is not None and self._strategy.amp:
+                from ... import amp as _amp
+                c = self._strategy.amp_configs
+                base_loss = loss_fn
+
+                def loss_fn(model, *batch, _base=base_loss, _c=c):
+                    with _amp.auto_cast(
+                            enable=True, level=_c.get("level", "O1"),
+                            dtype=_c.get("dtype", "bfloat16"),
+                            custom_white_list=_c.get("custom_white_list"),
+                            custom_black_list=_c.get("custom_black_list")):
+                        return _base(model, *batch)
+            self._train_step = HybridTrainStep(
+                self._layers, loss_fn, inner_opt,
+                mesh=self._hcg.mesh if self._hcg else None,
+                zero_stage=stage)
+        return self._train_step
+
+    def train_batch(self, batch, optimizer, lr_scheduler=None, loss_fn=None):
+        if self._train_step is None:
+            if loss_fn is None:
+                raise ValueError("first train_batch call needs loss_fn")
+            self.compile_train_step(loss_fn, optimizer)
+        loss = self._train_step(*batch)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

@@ -1,0 +1,118 @@
+"""Pipeline-parallel training wrapper.
+
+Rebuild of python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+(PipelineParallel.train_batch, 1F1B / interleave schedules — SURVEY.md §2.4
+PP row, §3.2 call stack).
+
+Two execution paths:
+
+* **Generic path (this class)** — microbatch loop over the PipelineLayer's
+  stages with gradient accumulation. Semantically identical to GPipe
+  fill-drain (loss/grads match 1F1B exactly; schedules differ only in memory
+  and overlap). In the single-controller world every stage's ops are issued
+  from one host; XLA/async dispatch overlaps them across devices when stage
+  parameters are sharded onto pp submeshes.
+* **Compiled scan path** — for homogeneous decoder stacks the hybrid engine
+  compiles the whole fill-drain pipeline into one XLA program with ppermute
+  rotation (parallel/pipeline.py); used by the transformer models and the
+  benchmark (models/llama.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        h = strategy.hybrid_configs if strategy is not None else {}
+        self.micro_batch_size = int(h.get("micro_batch_size", 1))
+        self.accumulate_steps = int(h.get("accumulate_steps", 1))
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def inner_model(self):
+        return self._layers
+
+    def _split_micro(self, data):
+        """Split (inputs, labels) into accumulate_steps microbatches."""
+        inputs, labels = data
+        n = self.accumulate_steps
+        def split(t):
+            if isinstance(t, (list, tuple)):
+                return [split(e) for e in t]
+            b = t.shape[0]
+            if b % n != 0:
+                raise ValueError(
+                    f"batch size {b} is not divisible by accumulate_steps {n}")
+            mb = b // n
+            return [t[i * mb:(i + 1) * mb] for i in range(n)]
+        ins = split(inputs)
+        labs = split(labels)
+        if isinstance(inputs, (list, tuple)):
+            ins = list(zip(*ins))
+        if isinstance(labels, (list, tuple)):
+            labs = list(zip(*labs))
+        return list(zip(ins, labs))
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """GPipe-equivalent gradient accumulation over microbatches.
+        Reference: forward_backward_pipeline + 1F1B (SURVEY.md §3.2)."""
+        assert self._layers._loss_fn is not None, "PipelineLayer needs loss_fn"
+        micro = self._split_micro(data)
+        total = None
+        for mb_in, mb_lab in micro:
+            out = self._layers(mb_in)
+            loss = self._layers._loss_fn(out, mb_lab)
+            scaled = loss / len(micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = scaled.detach() if total is None else total + scaled.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = total
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        micro = self._split_micro(data)
+        total = None
+        from ...core import autograd as _ag
+        with _ag.no_grad():
+            for mb_in, mb_lab in micro:
+                out = self._layers(mb_in)
+                if compute_loss:
+                    loss = self._layers._loss_fn(out, mb_lab) / len(micro)
+                    total = loss if total is None else total + loss
+        return total
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved virtual-pipeline schedule (reference: same class name).
+
+    Eager path: numerics identical to the base schedule (gradient
+    accumulation commutes), so train_batch is inherited. The *compiled*
+    interleave — the systolic one-chunk-per-tick scan with the v-fold
+    bubble reduction — is parallel/pipeline.py::pipeline_spmd_interleaved;
+    homogeneous decoder stacks should route through it with chunk params
+    pre-permuted by interleave_chunk_order."""
+    pass

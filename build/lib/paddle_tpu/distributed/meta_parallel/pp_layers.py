@@ -1,0 +1,118 @@
+"""Pipeline-layer description & partitioning.
+
+Rebuild of python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py (LayerDesc / SharedLayerDesc / PipelineLayer — SURVEY.md §2.4 PP
+row). PipelineLayer partitions a layer list into stages; execution happens in
+the compiled hybrid engine (parallel/pipeline.py) rather than per-process
+NCCL p2p.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...nn.layer import Layer, LayerList, Sequential
+from ..topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in multiple stages (e.g. embedding +
+    output head). All instances share the first-built layer's parameters."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Holds the full layer list + the stage partition.
+
+    In the reference each process builds only its stage; in the
+    single-controller rebuild all stages are built (device memory is governed
+    by shardings, not host construction) and the hybrid engine maps stage
+    parameters onto pp submeshes.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None and hcg is not None:
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._num_stages = max(int(num_stages or 1), 1)
+
+        self._descs = list(layers)
+        self._shared_instances = {}
+        built: List[Any] = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_instances:
+                    src = self._shared_instances[d.layer_name]
+                    inst = d.build_layer()
+                    # tie: point the shared attr at the original Parameter
+                    setattr(inst, d.shared_weight_attr,
+                            getattr(src, d.shared_weight_attr))
+                else:
+                    inst = d.build_layer()
+                    self._shared_instances[d.layer_name] = inst
+                inst._pp_forward_func = d.forward_func
+                built.append(inst)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"invalid pipeline entry {d!r}")
+        self.run_function = built
+        self._layers_holder = LayerList([l for l in built if isinstance(l, Layer)])
+        self._stage_bounds = self._partition(len(built), self._num_stages,
+                                             seg_method)
+
+    @staticmethod
+    def _partition(n_layers: int, n_stages: int, seg_method: str) -> List[int]:
+        """Uniform split bounds (len n_stages+1), parity with seg_method
+        'uniform' / 'layer:<cls>' (uniform here)."""
+        base = n_layers // n_stages
+        extra = n_layers % n_stages
+        bounds = [0]
+        for s in range(n_stages):
+            bounds.append(bounds[-1] + base + (1 if s < extra else 0))
+        return bounds
+
+    def get_stage_layers(self, stage_id: int) -> List[Any]:
+        lo, hi = self._stage_bounds[stage_id], self._stage_bounds[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x

@@ -1,0 +1,94 @@
+"""Per-group RNG state tracker.
+
+Rebuild of python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+random.py (``RNGStatesTracker`` — SURVEY.md §2.4 TP row). The reference keeps
+separate CUDA RNG states per parallel group so dropout inside TP regions is
+identical across mp ranks ("local_seed" vs "global_seed"). With jax PRNG keys
+this is fold_in bookkeeping: each named state is a key derived from the base
+seed; inside shard_map, model-parallel regions additionally fold in the mp
+axis index (or deliberately do NOT, to keep dropout identical across mp).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...parallel import pcontext
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+def _stable_hash(name: str) -> int:
+    h = 0
+    for c in name:
+        h = (h * 131 + ord(c)) % (2 ** 31 - 1)
+    return h
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states: Dict[str, jax.Array] = {}
+        self.seeds = set()
+        self._counters: Dict[str, int] = {}
+
+    def reset(self):
+        self.states = {}
+        self.seeds = set()
+        self._counters = {}
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states:
+            raise ValueError(f"state {name} already exists")
+        self.seeds.add(seed)
+        self.states[name] = jax.random.key(seed)
+        self._counters[name] = 0
+
+    def get_states_tracker(self):
+        return dict(self.states), dict(self._counters)
+
+    def set_states_tracker(self, states):
+        self.states, self._counters = dict(states[0]), dict(states[1])
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Inside this context, framework RNG draws come from the named
+        per-group state. In manual mode the key is folded with the mp axis
+        index so dropout differs per mp rank (the reference's local_seed
+        semantics)."""
+        if name not in self.states:
+            # lazily seed from the global framework seed
+            self.add(name, 2718 + len(self.states))
+        from ... import random as _random
+
+        self._counters[name] += 1
+        # Under a compiled step, derive from the ambient *traced* key so masks
+        # vary per executed step (a concrete state key would be baked into the
+        # trace and replay the same mask forever).
+        ambient = _random._state.traced_key
+        base = ambient if ambient is not None else self.states[name]
+        key = jax.random.fold_in(base, self._counters[name])
+        key = jax.random.fold_in(key, _stable_hash(name))
+        ax = pcontext.manual_axis("mp")
+        if pcontext.in_manual_mode() and ax is not None:
+            key = jax.random.fold_in(key, lax.axis_index(ax))
+        with _random.traced_key_scope(key):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 2718):
+    _tracker.reset()
+    _tracker.add(MODEL_PARALLEL_RNG, seed)

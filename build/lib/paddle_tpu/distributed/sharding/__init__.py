@@ -1,0 +1,24 @@
+"""``paddle_tpu.distributed.sharding`` — ZeRO group-sharded data parallelism.
+
+Rebuild of python/paddle/distributed/sharding/group_sharded.py and
+python/paddle/distributed/fleet/meta_parallel/sharding/ (SURVEY.md §2.4
+Sharding row): stage 1 ("os") shards optimizer state, stage 2 ("os_g") also
+shards gradients, stage 3 ("p_g_os") additionally shards parameters (FSDP).
+
+TPU-native mechanism: instead of per-rank python bookkeeping + NCCL
+reduce-scatter/allgather (reference GroupShardedStage2/3), shards are
+expressed as ``NamedSharding`` placements over the ``sharding`` mesh axis.
+XLA then materialises the reduce-scatter (grads), the sharded update
+(optimizer state) and the on-demand all-gathers (stage-3 params) — in eager
+mode via explicit ``device_put`` placement, in compiled steps via GSPMD
+(jit.HybridTrainStep's ``zero_stage``).
+"""
+
+from .group_sharded import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+    group_sharded_parallel,
+    save_group_sharded_model,
+    shard_spec_for,
+)

@@ -1,0 +1,77 @@
+"""``paddle.distributed.spawn`` parity: single-node multiprocess launcher.
+
+Reference: python/paddle/distributed/spawn.py (SURVEY.md §2.6). Spawns
+``nprocs`` Python processes running ``func(*args)`` with the same
+``PADDLE_*`` env the launch CLI would inject, then joins them.
+
+TPU note: one jax process owns all local chips, so per-chip spawning is a
+CPU-backend testing pattern here (set JAX_PLATFORMS=cpu in the parent, or
+pass ``env={...}``); on real multi-host TPU use the launch CLI per host.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Dict, Optional, Sequence
+
+from .launch.context import free_ports, free_port
+from .launch.job import build_trainer_env
+
+
+class ProcessContext:
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Join all workers. If any worker dies non-zero while siblings are
+        still running, the survivors are terminated (they may be blocked on
+        a rendezvous with the dead rank) and RuntimeError is raised —
+        reference spawn behaviour."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            alive = [p for p in self.processes if p.is_alive()]
+            bad = [p for p in self.processes
+                   if not p.is_alive() and p.exitcode != 0]
+            if bad:
+                for p in alive:
+                    p.terminate()
+                for p in alive:
+                    p.join(5)
+                raise RuntimeError(
+                    f"{len(bad)} spawned process(es) failed with exit codes "
+                    f"{[p.exitcode for p in bad]}")
+            if not alive:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            alive[0].join(0.2)
+
+
+def _worker(func, i: int, args, env: Dict[str, str]):
+    os.environ.update(env)
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, env: Optional[Dict[str, str]] = None,
+          **options) -> ProcessContext:
+    ports = free_ports(nprocs)
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    master = f"127.0.0.1:{free_port()}"
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    procs = []
+    for i in range(nprocs):
+        child_env = build_trainer_env(i, nprocs, i, nprocs, eps[i], eps,
+                                      master)
+        if env:
+            child_env.update(env)
+        p = ctx.Process(target=_worker, args=(func, i, tuple(args), child_env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    pc = ProcessContext(procs)
+    if join:
+        pc.join()
+    return pc

@@ -1,0 +1,177 @@
+"""Hybrid-parallel topology.
+
+Rebuild of CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py — SURVEY.md §2.4 hybrid
+row). The reference builds a cartesian rank grid and one NCCL group per axis;
+here the grid IS a jax Mesh and each "group" is a mesh-axis handle
+(collective.Group). Rank→coordinate bijection matches the reference's order
+["dp", "pp", "sharding", "sep", "mp"].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .collective import Group
+from ..parallel import mesh as _mesh
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: List[str] = None,
+                 dims: List[int] = None):
+        self._parallel_names = hybrid_group_names or list(_mesh.HYBRID_ORDER)
+        self._dims = dims or [1] * len(self._parallel_names)
+        self._world_size = int(np.prod(self._dims))
+        shape = tuple(self._dims)
+        self._coord_of_rank = {}
+        for rank in range(self._world_size):
+            self._coord_of_rank[rank] = np.unravel_index(rank, shape)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(np.ravel_multi_index(coord, tuple(self._dims)))
+
+    def get_coord(self, rank: int):
+        return tuple(int(c) for c in self._coord_of_rank[rank])
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coord_of_rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along the axis: lists of world ranks that differ only in
+        this coordinate."""
+        axis = self._parallel_names.index(axis_name)
+        groups: Dict[tuple, List[int]] = {}
+        for rank, coord in self._coord_of_rank.items():
+            key = tuple(c for i, c in enumerate(coord) if i != axis)
+            groups.setdefault(key, []).append(rank)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self.get_rank(**dict(zip(self._parallel_names, coord)))
+
+
+class HybridCommunicateGroup:
+    """Axis-group query API (parity with the reference class of the same
+    name). Groups returned are mesh-axis handles usable with
+    distributed.collective functions and inside compiled programs."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self._dp_degree = topology.get_dim("dp")
+        self._pp_degree = topology.get_dim("pp")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("mp")
+        self.global_rank = 0
+        degrees = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
+        mesh = _mesh.get_global_mesh()
+        if mesh is None or dict(zip(mesh.axis_names, [mesh.shape[a] for a in mesh.axis_names])) != \
+                {ax: degrees.get(ax, 1) for ax in _mesh.HYBRID_ORDER}:
+            try:
+                mesh = _mesh.build_mesh(degrees)
+                _mesh.set_global_mesh(mesh)
+            except ValueError:
+                mesh = _mesh.get_global_mesh()
+        self.mesh = mesh
+
+    # degrees -------------------------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    # ranks (single-controller: coordinate of "this" process is 0; in-program
+    # coordinates come from lax.axis_index) --------------------------------
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def get_sharding_parallel_rank(self) -> int:
+        return 0
+
+    def get_sep_parallel_rank(self) -> int:
+        return 0
+
+    # groups ---------------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return Group("dp", self.mesh)
+
+    def get_model_parallel_group(self) -> Group:
+        return Group("mp", self.mesh)
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group("pp", self.mesh)
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group("sharding", self.mesh)
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group("sep", self.mesh)
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return Group("mp", self.mesh)
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return 0
+
+    # pipe helpers ---------------------------------------------------------
+    def is_first_stage(self) -> bool:
+        # single controller executes every stage, so it is both first and last
+        return True
+
+    def is_last_stage(self) -> bool:
+        return True
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+
+_hcg: List[Optional[HybridCommunicateGroup]] = [None]
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    _hcg[0] = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg[0]

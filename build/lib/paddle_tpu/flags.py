@@ -1,0 +1,88 @@
+"""Global flag registry.
+
+TPU-native rebuild of the reference's gflags-workalike flag plane
+(reference: paddle/utils/flags.h, paddle/phi/core/flags.cc — see SURVEY.md §5.6).
+Flags are plain Python values with env-var override (``FLAGS_<name>``),
+inspectable via :func:`get_flags` / settable via :func:`set_flags`
+(API parity with ``paddle.get_flags`` / ``paddle.set_flags``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name: str, default: Any, typ: type, help_str: str):
+        self.name = name
+        self.default = default
+        self.type = typ
+        self.help = help_str
+        self.value = self._from_env(default)
+
+    def _from_env(self, default: Any) -> Any:
+        raw = os.environ.get("FLAGS_" + self.name)
+        if raw is None:
+            return default
+        return _parse(raw, self.type)
+
+
+def _parse(raw: str, typ: type) -> Any:
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag (idempotent; keeps the existing value on re-register)."""
+    if name in _REGISTRY:
+        return
+    _REGISTRY[name] = _Flag(name, default, type(default), help_str)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    if flags is None:
+        names: List[str] = list(_REGISTRY)
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    out = {}
+    for n in names:
+        key = n[len("FLAGS_"):] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag: {n}")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for n, v in flags.items():
+        key = n[len("FLAGS_"):] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag: {n}")
+        f = _REGISTRY[key]
+        f.value = _parse(v, f.type) if isinstance(v, str) and f.type is not str else f.type(v)
+
+
+def flag_value(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flag corpus (subset of reference's paddle/phi/core/flags.cc that is
+# meaningful on TPU/XLA; allocator/cudnn/nccl flags have no analog).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for nan/inf (debug pass).")
+define_flag("check_nan_inf_level", 0, "0: report all; higher levels reduce verbosity.")
+define_flag("use_pallas_kernels", True, "Use Pallas kernels on TPU (fall back to XLA ops otherwise).")
+define_flag("deterministic", False, "Force deterministic compilation/reductions where possible.")
+define_flag("log_level", 0, "VLOG-style verbosity for framework-internal logging.")
+define_flag("benchmark", False, "Block on every op for timing (eager debugging).")
+define_flag("ring_attention_mode", "ring", "Long-context attention mode: 'ring' or 'ulysses'.")
+define_flag("remat_policy", "none", "Default rematerialisation policy: none|dots|everything.")

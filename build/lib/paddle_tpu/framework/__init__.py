@@ -1,0 +1,9 @@
+"""Framework-plane utilities (save/load, dtype/device context).
+
+Parity with python/paddle/framework/ of the reference (SURVEY.md §5.4 tier 1:
+paddle.save/load — python/paddle/framework/io.py).
+"""
+
+from . import io_save  # noqa: F401
+from .io_save import save, load  # noqa: F401
+from ..core.dtype import set_default_dtype, get_default_dtype  # noqa: F401

@@ -1,0 +1,75 @@
+"""``paddle_tpu.save`` / ``load`` — single-process checkpoint tier.
+
+Rebuild of python/paddle/framework/io.py (SURVEY.md §5.4 tier 1): state dicts
+are pickled with tensors converted to numpy (bfloat16 stored via ml_dtypes
+view). Distributed sharded checkpoints live in distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (dtype name + raw bytes + shape)."""
+
+    def __init__(self, arr):
+        a = np.asarray(arr)
+        self.dtype = str(a.dtype)
+        self.shape = a.shape
+        if a.dtype == jnp.bfloat16:
+            self.dtype = "bfloat16"
+            self.data = a.view(np.uint16).tobytes()
+        else:
+            self.data = a.tobytes()
+
+    def to_numpy(self):
+        if self.dtype == "bfloat16":
+            u16 = np.frombuffer(self.data, dtype=np.uint16).reshape(self.shape)
+            return u16.view(jnp.bfloat16)
+        return np.frombuffer(self.data, dtype=np.dtype(self.dtype)).reshape(self.shape)
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj._value)
+    if isinstance(obj, (jnp.ndarray,)) or type(obj).__module__.startswith("jax"):
+        try:
+            return _TensorPayload(obj)
+        except Exception:
+            return obj
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, _TensorPayload):
+        return Tensor(jnp.asarray(obj.to_numpy()))
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **kwargs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, **kwargs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

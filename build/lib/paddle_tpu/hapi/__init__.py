@@ -1,0 +1,6 @@
+"""``paddle_tpu.hapi`` — high-level Model API (reference:
+python/paddle/hapi/ — SURVEY.md §2.5 hapi row)."""
+
+from .model import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .progressbar import ProgressBar  # noqa: F401

@@ -1,0 +1,5 @@
+"""``paddle_tpu.incubate`` — fused layers and MoE (reference:
+python/paddle/incubate/)."""
+
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

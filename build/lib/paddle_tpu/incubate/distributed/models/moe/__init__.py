@@ -1,0 +1,7 @@
+"""Mixture-of-Experts (reference:
+python/paddle/incubate/distributed/models/moe/ — SURVEY.md §2.4 EP row)."""
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+from .utils import global_gather, global_scatter  # noqa: F401

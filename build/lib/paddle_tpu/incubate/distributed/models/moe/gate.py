@@ -1,0 +1,142 @@
+"""MoE gates — Naive / GShard top-2 / Switch top-1.
+
+Rebuild of python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py:§0 (SURVEY.md §2.4 EP row). Each gate returns
+(per-k expert indices, per-k combine probs) and stashes its load-balancing
+auxiliary loss on ``self.l_aux``.
+
+Differentiability: probs/aux-loss flow through the eager tape (Tensor ops);
+index computations (top-k choice, capacity pruning, random routing) are
+index-only and run raw — they carry no gradient by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....nn.layer import Layer
+from .....nn import functional as F
+from .....core import math_ops as pm
+from .....core.tensor import Tensor
+from .....ops import moe_ops
+from ..... import random as _random
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert: int, world_size: int = 1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.l_aux = None
+
+    def capacity(self, num_tokens: int, capacity_factor: float) -> int:
+        return max(int(np.ceil(capacity_factor * num_tokens / self.tot_expert)), 1)
+
+
+def _gate_probs(gate_layer, inp) -> Tensor:
+    """fp32 softmax over expert logits, on the tape."""
+    logits = pm.matmul(inp, gate_layer.gate)
+    return F.softmax(pm.cast(logits, "float32"), axis=-1)
+
+
+def _aux_loss(probs: Tensor, top1_idx, num_experts: int) -> Tensor:
+    """GShard/Switch load-balance loss: E * sum_e mean(P_e) * frac_top1_e.
+    ``top1_idx`` is index data (constant); probs stay differentiable."""
+    ce = jax.nn.one_hot(jnp.asarray(top1_idx), num_experts,
+                        dtype=jnp.float32).mean(axis=0)
+    me = pm.mean(probs, axis=0)
+    return pm.sum(me * Tensor(ce)) * float(num_experts)
+
+
+class NaiveGate(BaseGate):
+    """Plain linear top-k gate, no capacity, no aux loss."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.topk = topk
+        self.gate = self.create_parameter((d_model, self.tot_expert))
+
+    def forward(self, inp):
+        probs = _gate_probs(self, inp)
+        topv, topi = pm.topk(probs, self.topk, axis=-1)
+        self.l_aux = Tensor(jnp.zeros((), jnp.float32))
+        return topi, topv
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with capacity, random 2nd-expert routing, aux loss
+    (reference gshard_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), random_routing: bool = True,
+                 group=None):
+        super().__init__(num_expert, world_size)
+        assert topk == 2, "GShard gate is top-2"
+        self.topk = 2
+        self.capacity_factor = capacity
+        self.random_routing = random_routing
+        self.gate = self.create_parameter((d_model, self.tot_expert))
+
+    def forward(self, inp):
+        n = inp.shape[0]
+        probs = _gate_probs(self, inp)
+        topv, topi = pm.topk(probs, 2, axis=-1)
+        idx = topi._value
+        self.l_aux = _aux_loss(probs, idx[:, 0], self.tot_expert)
+        raw_idx = idx
+        if self.random_routing and self.training:
+            prob = jax.random.uniform(_random.next_key(), (n,))
+            raw_idx = moe_ops.random_routing(raw_idx, topv._value, prob)
+        factor = self.capacity_factor
+        if isinstance(factor, (tuple, list)):
+            factor = factor[0] if self.training else factor[1]
+        cap = self.capacity(n, factor)
+        # joint capacity pruning, choice order = GShard order (index-only)
+        masks = moe_ops.dispatch_masks_topk(raw_idx, self.tot_expert, cap)
+        kept = [m.sum(axis=(1, 2)) > 0 for m in masks]
+        raw_idx = jnp.stack(
+            [jnp.where(kept[k], raw_idx[:, k], -1) for k in range(2)], axis=1)
+        # pruning zeroed the dropped tokens' rows, so these masks are exactly
+        # the dispatch masks for the pruned indices — let MoELayer reuse them
+        self._dispatch_cache = (raw_idx, cap, masks)
+        return Tensor(raw_idx), topv
+
+
+class SwitchGate(BaseGate):
+    """Top-1 gate with capacity + aux loss (reference switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(num_expert, world_size)
+        assert topk == 1, "Switch gate is top-1"
+        self.topk = 1
+        self.switch_eps = switch_eps
+        self.capacity_factor = capacity
+        self.gate = self.create_parameter((d_model, self.tot_expert))
+
+    def forward(self, inp):
+        n = inp.shape[0]
+        logits = pm.matmul(inp, self.gate)
+        if self.training:
+            # jitter noise (reference multiplies logits by U[1-eps, 1+eps])
+            noise = jax.random.uniform(
+                _random.next_key(), tuple(logits.shape),
+                minval=1.0 - self.switch_eps, maxval=1.0 + self.switch_eps)
+            logits = logits * Tensor(noise.astype(logits._value.dtype))
+        probs = F.softmax(pm.cast(logits, "float32"), axis=-1)
+        topv, topi = pm.topk(probs, 1, axis=-1)
+        idx = topi._value
+        self.l_aux = _aux_loss(probs, idx[:, 0], self.tot_expert)
+        factor = self.capacity_factor
+        if isinstance(factor, (tuple, list)):
+            factor = factor[0] if self.training else factor[1]
+        cap = self.capacity(n, factor)
+        counts = moe_ops.number_count(idx[:, 0], self.tot_expert)
+        pruned = moe_ops.prune_gate_by_capacity(
+            idx[:, 0], jnp.minimum(counts, cap), self.tot_expert)
+        return Tensor(pruned[:, None]), topv

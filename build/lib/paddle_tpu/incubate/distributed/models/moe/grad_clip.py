@@ -1,0 +1,63 @@
+"""Expert-aware global-norm clip.
+
+Rebuild of python/paddle/incubate/distributed/models/moe/grad_clip.py:§0
+(ClipGradForMOEByGlobalNorm): expert parameters (tagged ``p.expert``) are
+local to an expert-parallel rank, so their squared norm must be summed over
+the expert group before joining the global norm. Single-controller arrays
+are already global; in the manual shard_map path the psum over the expert
+axis mirrors the reference's allreduce on the moe group.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .....optimizer.clip import ClipGradByGlobalNorm
+from .....parallel import pcontext
+
+
+def _sq_sum(grads):
+    sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads
+          if g is not None]
+    if not sq:
+        return jnp.asarray(0.0, jnp.float32)
+    total = sq[0]
+    for s in sq[1:]:
+        total = total + s
+    return total
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.moe_group = moe_group
+        self.is_expert_param_func = is_expert_param_func or (
+            lambda p: getattr(p, "expert", False))
+
+    def _clip(self, params_grads):
+        normal = [(p, g) for p, g in params_grads
+                  if g is not None and not self.is_expert_param_func(p)]
+        expert = [(p, g) for p, g in params_grads
+                  if g is not None and self.is_expert_param_func(p)]
+        sq_normal = _sq_sum([g for p, g in normal
+                             if getattr(p, "need_clip", True)])
+        sq_expert = _sq_sum([g for p, g in expert
+                             if getattr(p, "need_clip", True)])
+        if pcontext.in_manual_mode():
+            ax = pcontext.manual_axis("expert") or pcontext.manual_axis("ep")
+            if ax is not None:
+                sq_expert = lax.psum(sq_expert, ax)
+        gnorm = jnp.sqrt(sq_normal + sq_expert)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
+
+
+ClipGradByGlobalNormForMOE = ClipGradForMOEByGlobalNorm

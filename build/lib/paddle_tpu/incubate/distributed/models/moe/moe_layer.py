@@ -1,0 +1,231 @@
+"""MoE layer with expert-parallel dispatch.
+
+Rebuild of python/paddle/incubate/distributed/models/moe/moe_layer.py:§0
+(SURVEY.md §2.4 EP row). Reference pipeline: gate → global_scatter (count
+exchange + NCCL alltoall) → local experts → global_gather. TPU-native: the
+dense GShard dispatch/combine einsums (ops.moe_ops) carry the routing; under
+a mesh with an ``expert``-sharded axis, XLA lowers the expert dimension of
+those einsums to an ICI all_to_all — no hand-written comm. Experts compute on
+fixed-capacity slots, keeping shapes static for XLA.
+
+Gradients: dispatch/combine masks are index-only constants; probabilities,
+expert parameters, gate parameters and the input all differentiate through
+the eager tape (Tensor ops).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .....core import math_ops as pm
+from .....core.tensor import Tensor
+from .....nn.layer import Layer, LayerList
+from .....ops import moe_ops
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+_ACTS = {"GELU": "gelu", "ReLU": "relu", "SiLU": "silu", "Silu": "silu"}
+
+
+def _ffn_parts(expert):
+    """(lin1, lin2, act_name) when ``expert`` is exactly Linear → recognized
+    activation → Linear with a consistent bias layout; None otherwise (the
+    caller falls back to the dense dispatch path rather than silently
+    computing different numerics)."""
+    from .....nn.common_layers import Linear
+
+    linears, acts, others = [], [], 0
+    for _, sub in expert.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            linears.append(sub)
+        elif type(sub).__name__ in _ACTS:
+            acts.append(_ACTS[type(sub).__name__])
+        elif not list(sub.children()):  # unrecognized leaf layer
+            others += 1
+    if len(linears) != 2 or len(acts) != 1 or others:
+        return None
+    l1, l2 = linears
+    if l1.weight.shape[1] != l2.weight.shape[0] or \
+            l1.weight.shape[0] != l2.weight.shape[1]:
+        return None
+    # bias layout must be uniform (the stacked kernel has one has_bias flag)
+    if (l1.bias is None) != (l2.bias is None):
+        return None
+    return l1, l2, acts[0]
+
+
+@functools.lru_cache(maxsize=64)
+def _ep_program(mesh, axis: str, num_experts: int, capacity: int,
+                act_name: str, has_bias: bool):
+    """Cached jitted shard_map running expert_parallel_apply over ``axis``:
+    tokens and stacked expert weights both sharded on dim 0."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if act_name == "gelu":
+        # paddle GELU defaults to the exact erf form; jax.nn.gelu to tanh
+        act = functools.partial(jax.nn.gelu, approximate=False)
+    else:
+        act = getattr(jax.nn, act_name)
+
+    if has_bias:
+        def fn(xl, idx, prob, w1, b1, w2, b2):
+            return moe_ops.expert_parallel_apply(
+                xl, idx, prob, w1, w2, axis, num_experts, capacity,
+                act=act, b1_local=b1, b2_local=b2)
+        n_in = 7
+    else:
+        def fn(xl, idx, prob, w1, w2):
+            return moe_ops.expert_parallel_apply(
+                xl, idx, prob, w1, w2, axis, num_experts, capacity, act=act)
+        n_in = 5
+
+    shmap = jax.shard_map(fn, mesh=mesh, in_specs=(P(axis),) * n_in,
+                          out_specs=P(axis), check_vma=False)
+    return jax.jit(shmap)
+
+
+class MoELayer(Layer):
+    """``MoELayer(d_model, experts=[...], gate='gshard', ...)``.
+
+    experts: list of Layers, each mapping (n, d_model) -> (n, d_model).
+    gate: BaseGate instance or one of 'naive' | 'gshard' | 'switch'.
+    """
+
+    def __init__(self, d_model: int, experts: Optional[List[Layer]] = None,
+                 gate="gshard", moe_group=None, mp_group=None,
+                 recompute_interval: int = 0, random_routing: bool = True,
+                 capacity_factor=(1.2, 2.4), topk: Optional[int] = None,
+                 **kwargs):
+        super().__init__()
+        if not experts:
+            raise ValueError("experts list must be non-empty")
+        self.d_model = d_model
+        self.experts = LayerList(experts)
+        self.num_expert = len(experts)
+        self.moe_group = moe_group
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        elif gate in (None, "naive"):
+            self.gate = NaiveGate(d_model, self.num_expert, 1, topk=topk or 2)
+        elif gate == "gshard":
+            self.gate = GShardGate(d_model, self.num_expert, 1,
+                                   capacity=capacity_factor,
+                                   random_routing=random_routing)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, self.num_expert, 1,
+                                   capacity=capacity_factor)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+        self.capacity_factor = capacity_factor
+        # tag expert params for expert-aware grad clip / no-dp-sync
+        for p in self.experts.parameters():
+            p.expert = True
+        self.l_aux = None
+        # expert-parallel path: when moe_group names a multi-device mesh axis
+        # and every expert is a homogeneous 2-Linear FFN, dispatch routes
+        # through ops.moe_ops.expert_parallel_apply (explicit all_to_all over
+        # the axis — the reference's global_scatter/global_gather) instead of
+        # the dense (N,E,C) einsums + Python expert loop.
+        self._ep_parts = None
+        self._ep_axis = None
+        if moe_group is not None and getattr(moe_group, "nranks", 1) > 1:
+            parts = [_ffn_parts(e) for e in experts]
+            homogeneous = (
+                all(p is not None for p in parts)
+                and len({p[2] for p in parts}) == 1          # same activation
+                and len({p[0].bias is None for p in parts}) == 1)  # same bias
+            if homogeneous and self.num_expert % moe_group.nranks == 0:
+                self._ep_parts = parts
+                self._ep_axis = moe_group.axis
+                self._ep_mesh = moe_group.mesh
+
+    def forward(self, inp):
+        orig_shape = tuple(inp.shape)
+        d = orig_shape[-1]
+        xf = pm.reshape(inp, (-1, d))
+        n = xf.shape[0]
+
+        topi, topv = self.gate(xf)
+        self.l_aux = self.gate.l_aux
+        idx = topi._value
+        K = idx.shape[1]
+
+        # gates that prune by capacity define the factor; otherwise the
+        # layer's own capacity_factor governs (naive/custom gates)
+        factor = getattr(self.gate, "capacity_factor", None)
+        if factor is None:
+            factor = self.capacity_factor
+        if isinstance(factor, (tuple, list)):
+            factor = factor[0] if self.training else factor[1]
+        capacity = max(int(np.ceil(factor * n / self.num_expert)), 1)
+
+        valid = Tensor((idx >= 0).astype(jnp.float32))
+        if K == 1:
+            # top-1 (Switch) semantics: y = p(x) * E(x) — keep the raw gate
+            # prob so the gate trains from the task loss
+            probs = topv * valid
+        else:
+            # top-k: combine probs renormalized over admitted choices
+            probs = topv * valid
+            denom = pm.clip(pm.sum(probs, axis=-1, keepdim=True), min=1e-9)
+            probs = probs / denom
+
+        if self._ep_parts is not None and \
+                n % self._ep_mesh.shape[self._ep_axis] == 0:
+            out = self._forward_expert_parallel(xf, idx, probs, capacity)
+            return pm.reshape(out, orig_shape)
+
+        # reuse the gate's dispatch masks when it already built them for
+        # pruning (GShard); identity check guards against stale caches
+        cached = getattr(self.gate, "_dispatch_cache", None)
+        if cached is not None and cached[0] is idx and cached[1] == capacity:
+            masks = cached[2]
+        else:
+            masks = moe_ops.dispatch_masks_topk(idx, self.num_expert, capacity)
+        dtype = str(xf.dtype).split(".")[-1]
+        disp_sum = Tensor(sum(masks))  # (N,E,C) constant
+        expert_in = pm.einsum("nec,nd->ecd", pm.cast(disp_sum, dtype), xf)
+
+        # run experts on their capacity slots (static python loop: E is small
+        # and each expert owns distinct parameters)
+        outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
+        expert_out = pm.stack(outs, axis=0)  # (E, C, d)
+
+        # combine: sum_k mask_k * prob_k — probs differentiable
+        comb = None
+        for k in range(K):
+            pk = pm.unsqueeze(pm.unsqueeze(probs[:, k], -1), -1)  # (N,1,1)
+            term = pm.cast(Tensor(masks[k]), "float32") * pk
+            comb = term if comb is None else comb + term
+        out = pm.einsum("nec,ecd->nd", pm.cast(comb, dtype), expert_out)
+        return pm.reshape(out, orig_shape)
+
+    def _forward_expert_parallel(self, xf, idx, probs, capacity):
+        """all_to_all dispatch over the moe_group axis: tokens sharded over
+        the axis dispatch locally (per-shard capacity ceil(C/n)), route to
+        the expert's owning device, compute, and route back. Local capacity
+        admission approximates the dense path's global ordering — identical
+        whenever capacity is ample (no drops)."""
+        from .....core.dispatch import apply
+
+        nr = self._ep_mesh.shape[self._ep_axis]
+        cap_local = max(int(np.ceil(capacity / nr)), 1)
+        l1s, l2s, act = (list(z) for z in zip(*self._ep_parts))
+        w1 = pm.stack([l.weight for l in l1s], axis=0)   # (E, d, ff)
+        w2 = pm.stack([l.weight for l in l2s], axis=0)   # (E, ff, d)
+        has_bias = l1s[0].bias is not None
+        prog = _ep_program(self._ep_mesh, self._ep_axis, self.num_expert,
+                           cap_local, act[0], has_bias)
+        idx_t = Tensor(idx)
+        if has_bias:
+            b1 = pm.stack([l.bias for l in l1s], axis=0)
+            b2 = pm.stack([l.bias for l in l2s], axis=0)
+            return apply(prog, xf, idx_t, probs, w1, b1, w2, b2,
+                         op_name="moe_expert_parallel")
+        return apply(prog, xf, idx_t, probs, w1, w2,
+                     op_name="moe_expert_parallel")

@@ -1,0 +1,47 @@
+"""MoE comm-op parity wrappers.
+
+Reference: python/paddle/distributed/utils/moe_utils.py:§0 exposes
+``global_scatter`` / ``global_gather`` (NCCL alltoall dispatch). On TPU these
+are the dispatch/combine einsums (ops.moe_ops) whose expert dim lowers to an
+ICI all_to_all under an expert-sharded mesh; these wrappers keep the API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....ops import moe_ops
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Tokens, sorted by destination expert, are scattered into expert-major
+    layout. Count-based ragged semantics are realized with capacity padding
+    (static shapes): capacity = max count."""
+    lc = _v(local_count).astype(jnp.int32)
+    xv = _v(x)
+    n_expert = lc.shape[0]
+    cap = int(jnp.max(lc))
+    # rebuild per-token expert ids from counts (tokens arrive expert-sorted)
+    ids = jnp.repeat(jnp.arange(n_expert), lc, total_repeat_length=xv.shape[0])
+    disp, _ = moe_ops.dispatch_combine_masks(ids, jnp.ones_like(ids, jnp.float32),
+                                             n_expert, cap)
+    return Tensor(moe_ops.moe_dispatch(xv, disp.astype(xv.dtype)))
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter: expert-major (E,C,d) back to token order."""
+    lc = _v(local_count).astype(jnp.int32)
+    xv = _v(x)
+    n_expert = lc.shape[0]
+    cap = xv.shape[1] if xv.ndim == 3 else int(jnp.max(lc))
+    total = int(jnp.sum(lc))
+    ids = jnp.repeat(jnp.arange(n_expert), lc, total_repeat_length=total)
+    disp, _ = moe_ops.dispatch_combine_masks(ids, jnp.ones((total,), jnp.float32),
+                                             n_expert, cap)
+    return Tensor(moe_ops.moe_combine(xv.reshape(n_expert, cap, -1),
+                                      disp.astype(xv.dtype)))

@@ -1,0 +1,8 @@
+"""``paddle_tpu.incubate.nn`` — fused layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py:§0, SURVEY.md §2.5
+"incubate fused layers")."""
+
+from .layer.fused_transformer import (  # noqa: F401
+    FusedMultiTransformer, FusedMultiHeadAttention, FusedFeedForward,
+)
+from . import functional  # noqa: F401

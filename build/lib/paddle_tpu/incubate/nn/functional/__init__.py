@@ -1,0 +1,41 @@
+"""Functional surface for the fused layers (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py:§0)."""
+
+from __future__ import annotations
+
+from ....core.dispatch import apply
+from ....ops import fused_transformer_block as ftb
+from ....ops.rms_norm import rms_norm_array
+from ....ops.fused_linear import fused_linear_param_grad_add  # noqa: F401
+
+
+def fused_multi_transformer(x, params, *, num_heads, activation="gelu",
+                            epsilon=1e-5, attn_mask=None, cache_kvs=None,
+                            time_step=None, max_cache_len=None, seq_lens=None):
+    """Tensor-level entry for the fused decoder stack
+    (ops/fused_transformer_block.py). Mirrors
+    paddle.incubate.nn.functional.fused_multi_transformer:§0; the layer loop
+    is a scanned XLA computation rather than a CUDA megakernel."""
+    tensors = [x]
+    keys = sorted(params)
+    tensors += [params[k] for k in keys]
+    if cache_kvs is not None:
+        tensors.append(cache_kvs)
+
+    def fn(xv, *rest):
+        pv = dict(zip(keys, rest[:len(keys)]))
+        cache = rest[len(keys)] if cache_kvs is not None else None
+        out, kv = ftb.fused_multi_transformer_array(
+            xv, pv, num_heads=num_heads, act=activation, epsilon=epsilon,
+            attn_mask=attn_mask, cache_kv=cache, time_step=time_step,
+            max_cache_len=max_cache_len, seq_lens=seq_lens)
+        return out if kv is None else (out, kv)
+
+    return apply(fn, *tensors, op_name="fused_multi_transformer")
+
+
+def fused_rms_norm(x, weight, epsilon=1e-6):
+    """paddle.incubate.nn.functional.fused_rms_norm:§0 parity (Pallas kernel
+    in ops/rms_norm.py)."""
+    return apply(lambda xv, wv: rms_norm_array(xv, wv, epsilon), x, weight,
+                 op_name="fused_rms_norm")

@@ -1,0 +1,1 @@
+from . import fused_transformer  # noqa: F401

@@ -1,0 +1,76 @@
+"""Inference Config (parity: paddle.inference.Config).
+
+Reference: paddle/fluid/inference/api/analysis_config.cc pybind surface.
+GPU/TensorRT/IR knobs are accepted for API compatibility; on TPU they map
+to XLA (which always "fuses") or are recorded no-ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Config:
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # jit.save triple prefix: model_path may be "<prefix>" or
+        # "<prefix>.pdmodel" (reference passes model+params separately)
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[: -len(".pdmodel")]
+        self._prefix = model_path
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._switch_ir_optim = True
+        self._cache_dir: Optional[str] = None
+
+    # -- model location ------------------------------------------------------
+    def set_prog_file(self, path: str) -> None:
+        if path.endswith(".pdmodel"):
+            path = path[: -len(".pdmodel")]
+        self._prefix = path
+
+    def prog_file(self) -> str:
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self) -> str:
+        return (self._prefix or "") + ".pdiparams"
+
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        self.set_prog_file(model_path)
+
+    # -- device --------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        """Parity alias: selects the accelerator (TPU here)."""
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device != "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+    # -- optimisation knobs (XLA owns these; recorded no-ops) ----------------
+    def switch_ir_optim(self, flag: bool = True):
+        self._switch_ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._enable_memory_optim = flag
+
+    def enable_tensorrt_engine(self, *args, **kwargs):
+        """TensorRT has no TPU analog; XLA is the compiler (SURVEY §2.5)."""
+
+    def enable_tuned_tensorrt_dynamic_shape(self, *args, **kwargs):
+        pass
+
+    def set_optim_cache_dir(self, path: str):
+        self._cache_dir = path
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix}, device={self._device}, "
+                f"ir_optim={self._switch_ir_optim})")

@@ -1,0 +1,261 @@
+"""Serving decode loop: compiled prefill + KV-cache token generation.
+
+This is the TPU replacement for the reference's inference hot path
+(AnalysisPredictor decode loop over fused_multi_transformer with its CUDA
+KV cache — SURVEY.md §2.2/§3.5): one jitted prefill over the padded prompt
+bucket, then a jitted ``lax.scan`` over decode steps, KV cache donated
+between steps so generation runs without host round-trips.
+
+Prompt lengths are padded to buckets (powers of two by default) — the
+dynamic-shape story on XLA (SURVEY §2.5 CINN row: bucketing/padding
+replaces symbolic shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: int = 0            # 0 = off
+    top_p: float = 1.0        # 1.0 = off
+    do_sample: bool = False   # False = greedy
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+
+class KVCache:
+    """Thin named wrapper over the model's cache pytree (parity surface for
+    the reference's CacheKV tensors)."""
+
+    def __init__(self, tree: Any):
+        self.tree = tree
+
+    @property
+    def seq_capacity(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.tree)
+        return leaves[0].shape[2] if leaves else 0
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _sample(logits, key, cfg: GenerationConfig):
+    logits = logits.astype(jnp.float32)
+    if not cfg.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -cfg.top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class GenerationEngine:
+    """Compiled generation over a model's (prefill, decode_step, init_cache)
+    triple.
+
+    ``prefill(params, ids, cache) -> (logits, cache)``
+    ``decode_step(params, tok, pos, cache) -> (logits, cache)``
+    ``init_cache(batch, max_len) -> cache pytree``
+    """
+
+    def __init__(self, prefill: Callable, decode_step: Callable,
+                 init_cache: Callable, config: GenerationConfig = None):
+        self._prefill = prefill
+        self._decode = decode_step
+        self._init_cache = init_cache
+        self.config = config or GenerationConfig()
+        self._compiled: Dict[Tuple, Callable] = {}
+
+    # -- compiled program per (bucket, max_new) shape ------------------------
+
+    def _build(self, prompt_bucket: int, max_new: int):
+        cfg = self.config
+        prefill = self._prefill
+        decode = self._decode
+
+        def run(params, ids, prompt_len, cache, key):
+            # ids: (B, prompt_bucket) right-padded; prompt_len: (B,) uniform
+            # (ragged serving batches belong to the paged-attention path,
+            # ops/paged_attention.py)
+            logits, cache = prefill(params, ids, cache)       # (B, T, V)
+            last = jax.lax.dynamic_index_in_dim(
+                logits, prompt_len[0] - 1, axis=1, keepdims=False)
+            key, sub = jax.random.split(key)
+            tok = _sample(last, sub, cfg)
+
+            def step(carry, i):
+                tok, cache, key = carry
+                pos = prompt_len[0] + i  # uniform-length batch
+                lg, cache = decode(params, tok, pos, cache)
+                key, sub = jax.random.split(key)
+                nxt = _sample(lg, sub, cfg)
+                return (nxt, cache, key), tok
+
+            (last, cache, _), toks = jax.lax.scan(
+                step, (tok, cache, key), jnp.arange(max_new - 1))
+            toks = jnp.concatenate([toks, last[None]], axis=0)  # (max_new, B)
+            # Return the final cache so the donated input cache buffers are
+            # actually aliasable (donating without returning produced
+            # "donated buffers were not usable" warnings and saved nothing).
+            return jnp.swapaxes(toks, 0, 1), cache              # (B, max_new)
+
+        return jax.jit(run, donate_argnums=(3,))
+
+    def generate(self, params, input_ids,
+                 generation_config: Optional[GenerationConfig] = None):
+        """input_ids: (B, T) numpy/jax int array → (B, max_new_tokens)."""
+        if generation_config is not None:
+            self.config = generation_config
+            self._compiled.clear()
+        cfg = self.config
+        ids = np.asarray(input_ids)
+        b, t = ids.shape
+        bucket = _bucket(t)
+        padded = np.full((b, bucket), cfg.pad_token_id, ids.dtype)
+        padded[:, :t] = ids
+        # right-padding is safe: pad rows in the cache sit beyond kv_len
+        # until decode overwrites each position before first attending to it
+        key = (bucket, cfg.max_new_tokens, b)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(bucket, cfg.max_new_tokens)
+        cache = self._init_cache(b, bucket + cfg.max_new_tokens)
+        if isinstance(cache, KVCache):
+            cache = cache.tree
+        prompt_len = jnp.full((b,), t, jnp.int32)
+        rng = jax.random.key(cfg.seed)
+        out, _ = self._compiled[key](params, jnp.asarray(padded), prompt_len,
+                                     cache, rng)
+        return np.asarray(out)
+
+
+def llama_engine(config, generation_config: Optional[GenerationConfig] = None
+                 ) -> GenerationEngine:
+    """GenerationEngine wired to the stacked-param Llama family."""
+    from ..models import llama as L
+
+    return GenerationEngine(
+        prefill=functools.partial(_llama_prefill, config=config),
+        decode_step=functools.partial(_llama_decode, config=config),
+        init_cache=lambda b, s: L.init_kv_cache(config, b, s),
+        config=generation_config,
+    )
+
+
+def _llama_prefill(params, ids, cache, config):
+    from ..models import llama as L
+    return L.prefill_stacked(params, ids, cache, config)
+
+
+def _llama_decode(params, tok, pos, cache, config):
+    from ..models import llama as L
+    return L.decode_step_stacked(params, tok, pos, cache, config)
+
+
+# ---------------------------------------------------------------------------
+# Ragged (paged) serving engine
+# ---------------------------------------------------------------------------
+class PagedGenerationEngine:
+    """Ragged-batch generation over the paged KV cache.
+
+    Unlike GenerationEngine (uniform prompt lengths, contiguous cache),
+    prompts may have different lengths: each sequence owns pages via a
+    block table (ops/paged_attention.py), decode positions advance per row,
+    and sampling starts from each row's own last prompt token.
+    """
+
+    def __init__(self, model_config, generation_config: Optional[GenerationConfig] = None,
+                 page_size: int = 16, num_pages: Optional[int] = None):
+        from ..models import llama as L
+        self._L = L
+        self.model_config = model_config
+        self.config = generation_config or GenerationConfig()
+        self.page_size = page_size
+        self._num_pages = num_pages
+        self._compiled: Dict[Tuple, Callable] = {}
+
+    def _build(self, max_new: int):
+        L = self._L
+        cfg = self.config
+        mcfg = self.model_config
+
+        def run(params, ids, seq_lens, k_pages, v_pages, block_tables, key):
+            logits, k_pages, v_pages = L.prefill_paged(
+                params, ids, seq_lens, k_pages, v_pages, block_tables, mcfg)
+            last = jnp.take_along_axis(
+                logits, (seq_lens - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                       # (B, V) per-row last token
+            key, sub = jax.random.split(key)
+            tok = _sample(last, sub, cfg)
+
+            def step(carry, i):
+                tok, kp, vp, key = carry
+                positions = seq_lens + i            # (B,) per-row position
+                lg, kp, vp = L.decode_step_paged(
+                    params, tok, positions, kp, vp, block_tables, mcfg)
+                key, sub = jax.random.split(key)
+                nxt = _sample(lg, sub, cfg)
+                return (nxt, kp, vp, key), tok
+
+            (last_tok, k_pages, v_pages, _), toks = jax.lax.scan(
+                step, (tok, k_pages, v_pages, key), jnp.arange(max_new - 1))
+            toks = jnp.concatenate([toks, last_tok[None]], axis=0)
+            return jnp.swapaxes(toks, 0, 1), k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(3, 4))
+
+    def generate(self, params, prompts):
+        """prompts: list of 1-D int arrays (ragged) → (B, max_new_tokens)."""
+        from ..ops.paged_attention import PagedKVCacheManager
+        cfg = self.config
+        mcfg = self.model_config
+        lens = [len(p) for p in prompts]
+        b = len(prompts)
+        t_bucket = _bucket(max(lens))
+        ids = np.full((b, t_bucket), cfg.pad_token_id, np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = np.asarray(p, np.int32)
+
+        total = [l + cfg.max_new_tokens for l in lens]
+        pages_per_seq = [(n + self.page_size - 1) // self.page_size
+                         for n in total]
+        num_pages = self._num_pages or (sum(pages_per_seq) + 1)
+        mgr = PagedKVCacheManager(
+            mcfg.num_hidden_layers, num_pages, self.page_size,
+            mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+        for i in range(b):
+            mgr.allocate(i, total[i])
+            mgr._lens[i] = lens[i]  # prompt length is the live length
+        bt, seq_lens = mgr.block_tables(list(range(b)))
+
+        key = (t_bucket, cfg.max_new_tokens, b, bt.shape[1])
+        if key not in self._compiled:
+            self._compiled[key] = self._build(cfg.max_new_tokens)
+        rng = jax.random.key(cfg.seed)
+        toks, _, _ = self._compiled[key](
+            params, jnp.asarray(ids), jnp.asarray(seq_lens, jnp.int32),
+            mgr.k_pages, mgr.v_pages, jnp.asarray(bt), rng)
+        return np.asarray(toks)

@@ -1,0 +1,103 @@
+"""Predictor: run a loaded inference program through named IO handles.
+
+Reference: AnalysisPredictor + ZeroCopyTensor (paddle/fluid/inference/api/
+analysis_predictor.cc, details_zero_copy_tensor ⚠ — SURVEY.md §3.5):
+``get_input_handle(name).copy_from_cpu(arr); predictor.run();
+out = get_output_handle(name).copy_to_cpu()``.
+
+"Zero-copy" TPU reading: ``copy_from_cpu`` stages the host array once
+(device transfer happens at dispatch); outputs stay on device until
+``copy_to_cpu`` materialises them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..jit.save_load import TranslatedLayer, load as _jit_load
+from .config import Config
+
+
+class IOTensor:
+    """ZeroCopyTensor parity handle."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr) -> None:
+        self._value = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr) -> None:
+        self._value = arr  # no copy: jax array / dlpack-compatible
+
+    def reshape(self, shape) -> None:
+        if self._value is not None:
+            self._value = np.reshape(self._value, shape)
+
+    def copy_to_cpu(self):
+        import jax
+        v = self._value
+        return np.asarray(v) if isinstance(v, jax.Array) else v
+
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    def __init__(self, config: Config, program: Optional[TranslatedLayer] = None):
+        self._config = config
+        self._program = program or _jit_load(config._prefix)
+        n_in = len(self._program.input_spec)
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, IOTensor] = {
+            n: IOTensor(n) for n in self._input_names}
+        self._output_names: List[str] = []
+        self._outputs: Dict[str, IOTensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> IOTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List] = None):
+        """Execute. Either feed via handles then ``run()``, or pass arrays
+        directly (newer reference API) and get arrays back."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = [self._inputs[n]._value for n in self._input_names]
+        if any(a is None for a in args):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            raise ValueError(f"inputs not set: {missing}")
+        out = self._program(*args)
+        outs = out if isinstance(out, tuple) else (out,)
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._output_names, outs):
+            h = IOTensor(n)
+            h._value = o._value
+            self._outputs[n] = h
+        if inputs is not None:
+            return [np.asarray(o._value) for o in self._outputs.values()]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> IOTensor:
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,201 @@
+"""``paddle_tpu.jit`` — dy2static equivalent.
+
+The reference compiles imperative code via AST transforms + SOT bytecode
+tracing (python/paddle/jit/, SURVEY.md §2.5 dy2static row). Here jax.jit IS
+the tracer: ``to_static`` lifts a Layer's parameters/buffers into traced
+arguments and jit-compiles the forward; ``TrainStep`` compiles the full
+forward+backward+optimizer update into ONE XLA program (the equivalent of the
+reference's whole-graph executor path, with XLA doing the stream scheduling).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..core import autograd as _ag
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+from .. import random as _random
+from .functional import bind, param_arrays, buffer_arrays, tree_unwrap, tree_wrap
+
+
+class StaticFunction:
+    """jit-compiled forward (inference/eval) over an imperative fn/Layer."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 donate_params: bool = False):
+        self._fn = fn
+        self._layer = layer
+        self._jitted = None
+
+    def _build(self):
+        layer = self._layer
+
+        def pure(params, buffers, key, args, kwargs):
+            with _random.traced_key_scope(key):
+                wargs = tree_wrap(args)
+                wkwargs = tree_wrap(kwargs)
+                if layer is not None:
+                    with bind(layer, params, buffers):
+                        out = self._fn(*wargs, **wkwargs)
+                else:
+                    out = self._fn(*wargs, **wkwargs)
+                return tree_unwrap(out)
+
+        self._jitted = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        params = param_arrays(self._layer) if self._layer else {}
+        buffers = buffer_arrays(self._layer) if self._layer else {}
+        key = _random.next_key()
+        out = self._jitted(params, buffers, key, tree_unwrap(args), tree_unwrap(kwargs))
+        return tree_wrap(out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Parity with paddle.jit.to_static (decorator or call form)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn)
+            fn.forward = sf
+            return fn
+        layer = getattr(fn, "__self__", None)
+        if isinstance(layer, Layer):
+            return StaticFunction(fn, layer=layer)
+        return StaticFunction(fn, layer=None)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class TrainStep:
+    """One fully-compiled training step: forward + tape backward + clip +
+    optimizer update + buffer (e.g. BN stats) update, as a single XLA program
+    with donated parameter/optimizer buffers.
+
+    Equivalent of the reference's static-graph hot loop (SURVEY.md §3.4), but
+    derived automatically from imperative code.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
+                 in_shardings=None, donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn  # (model, *batch) -> scalar Tensor
+        self.optimizer = optimizer
+        self._donate = donate
+        self._jitted = None
+        # materialise optimizer state for every trainable param now
+        self._trainable = [
+            (name, p) for name, p in model.named_parameters() if p.trainable
+        ]
+        for _, p in self._trainable:
+            optimizer._state_of(p)
+
+    # -- pytree helpers -----------------------------------------------------
+    def _opt_state_tree(self):
+        return {name: dict(self.optimizer._accumulators[id(p)])
+                for name, p in self._trainable}
+
+    def _write_back(self, params, opt_state, buffers):
+        by_name = dict(self.model.named_parameters())
+        for name, v in params.items():
+            by_name[name]._value = v
+        for name, p in self._trainable:
+            self.optimizer._accumulators[id(p)] = dict(opt_state[name])
+        buf_objs = {n: b for n, b in self.model.named_buffers() if b is not None}
+        for name, v in buffers.items():
+            if name in buf_objs:
+                buf_objs[name]._value = v
+
+    # -- build --------------------------------------------------------------
+    def _build(self):
+        donate = (0, 1, 2) if self._donate else ()
+        self._jitted = jax.jit(self._make_step_fn(), donate_argnums=donate)
+
+    def _make_step_fn(self):
+        model = self.model
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+        trainable_names = [n for n, _ in self._trainable]
+        lr_mults = {n: p.optimize_attr.get("learning_rate", 1.0)
+                    for n, p in self._trainable}
+        need_clip = {n: getattr(p, "need_clip", True) for n, p in self._trainable}
+        # honour AdamW.apply_decay_param_fun in the compiled path too
+        decay_fn = getattr(opt, "_apply_decay_param_fun", None)
+        wd_on = {n: (decay_fn is None or decay_fn(p.name))
+                 for n, p in self._trainable}
+
+        def step(params, opt_state, buffers, batch, lr, step_i, key):
+            with _random.traced_key_scope(key):
+                with bind(model, params, buffers) as mutated_buffers:
+                    for _, p in model.named_parameters():
+                        p._grad_value = None
+                    wbatch = tree_wrap(batch)
+                    loss = loss_fn(model, *wbatch)
+                    with _ag.enable_grad():
+                        loss.backward()
+                    pobjs = dict(model.named_parameters())
+                    grads = {n: pobjs[n]._grad_value for n in trainable_names}
+                # clip (outside bind: pure arrays now)
+                if opt._grad_clip is not None:
+                    class _P:  # lightweight stand-in carrying need_clip
+                        __slots__ = ("need_clip",)
+                        def __init__(self, nc):
+                            self.need_clip = nc
+                    pairs = [(_P(need_clip[n]), grads[n]) for n in trainable_names]
+                    pairs = opt._grad_clip(pairs)
+                    grads = {n: g for n, (_, g) in zip(trainable_names, pairs)}
+                new_params = dict(params)
+                new_state = {}
+                saved_wd = opt._weight_decay
+                for n in trainable_names:
+                    g = grads[n]
+                    if g is None:
+                        new_state[n] = opt_state[n]
+                        continue
+                    opt._weight_decay = saved_wd if wd_on[n] else 0.0
+                    nv, ns = opt._update(params[n], g, dict(opt_state[n]),
+                                         lr * lr_mults[n], step_i)
+                    new_params[n] = nv
+                    new_state[n] = ns
+                opt._weight_decay = saved_wd
+                return tree_unwrap(loss), new_params, new_state, mutated_buffers
+
+        return step
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._build()
+        opt = self.optimizer
+        opt._step_count += 1
+        params = param_arrays(self.model)
+        opt_state = self._opt_state_tree()
+        buffers = buffer_arrays(self.model)
+        lr = opt.get_lr()
+        key = _random.next_key()
+        loss, new_params, new_state, new_buffers = self._jitted(
+            params, opt_state, buffers, tree_unwrap(batch),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(opt._step_count, jnp.int32), key)
+        self._write_back(new_params, new_state, new_buffers)
+        return Tensor(loss)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def enable_to_static(flag: bool):
+    pass
+
+
+from .save_load import save, load, TranslatedLayer  # noqa: E402,F401

@@ -1,0 +1,96 @@
+"""Functional bridge: imperative Layers ⇄ pure jax functions.
+
+This is the load-bearing piece that replaces the reference's executors
+(StandaloneExecutor/ProgramInterpreter, paddle/fluid/framework/new_executor/ —
+SURVEY.md §2.1): instead of interpreting an op graph, we *trace* the user's
+imperative code (which runs on the vjp tape) under jax.jit, with Parameters and
+buffers temporarily rebound to traced values. XLA then owns scheduling, fusion,
+memory and collectives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+
+
+def param_arrays(layer: Layer, trainable_only: bool = False) -> Dict[str, Any]:
+    out = {}
+    for name, p in layer.named_parameters():
+        if trainable_only and not p.trainable:
+            continue
+        out[name] = p._value
+    return out
+
+
+def buffer_arrays(layer: Layer) -> Dict[str, Any]:
+    out = {}
+    for name, b in layer.named_buffers():
+        if b is not None:
+            out[name] = b._value
+    return out
+
+
+@contextlib.contextmanager
+def bind(layer: Layer, params: Dict[str, Any] = None, buffers: Dict[str, Any] = None):
+    """Temporarily point Parameters/buffers at the given (possibly traced)
+    arrays; restores originals (and captures mutated buffer values) on exit."""
+    param_objs = dict(layer.named_parameters())
+    buffer_objs = {n: b for n, b in layer.named_buffers() if b is not None}
+    saved_p = {n: p._value for n, p in param_objs.items()}
+    saved_b = {n: b._value for n, b in buffer_objs.items()}
+    saved_grads = {n: p._grad_value for n, p in param_objs.items()}
+    mutated: Dict[str, Any] = {}
+    try:
+        if params is not None:
+            for n, v in params.items():
+                if n in param_objs:
+                    param_objs[n]._value = v
+        if buffers is not None:
+            for n, v in buffers.items():
+                if n in buffer_objs:
+                    buffer_objs[n]._value = v
+        yield mutated
+    finally:
+        for n, b in buffer_objs.items():
+            mutated[n] = b._value
+            b._value = saved_b[n]
+        for n, p in param_objs.items():
+            p._value = saved_p[n]
+            p._grad_value = saved_grads[n]
+
+
+def functional_call(layer: Layer, params: Dict[str, Any], *args,
+                    buffers: Dict[str, Any] = None, **kwargs):
+    """Call ``layer`` with parameters substituted from a pytree. Returns
+    (output, new_buffers)."""
+    with bind(layer, params, buffers) as mutated:
+        out = layer(*args, **kwargs)
+    return out, mutated
+
+
+def tree_unwrap(x):
+    """Recursively turn Tensors into jax arrays inside containers."""
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_unwrap(e) for e in x)
+    if isinstance(x, dict):
+        return {k: tree_unwrap(v) for k, v in x.items()}
+    return x
+
+
+def tree_wrap(x):
+    if isinstance(x, (jax.Array,)):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_wrap(e) for e in x)
+    if isinstance(x, dict):
+        return {k: tree_wrap(v) for k, v in x.items()}
+    return x

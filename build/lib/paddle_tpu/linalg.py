@@ -1,0 +1,143 @@
+"""``paddle.linalg`` parity namespace.
+
+Reference: python/paddle/tensor/linalg.py + python/paddle/linalg.py:§0.
+Decompositions and solvers delegate to jnp.linalg (XLA lowers QR/SVD/
+eigh/cholesky natively; on TPU these run in fp32 on the MXU where shapes
+allow). Everything funnels through the dispatch `apply` so autograd and
+profiler hooks see them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+
+
+def _op(name, fn, *args, **static):
+    return apply(fn, *args, op_name=name, **static)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from .core import math_ops as M
+    return M.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    # paddle semantics (flattened vector norm when axis is None) — shared
+    # with the tensor-method implementation
+    from .core import math_ops as M
+    return M.norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def cond(x, p=None, name=None):
+    return _op("cond", lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+def inv(x, name=None):
+    return _op("inv", lambda v: jnp.linalg.inv(v), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _op("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                                 hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return _op("det", lambda v: jnp.linalg.det(v), x)
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+    return _op("slogdet", fn, x)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return _op("cholesky", fn, x)
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        # jnp returns the bare R matrix here — tuple() would split rows
+        return _op("qr", lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return _op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return _op("svd", lambda v: tuple(
+        jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def eig(x, name=None):
+    return _op("eig", lambda v: tuple(jnp.linalg.eig(v)), x)
+
+
+def _from_triangle(v, UPLO):
+    """Symmetric matrix read from one triangle (paddle UPLO semantics)."""
+    if UPLO == "L":
+        lo = jnp.tril(v)
+        return lo + jnp.swapaxes(jnp.tril(v, -1), -1, -2)
+    up = jnp.triu(v)
+    return up + jnp.swapaxes(jnp.triu(v, 1), -1, -2)
+
+
+def eigh(x, UPLO="L", name=None):
+    return _op("eigh", lambda v: tuple(
+        jnp.linalg.eigh(_from_triangle(v, UPLO), symmetrize_input=False)), x)
+
+
+def eigvals(x, name=None):
+    return _op("eigvals", lambda v: jnp.linalg.eigvals(v), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _op("eigvalsh", lambda v: jnp.linalg.eigvalsh(
+        _from_triangle(v, UPLO)), x)
+
+
+def solve(x, y, name=None):
+    return _op("solve", lambda a, b: jnp.linalg.solve(a, b), x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+    return _op("triangular_solve",
+               lambda a, b: jsl.solve_triangular(
+                   a, b, lower=not upper, trans=1 if transpose else 0,
+                   unit_diagonal=unitriangular), x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return _op("lstsq", fn, x, y)
+
+
+def matrix_power(x, n, name=None):
+    return _op("matrix_power",
+               lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def fn(v):
+        s = (jnp.abs(jnp.linalg.eigvalsh(v)) if hermitian
+             else jnp.linalg.svd(v, compute_uv=False))
+        if tol is None:
+            # numpy default: max(dims) * eps * largest singular value
+            t = (max(v.shape[-2:]) * jnp.finfo(v.dtype).eps
+                 * jnp.max(s, axis=-1, keepdims=True))
+        else:
+            t = jnp.asarray(tol)  # paddle: ABSOLUTE tolerance
+        return jnp.sum(s > t, axis=-1)
+    return _op("matrix_rank", fn, x)
+
+
+def multi_dot(xs, name=None):
+    return _op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *xs)

@@ -1,0 +1,10 @@
+"""Model zoo: flagship LLM families (vision zoo lives in paddle_tpu.vision).
+
+llama — TP/PP/DP/SP hybrid training flagship (workload #2).
+gpt   — FusedMultiTransformer pretraining/inference path (workload #3).
+ernie — bidirectional encoder on fused attention/FFN (workload #3).
+"""
+
+from . import llama  # noqa: F401
+from . import gpt  # noqa: F401
+from . import ernie  # noqa: F401

@@ -1,0 +1,170 @@
+"""ERNIE/BERT-style bidirectional encoder family.
+
+Workload #3's encoder side (SURVEY.md §2.2: fused_attention +
+fused_feedforward are "used by ERNIE/GPT"): post-LN transformer encoder
+built from the incubate FusedMultiHeadAttention (causal=False) and
+FusedFeedForward blocks, with word+position+token-type embeddings, pooler,
+and masked-LM / sequence-classification heads. Surface follows the
+reference model zoo's ErnieModel/ErnieForSequenceClassification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..incubate.nn.layer.fused_transformer import (
+    FusedFeedForward, FusedMultiHeadAttention)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common_layers import LayerNorm, Linear
+from ..nn.layer import Layer, LayerList
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_epsilon: float = 1e-12
+    activation: str = "gelu"
+    pad_token_id: int = 0
+
+
+def ernie_tiny(**over) -> ErnieConfig:
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64, type_vocab_size=2)
+    base.update(over)
+    return ErnieConfig(**base)
+
+
+class ErnieEmbeddings(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        mk = lambda shape: self.create_parameter(
+            shape, default_initializer=I.Normal(0.0, 0.02))
+        self.word_embeddings = mk((config.vocab_size, config.hidden_size))
+        self.position_embeddings = mk(
+            (config.max_position_embeddings, config.hidden_size))
+        self.token_type_embeddings = mk(
+            (config.type_vocab_size, config.hidden_size))
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, token_type_ids=None):
+        def fn(ids, tt, we, pe, te):
+            s = ids.shape[-1]
+            return (jnp.take(we, ids.astype(jnp.int32), axis=0)
+                    + pe[None, :s]
+                    + jnp.take(te, tt.astype(jnp.int32), axis=0))
+
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros(tuple(input_ids.shape),
+                                              jnp.int32))
+        x = apply(fn, input_ids, token_type_ids, self.word_embeddings,
+                  self.position_embeddings, self.token_type_embeddings,
+                  op_name="ernie_embeddings")
+        return self.layer_norm(x)
+
+
+class ErnieEncoderLayer(Layer):
+    """Post-LN encoder block over the fused attention/FFN ops."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            config.hidden_size, config.num_attention_heads,
+            normalize_before=False, epsilon=config.layer_norm_epsilon)
+        self.ffn = FusedFeedForward(
+            config.hidden_size, config.intermediate_size,
+            activation=config.activation, normalize_before=False,
+            epsilon=config.layer_norm_epsilon)
+
+    def forward(self, x, attn_mask=None):
+        x = self.self_attn(x, attn_mask=attn_mask, causal=False)
+        return self.ffn(x)
+
+
+class ErniePooler(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+def _attention_mask_from_ids(input_ids, pad_token_id: int):
+    """(B, S) token ids -> additive (B, 1, 1, S) mask (-1e4 at pads)."""
+    def fn(ids):
+        pad = (ids == pad_token_id)
+        return jnp.where(pad, -1e4, 0.0)[:, None, None, :].astype(jnp.float32)
+    return apply(fn, input_ids, op_name="ernie_attn_mask")
+
+
+class ErnieModel(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = LayerList([ErnieEncoderLayer(config)
+                                  for _ in range(config.num_hidden_layers)])
+        self.pooler = ErniePooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is None:
+            attention_mask = _attention_mask_from_ids(
+                input_ids, self.config.pad_token_id)
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask=attention_mask)
+        return x, self.pooler(x)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(pooled)
+
+
+class ErnieForMaskedLM(Layer):
+    """MLM head tied to the word embedding."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_ln = LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_epsilon)
+        self.bias = self.create_parameter((config.vocab_size,), is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq)))
+        from ..core import math_ops as M
+        return M.matmul(h, self.ernie.embeddings.word_embeddings,
+                        transpose_y=True) + self.bias
+
+    def compute_loss(self, input_ids, labels, token_type_ids=None):
+        """labels: -100 at unmasked positions (ignore_index)."""
+        logits = self(input_ids, token_type_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)

@@ -1,0 +1,157 @@
+"""GPT model family on the FusedMultiTransformer path.
+
+This is workload #3's surface (SURVEY.md §2.2 fused_multi_transformer row:
+"used by ERNIE/GPT inference+pretraining encoder path"): a GPT-style
+causal LM whose decoder stack is ONE fused op — the incubate
+FusedMultiTransformer layer backed by the scanned/fused block in
+ops/fused_transformer_block.py (Pallas flash attention inside) — rather
+than a per-layer Python loop. KV-cache generation rides the same op's
+decode mode (reference: fused_multi_transformer CUDA decode with CacheKV).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..incubate.nn.layer.fused_transformer import FusedMultiTransformer
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from .. import creation
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    activation: str = "gelu"
+
+
+def gpt_tiny(**over) -> GPTConfig:
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64)
+    base.update(over)
+    return GPTConfig(**base)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = self.create_parameter(
+            (config.vocab_size, config.hidden_size),
+            default_initializer=I.Normal(0.0, 0.02))
+        self.position_embeddings = self.create_parameter(
+            (config.max_position_embeddings, config.hidden_size),
+            default_initializer=I.Normal(0.0, 0.02))
+
+    def forward(self, input_ids, position_offset: int = 0):
+        from ..core.dispatch import apply
+
+        def fn(ids, we, pe):
+            s = ids.shape[-1]
+            tok = jnp.take(we, ids.astype(jnp.int32), axis=0)
+            pos = jax.lax.dynamic_slice_in_dim(pe, position_offset, s, 0)
+            return tok + pos[None]
+
+        return apply(fn, input_ids, self.word_embeddings,
+                     self.position_embeddings, op_name="gpt_embeddings")
+
+
+class GPTModel(Layer):
+    """Embeddings → fused decoder stack → final LN."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.decoder = FusedMultiTransformer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, activation=config.activation,
+            normalize_before=True, epsilon=config.layer_norm_epsilon,
+            num_layers=config.num_hidden_layers)
+        from ..nn.common_layers import LayerNorm
+        self.final_layernorm = LayerNorm(config.hidden_size,
+                                         epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, caches=None, time_step: Optional[int] = None,
+                gen_cache_len: Optional[int] = None):
+        x = self.embeddings(input_ids,
+                            position_offset=time_step if time_step else 0)
+        out = self.decoder(x, caches=caches, time_step=time_step,
+                           gen_cache_len=gen_cache_len)
+        if isinstance(out, tuple):
+            h, kv = out
+            return self.final_layernorm(h), kv
+        return self.final_layernorm(out)
+
+
+class GPTForCausalLM(Layer):
+    """LM head tied to the word embedding (reference GPT pretrain head)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, caches=None, time_step=None,
+                gen_cache_len=None):
+        out = self.gpt(input_ids, caches=caches, time_step=time_step,
+                       gen_cache_len=gen_cache_len)
+        kv = None
+        if isinstance(out, tuple):
+            out, kv = out
+        from ..core import math_ops as M
+        logits = M.matmul(out, self.gpt.embeddings.word_embeddings,
+                          transpose_y=True)
+        return (logits, kv) if kv is not None else logits
+
+    def compute_loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+
+    # -- generation over the fused decode path ------------------------------
+
+    def generate(self, input_ids, max_new_tokens: int = 16,
+                 eos_token_id: Optional[int] = None):
+        """Greedy KV-cache generation (host loop over the fused decode op;
+        the bucketed compiled loop for serving lives in
+        paddle_tpu.inference.decoding)."""
+        from ..core import autograd as _ag
+        ids = input_ids if isinstance(input_ids, Tensor) else \
+            creation.to_tensor(np.asarray(input_ids))
+        b, t = ids.shape
+        cache_len = t + max_new_tokens
+        if cache_len > self.config.max_position_embeddings:
+            raise ValueError("generation exceeds max_position_embeddings")
+        with _ag.no_grad():
+            logits, kv = self(ids, gen_cache_len=cache_len)
+            toks = [np.asarray(jnp.argmax(
+                logits._value[:, -1].astype(jnp.float32), -1))]
+            for i in range(max_new_tokens - 1):
+                step_ids = creation.to_tensor(toks[-1][:, None].astype(np.int32))
+                logits, kv = self(step_ids, caches=kv, time_step=t + i)
+                toks.append(np.asarray(jnp.argmax(
+                    logits._value[:, 0].astype(jnp.float32), -1)))
+        out = np.stack(toks, axis=1).astype(np.int32)
+        if eos_token_id is not None:
+            # right-truncate after first EOS per row (parity convenience)
+            for r in range(out.shape[0]):
+                hit = np.where(out[r] == eos_token_id)[0]
+                if hit.size:
+                    out[r, hit[0] + 1:] = eos_token_id
+        return out

@@ -1,0 +1,144 @@
+"""GPT-MoE: decoder blocks whose FFN is an expert-parallel MoELayer.
+
+Workload #4 of BASELINE.md ("GPT-MoE with Fleet expert parallel"). Reference
+surface: the PaddleNLP GPT-MoE recipe over
+python/paddle/incubate/distributed/models/moe/MoELayer with
+global_scatter/global_gather dispatch (SURVEY.md §2.4 EP row). TPU-native:
+experts shard over a mesh axis (``moe_group``); MoELayer routes tokens
+through ops.moe_ops.expert_parallel_apply — an explicit ``lax.all_to_all``
+over ICI — when the group spans devices, and the dense einsum path otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList, Sequential
+from ..nn.common_layers import LayerNorm, Linear
+from ..core.tensor import Tensor
+from ..incubate.distributed.models.moe import MoELayer
+from .gpt import GPTConfig, GPTEmbeddings
+
+
+@dataclass
+class GPTMoEConfig(GPTConfig):
+    num_experts: int = 8
+    moe_topk: int = 2
+    moe_gate: str = "gshard"
+    capacity_factor: tuple = (1.2, 2.4)
+    aux_loss_coef: float = 0.01
+    # decoder layers using MoE FFN (every layer by default)
+    moe_layer_interval: int = 1
+
+
+def gpt_moe_tiny(**over) -> GPTMoEConfig:
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64, num_experts=8, moe_topk=2)
+    base.update(over)
+    return GPTMoEConfig(**base)
+
+
+class GPTSelfAttention(Layer):
+    def __init__(self, config: GPTMoEConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.qkv = Linear(h, 3 * h)
+        self.out_proj = Linear(h, h)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, h // self.num_heads])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+def _expert_ffn(config: GPTMoEConfig) -> Layer:
+    from ..nn.common_layers import GELU, ReLU
+
+    act = {"gelu": GELU, "relu": ReLU}[config.activation]
+    return Sequential(
+        Linear(config.hidden_size, config.intermediate_size),
+        act(),
+        Linear(config.intermediate_size, config.hidden_size))
+
+
+class GPTMoEBlock(Layer):
+    def __init__(self, config: GPTMoEConfig, use_moe: bool, moe_group=None):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.ln1 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = GPTSelfAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.use_moe = use_moe
+        if use_moe:
+            self.mlp = MoELayer(
+                config.hidden_size,
+                experts=[_expert_ffn(config)
+                         for _ in range(config.num_experts)],
+                gate=config.moe_gate, topk=config.moe_topk,
+                capacity_factor=config.capacity_factor,
+                moe_group=moe_group)
+        else:
+            self.mlp = _expert_ffn(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTMoEForCausalLM(Layer):
+    """GPT with MoE FFN layers; LM head tied to the word embedding.
+
+    ``moe_group``: a paddle_tpu.distributed Group naming the mesh axis the
+    experts shard over (the Fleet expert-parallel group); None = dense.
+    """
+
+    def __init__(self, config: GPTMoEConfig, moe_group=None):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.blocks = LayerList([
+            GPTMoEBlock(config,
+                        use_moe=(i % config.moe_layer_interval == 0),
+                        moe_group=moe_group)
+            for i in range(config.num_hidden_layers)])
+        self.final_layernorm = LayerNorm(config.hidden_size,
+                                         epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.final_layernorm(x)
+
+    def logits(self, input_ids):
+        from ..core import math_ops as M
+        h = self(input_ids)
+        return M.matmul(h, self.embeddings.word_embeddings, transpose_y=True)
+
+    def aux_loss(self):
+        total = None
+        for blk in self.blocks:
+            la = getattr(blk.mlp, "l_aux", None)
+            if la is not None:
+                total = la if total is None else total + la
+        return total
+
+    def compute_loss(self, input_ids, labels):
+        logits = self.logits(input_ids)
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+        aux = self.aux_loss()
+        if aux is not None and self.config.aux_loss_coef:
+            loss = loss + self.config.aux_loss_coef * aux
+        return loss

@@ -1,0 +1,779 @@
+"""Llama model family — the flagship workload (BASELINE.md workload #2).
+
+Two faces over ONE weight set:
+
+* **Imperative module** (`LlamaForCausalLM`): paddle-shaped nn.Layer built
+  from the TP meta_parallel layers; runs eagerly, under jit.TrainStep, or
+  under the GSPMD HybridTrainStep (dp/mp/sharding/sp via NamedShardings).
+  Reference surface: PaddleNLP LlamaForCausalLM over
+  fleet meta_parallel mp_layers (SURVEY.md §2.4, §3.2).
+
+* **Functional hybrid step** (`build_hybrid_train_step`): the TP×PP×DP×SP
+  compiled path — one shard_map program over the full mesh with Megatron-style
+  explicit collectives for mp, the fill-drain ppermute pipeline for pp
+  (parallel/pipeline.py), batch sharding for dp/sharding, and sequence
+  sharding for sp. Used by fleet PP training, __graft_entry__.dryrun_multichip
+  and bench.py.
+
+Decoder math follows Llama-2: RMSNorm → QKV (GQA) → RoPE → causal flash
+attention → out-proj → residual; RMSNorm → SwiGLU MLP → residual.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.common_layers import RMSNorm
+from ..ops import rope as rope_ops
+from ..ops import flash_attention as fa
+from ..ops.rms_norm import rms_norm_array
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+#: per-layer tensors in the stacked functional layout (leading L axis).
+LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln1", "ln2")
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.float32
+    # context-parallel attention flavor when sep_degree > 1:
+    # "ulysses" (all_to_all head repartition) or "ring" (ppermute KV ring)
+    sep_mode: str = "ulysses"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama2_7b(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
+        num_attention_heads=32, num_key_value_heads=32), **over})
+
+
+def llama2_13b(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+        num_attention_heads=40, num_key_value_heads=40), **over})
+
+
+def llama_tiny(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128), **over})
+
+
+# ===========================================================================
+# Imperative model
+# ===========================================================================
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, d = config.hidden_size, config.head_dim
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            h, config.num_key_value_heads * d, has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            h, config.num_key_value_heads * d, has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, cos, sin):
+        cfg = self.config
+        b, s, _ = x.shape
+        d = cfg.head_dim
+        q = self.q_proj(x).reshape([b, s, -1, d])
+        k = self.k_proj(x).reshape([b, s, -1, d])
+        v = self.v_proj(x).reshape([b, s, -1, d])
+        q, k = rope_ops.fused_rotary_position_embedding(q, k, cos, sin)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(out.reshape([b, s, -1]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        from ..nn.layer import LayerList
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        cfg = self.config
+        s = input_ids.shape[1]
+        cos, sin = rope_ops.build_rope_cache(s, cfg.head_dim, cfg.rope_theta)
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None  # logits via embed weightᵀ
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+
+    def forward(self, input_ids):
+        h = self.llama(input_ids)
+        if self.lm_head is None:
+            from ..core import math_ops as M
+            return M.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def compute_loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+
+
+# ===========================================================================
+# Functional forward (serial; single-device oracle + graft entry)
+# ===========================================================================
+def forward_stacked(params: Dict[str, Any], ids, config: LlamaConfig):
+    """Pure single-device forward over the stacked param layout → logits."""
+    cos, sin = rope_ops.build_rope_cache(ids.shape[-1], config.head_dim,
+                                         config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+
+    def body(carry, lp):
+        return _decoder_layer_manual(lp, carry, cos, sin, config=config,
+                                     mp_axis=None, fsdp_axis=None), None
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, _ = lax.scan(body, x, layer_params)
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    return jnp.einsum("bsh,hv->bsv", x, _dense(params["lm_head"]))
+
+
+def loss_stacked(params: Dict[str, Any], ids, labels, config: LlamaConfig):
+    logits = forward_stacked(params, ids, config).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None],
+                                 axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+# ===========================================================================
+# Functional TP×PP×DP×SP hybrid step
+# ===========================================================================
+def init_stacked_params(config: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
+    """Weights in the stacked functional layout: per-layer tensors stacked on
+    a leading L axis (pipeline shards slice it)."""
+    L, h, m = config.num_hidden_layers, config.hidden_size, config.intermediate_size
+    d = config.head_dim
+    kvh = config.num_key_value_heads * d
+    key = jax.random.key(seed)
+    ks = jax.random.split(key, 12)
+    std = 0.02
+    dt = config.dtype
+
+    def rnd(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dt)
+
+    return {
+        "embed": rnd(ks[0], (config.vocab_size, h)),
+        "wq": rnd(ks[1], (L, h, h)),
+        "wk": rnd(ks[2], (L, h, kvh)),
+        "wv": rnd(ks[3], (L, h, kvh)),
+        "wo": rnd(ks[4], (L, h, h)),
+        "w_gate": rnd(ks[5], (L, h, m)),
+        "w_up": rnd(ks[6], (L, h, m)),
+        "w_down": rnd(ks[7], (L, m, h)),
+        "ln1": jnp.ones((L, h), dt),
+        "ln2": jnp.ones((L, h), dt),
+        "ln_f": jnp.ones((h,), dt),
+        "lm_head": rnd(ks[8], (h, config.vocab_size)),
+    }
+
+
+def stacked_param_specs(config: LlamaConfig) -> Dict[str, P]:
+    """PartitionSpecs: L axis over pp, Megatron dims over mp, row-sharded big
+    matrices additionally over 'sharding' (ZeRO-3 style weight sharding)."""
+    return {
+        "embed": P("mp", None),
+        "wq": P("pp", ("dp", "sharding"), "mp"),
+        "wk": P("pp", ("dp", "sharding"), "mp"),
+        "wv": P("pp", ("dp", "sharding"), "mp"),
+        "wo": P("pp", "mp", ("dp", "sharding")),
+        "w_gate": P("pp", ("dp", "sharding"), "mp"),
+        "w_up": P("pp", ("dp", "sharding"), "mp"),
+        "w_down": P("pp", "mp", ("dp", "sharding")),
+        "ln1": P("pp", None),
+        "ln2": P("pp", None),
+        "ln_f": P(),
+        "lm_head": P(None, "mp"),
+    }
+
+
+def _rms(x, w, eps):
+    # fused Pallas rms_norm on TPU (ops/rms_norm.py), XLA ref path elsewhere
+    return rms_norm_array(x, w, eps)
+
+
+def _dense(w):
+    """Materialize a possibly weight-only-quantized weight ({"q","scale"}
+    from paddle_tpu.quantization.quantize_stacked_params) into its dense
+    form. Called inside the per-layer scan body so only ONE layer's weight
+    is dequantized at a time and XLA fuses the multiply into the consuming
+    einsum — int8 storage halves the HBM bytes the decode loop waits on.
+    Dense arrays pass through untouched."""
+    if isinstance(w, dict):
+        from ..quantization import weight_dequantize
+        return weight_dequantize(w["q"], w["scale"])
+    return w
+
+
+def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
+                          fsdp_axis, sep_axis=None):
+    """One decoder layer inside shard_map. Weight locals: wq (h, h/mp) etc.
+    (the fsdp axis shards the *contraction* dim h — all-gathered here, which
+    is the ZeRO-3 gather; XLA overlaps it with the previous layer).
+
+    When ``sep_axis`` is set, activations arrive sequence-sharded and
+    attention runs Ulysses-style (SURVEY.md §5.7 mechanism 2): all_to_all
+    repartitions (heads_local → seq_full) before attention and back after, so
+    causal attention always sees the full sequence per head subset.
+    """
+    b, s, h = x.shape
+    d = config.head_dim
+
+    def gather_in(w):
+        if fsdp_axis is not None:
+            return lax.all_gather(w, fsdp_axis, axis=0, tiled=True)
+        return w
+
+    def gather_out(w):
+        if fsdp_axis is not None:
+            return lax.all_gather(w, fsdp_axis, axis=1, tiled=True)
+        return w
+
+    xn = _rms(x, p["ln1"], config.rms_norm_eps)
+    q = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wq"])))
+    k = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wk"])))
+    v = jnp.einsum("bsh,hd->bsd", xn, gather_in(_dense(p["wv"])))
+    nh_local = q.shape[-1] // d
+    nkv_local = k.shape[-1] // d
+    q = q.reshape(b, s, nh_local, d)
+    k = k.reshape(b, s, nkv_local, d)
+    v = v.reshape(b, s, nkv_local, d)
+    q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    sep_mode = getattr(config, "sep_mode", "ulysses")
+    if sep_axis is not None and sep_mode == "ring":
+        # blockwise ring attention: KV rotates over the sep ICI ring with
+        # online-softmax merge (ops/ring_attention.py, SURVEY.md §5.7 (3))
+        from ..ops import ring_attention as ra
+        attn = ra.ring_attention_array(q, k, v, sep_axis, causal=True,
+                                       scale=1.0 / math.sqrt(d))
+    else:
+        if sep_axis is not None:
+            # (b, s_local, nh, d) -> (b, s_full, nh/sep, d)
+            q, k, v = (lax.all_to_all(t, sep_axis, split_axis=2, concat_axis=1,
+                                      tiled=True) for t in (q, k, v))
+        attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        if sep_axis is not None:
+            attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+    attn = attn.reshape(b, s, -1)
+    out = jnp.einsum("bsd,dh->bsh", attn, gather_out(_dense(p["wo"])))
+    if mp_axis is not None:
+        out = lax.psum(out, mp_axis)
+    x = x + out
+
+    xn = _rms(x, p["ln2"], config.rms_norm_eps)
+    g = jnp.einsum("bsh,hm->bsm", xn, gather_in(_dense(p["w_gate"])))
+    u = jnp.einsum("bsh,hm->bsm", xn, gather_in(_dense(p["w_up"])))
+    dn = jnp.einsum("bsm,mh->bsh", jax.nn.silu(g) * u, gather_out(_dense(p["w_down"])))
+    if mp_axis is not None:
+        dn = lax.psum(dn, mp_axis)
+    return x + dn
+
+
+def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
+                            learning_rate: float = 1e-3,
+                            remat: bool = True,
+                            seq_shard: bool = False,
+                            virtual_pp: int = 1):
+    """Returns (step_fn, init_fn).
+
+    step_fn(params, opt_state, batch_ids, batch_labels) ->
+        (loss, params, opt_state) — jitted, fully sharded.
+
+    Parallelism inside: dp (batch), pp (ppermute pipeline: fill-drain, or
+    the interleaved virtual-pipeline schedule when ``virtual_pp > 1`` —
+    each pp stage holds virtual_pp strided layer chunks, cutting the
+    bubble by that factor), mp (Megatron collectives), sharding (ZeRO-3
+    weight sharding with per-layer all_gather), and — with
+    ``seq_shard=True`` and a ``sep`` mesh axis — Ulysses context
+    parallelism (activations sequence-sharded; all_to_all head/seq
+    repartition around attention).
+    Optimizer: fused AdamW (state sharded like the weights).
+
+    Note: with virtual_pp > 1 the stacked layer arrays are stored in the
+    interleave-permuted order (init_fn applies it); checkpoints of these
+    params carry that layout.
+    """
+    from ..parallel import pipeline as ppipe
+
+    pp = mesh.shape.get("pp", 1)
+    mp = mesh.shape.get("mp", 1)
+    sep = mesh.shape.get("sep", 1)
+    sep_axis = "sep" if (seq_shard and sep > 1) else None
+    if seq_shard and sep <= 1:
+        raise ValueError("seq_shard=True requires a 'sep' mesh axis of size>1")
+    sep_mode = getattr(config, "sep_mode", "ulysses")
+    if sep_mode not in ("ulysses", "ring"):
+        raise ValueError(f"unknown sep_mode {sep_mode!r} "
+                         f"(expected 'ulysses' or 'ring')")
+    if sep_axis is not None:
+        nh, nkv = config.num_attention_heads, config.num_key_value_heads
+        if sep_mode == "ulysses":
+            # Ulysses repartitions heads over sep; ring never splits heads
+            if nh % (mp * sep) or nkv % (mp * sep):
+                raise ValueError(
+                    f"Ulysses sep={sep} with mp={mp} needs heads divisible "
+                    f"by mp*sep (got q={nh}, kv={nkv})")
+        elif nh % mp or nkv % mp:
+            raise ValueError(
+                f"ring sep with mp={mp} needs heads divisible by mp "
+                f"(got q={nh}, kv={nkv})")
+    fsdp = mesh.shape.get("sharding", 1) * mesh.shape.get("dp", 1)
+    mp_axis = "mp" if mp > 1 else None
+    fsdp_axes = ("dp", "sharding")
+    fsdp_axis = fsdp_axes if fsdp > 1 else None
+    specs = stacked_param_specs(config)
+    eps = config.rms_norm_eps
+
+    vpp = max(int(virtual_pp), 1)
+    if vpp > 1 and pp <= 1:
+        raise ValueError("virtual_pp > 1 requires a pp mesh axis of size > 1")
+    if config.num_hidden_layers % (pp * vpp):
+        raise ValueError(
+            f"num_hidden_layers {config.num_hidden_layers} must divide by "
+            f"pp*virtual_pp = {pp * vpp}")
+    layers_per_chunk = config.num_hidden_layers // (pp * vpp)
+    if vpp > 1:
+        # storage order: device-contiguous blocks hold strided model chunks
+        layer_order = np.asarray(
+            [c * layers_per_chunk + r
+             for c in ppipe.interleave_chunk_order(pp, vpp)
+             for r in range(layers_per_chunk)])
+    else:
+        layer_order = None
+
+    def spmd_loss(params, ids, labels):
+        """Runs per-device inside shard_map. ids/labels: (M, mb_local, S_local)."""
+        M, mb, S = ids.shape
+        s_glob = S * sep if sep_axis is not None else S
+        cos, sin = rope_ops.build_rope_cache(s_glob, config.head_dim,
+                                             config.rope_theta)
+        if sep_axis is not None:
+            # RoPE runs pre-all_to_all on the local chunk: slice its positions
+            off = lax.axis_index(sep_axis) * S
+            cos = lax.dynamic_slice_in_dim(cos, off, S, axis=0)
+            sin = lax.dynamic_slice_in_dim(sin, off, S, axis=0)
+
+        def embed(i):
+            return jnp.take(params["embed"], i.astype(jnp.int32), axis=0)
+
+        def stage_fn(sparams, x):
+            def layer_body(carry, lp):
+                fn = functools.partial(_decoder_layer_manual, config=config,
+                                       mp_axis=mp_axis, fsdp_axis=fsdp_axis,
+                                       sep_axis=sep_axis)
+                if remat:
+                    fn = jax.checkpoint(fn)
+                return fn(lp, carry, cos, sin), None
+
+            layer_params = {k: sparams[k] for k in LAYER_KEYS}
+            x, _ = lax.scan(layer_body, x, layer_params)
+            return x
+
+        # vocab-parallel embedding (weight sharded over mp on vocab dim)
+        if mp_axis is not None:
+            idx = lax.axis_index(mp_axis)
+            per = params["embed"].shape[0]
+            start = idx * per
+
+            def embed(i):  # noqa: F811
+                i32 = i.astype(jnp.int32) - start
+                ok = (i32 >= 0) & (i32 < per)
+                e = jnp.take(params["embed"], jnp.where(ok, i32, 0), axis=0)
+                return lax.psum(jnp.where(ok[..., None], e, 0.0), mp_axis)
+
+        x = embed(ids)  # (M, mb, S, h)
+
+        if pp > 1:
+            local = {k: params[k] for k in LAYER_KEYS}
+            if vpp > 1:
+                # local leaves: (L/pp, ...) -> (vpp, layers_per_chunk, ...);
+                # stage_fn scans whatever layer dim it receives, so it IS
+                # the chunk function
+                chunks = jax.tree_util.tree_map(
+                    lambda a: a.reshape((vpp, layers_per_chunk) + a.shape[1:]),
+                    local)
+                out = ppipe.pipeline_spmd_interleaved(
+                    stage_fn, chunks, x, vpp, axis_name="pp")
+            else:
+                out = ppipe.pipeline_spmd(stage_fn, local, x, axis_name="pp")
+            out = ppipe.last_stage_broadcast(out, "pp")
+        else:
+            def micro_body(_, xm):
+                return None, stage_fn({k: params[k] for k in LAYER_KEYS}, xm)
+            _, out = lax.scan(micro_body, None, x)
+
+        out = _rms(out, params["ln_f"], eps)
+        logits = jnp.einsum("mbsh,hv->mbsv", out, _dense(params["lm_head"]))
+        # vocab is replicated over mp here (lm_head spec P(None, 'mp') is
+        # sliced by shard_map, so logits are vocab-sharded when mp>1)
+        lg = logits.astype(jnp.float32)
+        lab = labels.astype(jnp.int32)
+        if mp_axis is not None:
+            from ..distributed.meta_parallel.mp_layers import vocab_parallel_ce_array
+            loss = jnp.mean(vocab_parallel_ce_array(lg, lab, mp_axis))
+        else:
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            picked = jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+            loss = -jnp.mean(picked)
+        # mean over dp/sharding batch shards (+ sep sequence shards)
+        for ax in ("dp", "sharding"):
+            if mesh.shape.get(ax, 1) > 1:
+                loss = lax.pmean(loss, ax)
+        if sep_axis is not None:
+            loss = lax.pmean(loss, sep_axis)
+        return loss
+
+    batch_in_spec = P(None, ("dp", "sharding"),
+                      "sep" if sep_axis is not None else None)
+
+    def loss_shardmapped(params, ids, labels):
+        f = jax.shard_map(
+            spmd_loss, mesh=mesh,
+            in_specs=(specs, batch_in_spec, batch_in_spec),
+            out_specs=P(), check_vma=False)
+        return f(params, ids, labels)
+
+    # --- fused AdamW over the sharded pytree --------------------------------
+    b1, b2, adam_eps, wd = 0.9, 0.95, 1e-8, 0.1
+
+    def init_fn(seed: int = 0):
+        params = init_stacked_params(config, seed)
+        if layer_order is not None:
+            params = {k: (v[layer_order] if k in LAYER_KEYS else v)
+                      for k, v in params.items()}
+        params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                  for k, v in params.items()}
+        opt_state = {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree_util.tree_map(lambda v: jnp.zeros_like(v, jnp.float32), params),
+            "v": jax.tree_util.tree_map(lambda v: jnp.zeros_like(v, jnp.float32), params),
+        }
+        return params, opt_state
+
+    state_specs = {"step": P(), "m": specs, "v": specs}
+
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(loss_shardmapped)(params, ids, labels)
+        t = opt_state["step"] + 1
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * g32
+            v2 = b2 * v + (1 - b2) * g32 * g32
+            mh = m2 / (1 - b1 ** t.astype(jnp.float32))
+            vh = v2 / (1 - b2 ** t.astype(jnp.float32))
+            p2 = p.astype(jnp.float32) - learning_rate * (
+                mh / (jnp.sqrt(vh) + adam_eps) + wd * p.astype(jnp.float32))
+            return p2.astype(p.dtype), m2, v2
+
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            new_p[k], new_m[k], new_v[k] = upd(params[k], grads[k],
+                                               opt_state["m"][k],
+                                               opt_state["v"][k])
+        return loss, new_p, {"step": t, "m": new_m, "v": new_v}
+
+    ns = lambda spec_tree: jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    step_jit = jax.jit(
+        step,
+        in_shardings=(ns(specs), ns(state_specs), ns(batch_in_spec), ns(batch_in_spec)),
+        out_shardings=(NamedSharding(mesh, P()), ns(specs), ns(state_specs)),
+        donate_argnums=(0, 1),
+    )
+    return step_jit, init_fn
+
+
+def microbatch(ids: np.ndarray, labels: np.ndarray, num_micro: int):
+    """(B, S) -> (M, B/M, S)."""
+    B = ids.shape[0]
+    assert B % num_micro == 0
+    return (ids.reshape(num_micro, B // num_micro, -1),
+            labels.reshape(num_micro, B // num_micro, -1))
+
+
+# ===========================================================================
+# KV-cache inference path (serving: prefill + single-token decode)
+# ===========================================================================
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int, dtype=None):
+    """Contiguous per-layer KV cache (L, B, S_max, n_kv, d). The paged
+    variant for ragged serving batches lives in ops/paged_attention.py."""
+    L = config.num_hidden_layers
+    d = config.head_dim
+    nkv = config.num_key_value_heads
+    dt = dtype or config.dtype
+    shape = (L, batch, max_len, nkv, d)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attention(q, k_cache, v_cache, kv_len, config: LlamaConfig):
+    """q: (B, T, nh, d); caches: (B, S_max, nkv, d); attend over [0, kv_len)
+    with causality inside the current T block (query i sits at absolute
+    position kv_len - T + i)."""
+    b, t, nh, d = q.shape
+    s_max = k_cache.shape[1]
+    rep = nh // k_cache.shape[2]
+    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(d)
+    q_pos = kv_len - t + jnp.arange(t)                      # (T,)
+    mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]     # (T, S_max)
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out
+
+
+def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
+                          config: LlamaConfig):
+    """One decoder layer with cache write + cached attention.
+    x: (B, T, H); cos/sin: (T, d) rope rows for these positions;
+    caches: (B, S_max, nkv, d). Returns (x', k_cache', v_cache')."""
+    b, t, h = x.shape
+    d = config.head_dim
+    xn = _rms(x, lp["ln1"], config.rms_norm_eps)
+    q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
+    k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
+    v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
+    q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    start = kv_len - t
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                       (0, start, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                       (0, start, 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, kv_len, config)
+    x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
+    xn = _rms(x, lp["ln2"], config.rms_norm_eps)
+    g = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_gate"]))
+    u = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_up"]))
+    x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
+    return x, k_cache, v_cache
+
+
+def prefill_stacked(params, ids, cache, config: LlamaConfig):
+    """Process the whole prompt, filling the cache.
+    ids: (B, T) int32 (pad to a bucket length for shape stability).
+    Returns (per-position logits (B, T, V), cache') — the caller picks the
+    last *real* prompt position (right-padding makes position T-1 a pad)."""
+    t = ids.shape[1]
+    s_max = cache["k"].shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+    kv_len = jnp.asarray(t, jnp.int32)
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kc, vc = lp_kv
+        xo, kc, vc = _decoder_layer_cached(lp, xc, cos_full[:t], sin_full[:t],
+                                           kc, vc, kv_len, config)
+        return xo, (kc, vc)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
+    return logits, {"k": k_new, "v": v_new}
+
+
+def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
+    """One generated token. tok: (B,) int32; pos: scalar int32 — absolute
+    position of ``tok`` (so kv_len becomes pos+1). Returns (logits, cache')."""
+    s_max = cache["k"].shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], tok.astype(jnp.int32), axis=0)[:, None, :]
+    cos = lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+    sin = lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+    kv_len = pos + 1
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kc, vc = lp_kv
+        xo, kc, vc = _decoder_layer_cached(lp, xc, cos, sin, kc, vc,
+                                           kv_len, config)
+        return xo, (kc, vc)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
+    return logits, {"k": k_new, "v": v_new}
+
+
+# ===========================================================================
+# Paged KV-cache path (ragged serving batches; ops/paged_attention.py)
+# ===========================================================================
+def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
+                  config: LlamaConfig):
+    """Prefill a ragged batch into paged KV.
+
+    ids: (B, T) right-padded prompts; seq_lens: (B,) true lengths;
+    k_pages/v_pages: (L, P, page, nkv, d); block_tables: (B, max_pages),
+    padded slots pointing at reserved page 0.
+    Returns (logits (B, T, V), k_pages', v_pages').
+    """
+    b, t = ids.shape
+    page = k_pages.shape[2]
+    cos, sin = rope_ops.build_rope_cache(t, config.head_dim, config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+
+    # scatter indices for every (b, t) slot: pad tokens land in page 0
+    tpos = jnp.arange(t)
+    page_idx = tpos[None, :] // page                      # (B, T)
+    page_off = tpos[None, :] % page
+    phys = jnp.take_along_axis(block_tables, page_idx, axis=1)  # (B, T)
+    valid = tpos[None, :] < seq_lens[:, None]
+    phys = jnp.where(valid, phys, 0)
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kp, vp = lp_kv
+        d = config.head_dim
+        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+        q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
+        q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+        # causal attention within the (padded) prompt
+        attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        xo = xc + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
+        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
+        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
+        # scatter this layer's K/V into its pages
+        kp = kp.at[phys, page_off].set(k.astype(kp.dtype))
+        vp = vp.at[phys, page_off].set(v.astype(vp.dtype))
+        return xo, (kp, vp)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
+    return logits, k_new, v_new
+
+
+def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
+                      config: LlamaConfig):
+    """One ragged decode step. tok: (B,); positions: (B,) absolute position
+    of each row's new token (may differ per row). Returns
+    (logits (B, V), k_pages', v_pages')."""
+    from ..ops import paged_attention as pa
+    b = tok.shape[0]
+    d = config.head_dim
+    s_max = block_tables.shape[1] * k_pages.shape[2]
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], tok.astype(jnp.int32), axis=0)[:, None, :]
+    cos = jnp.take(cos_full, positions, axis=0)[:, None, :]  # (B, 1, d)
+    sin = jnp.take(sin_full, positions, axis=0)[:, None, :]
+    kv_lens = positions + 1
+
+    def body(carry, lp_kv):
+        xc = carry
+        lp, kp, vp = lp_kv
+        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+        q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, 1, -1, d)
+        k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, 1, -1, d)
+        v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, 1, -1, d)
+        q2, k2 = rope_ops.apply_rope_array(q, k, cos, sin)  # (B,1,d) 3-D form
+        kp, vp = pa.paged_write_array(kp, vp, k2[:, 0], v[:, 0],
+                                      block_tables, positions)
+        attn = pa.paged_attention(q2[:, 0], kp, vp, block_tables,
+                                  kv_lens, scale=1.0 / math.sqrt(d))
+        xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
+                             _dense(lp["wo"]))[:, None, :]
+        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
+        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
+        return xo, (kp, vp)
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
+    return logits, k_new, v_new

@@ -1,0 +1,20 @@
+"""``paddle_tpu.nn`` — layers, functional ops, initializers.
+
+Parity with python/paddle/nn/ of the reference (SURVEY.md §2.5).
+"""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, LayerList, Sequential, ParameterList, ParamAttr  # noqa: F401
+from .common_layers import (  # noqa: F401
+    Linear, Embedding, Identity, Flatten, Dropout, Dropout2D, Upsample,
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm2D,
+    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Hardswish, Hardsigmoid,
+    Hardtanh, ELU, SELU, CELU, Softplus, Softsign, Tanhshrink, Hardshrink,
+    Softshrink, LogSoftmax, LeakyReLU, PReLU, Softmax,
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, Pad2D, PixelShuffle,
+)

@@ -1,0 +1,895 @@
+"""``nn.functional`` — stateless neural-net ops.
+
+Parity with the reference's python/paddle/nn/functional/ package
+(activation.py, conv.py, pooling.py, norm.py, loss.py, common.py —
+SURVEY.md §2.1/§2.5). Everything funnels through dispatch.apply so it is
+autograd-recorded and XLA-fused; attention entry points route to the Pallas
+kernels in paddle_tpu.ops when on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from .. import random as _random
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def relu(x, name=None):
+    return apply(jax.nn.relu, _t(x), op_name="relu")
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, _t(x), op_name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x), op_name="leaky_relu")
+
+
+def prelu(x, weight, name=None):
+    return apply(lambda v, w: jnp.where(v >= 0, v, w * v), _t(x), _t(weight), op_name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), _t(x), op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 _t(x), op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), _t(x), op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), _t(x), op_name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, _t(x), op_name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), _t(x), op_name="mish")
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3, 0, 6) / 6, _t(x), op_name="hardswish")
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(v * slope + offset, 0, 1), _t(x), op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), _t(x), op_name="hardtanh")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(v * beta > threshold, v,
+                                     jnp.log1p(jnp.exp(beta * v)) / beta),
+                 _t(x), op_name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(lambda v: v / (1 + jnp.abs(v)), _t(x), op_name="softsign")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), _t(x), op_name="tanhshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _t(x),
+                 op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold, 0.0)),
+                 _t(x), op_name="softshrink")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, _t(x), op_name="sigmoid")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, _t(x), op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply(fn, _t(x), op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply(fn, _t(x), op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    k = _random.next_key()
+
+    def fn(v):
+        g = jax.random.gumbel(k, v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            ar_shape = [1] * v.ndim
+            ar_shape[axis] = v.shape[axis]
+            ar = jnp.arange(v.shape[axis]).reshape(ar_shape)
+            y_hard = (ar == idx).astype(v.dtype)
+            y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+        return y
+
+    return apply(fn, _t(x), op_name="gumbel_softmax")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(lambda v: v / jnp.maximum(
+        jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True), epsilon),
+        _t(x), op_name="normalize")
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """paddle convention: weight shape [in, out]; y = x @ W + b."""
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), _t(x), _t(weight), op_name="linear")
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, _t(x), _t(weight), _t(bias),
+                 op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(fn, _t(x), _t(weight), op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes),
+                 _t(x), op_name="one_hot")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, op_name="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return apply(lambda v: v * (1.0 - p), _t(x), op_name="dropout_infer")
+        return _t(x)
+    key = _random.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape=tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(fn, _t(x), op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    key = _random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, shape=v.shape)
+        a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply(fn, _t(x), op_name="alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling
+# ---------------------------------------------------------------------------
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and not isinstance(padding[0], (list, tuple)):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Reference: paddle/phi/kernels/gpu/conv_kernel.cu (cudnn); here
+    jax.lax.conv_general_dilated → MXU convolutions."""
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+    dn = (data_format, "OIHW", data_format)
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.float32 else None,
+        ).astype(v.dtype)
+        if rest:
+            b = rest[0].reshape((1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1))
+            out = out + b
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, op_name="conv2d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups).astype(v.dtype)
+        if rest:
+            b = rest[0].reshape((1, -1, 1) if data_format == "NCL" else (1, 1, -1))
+            out = out + b
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, op_name="conv1d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    dn = (data_format, "OIDHW", data_format)
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups).astype(v.dtype)
+        if rest:
+            b = rest[0].reshape((1, -1, 1, 1, 1))
+            out = out + b
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, op_name="conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad_amt = _conv_padding(padding, nd)
+    if isinstance(pad_amt, str):
+        raise NotImplementedError("string padding for conv_transpose")
+
+    def fn(v, w, *rest):
+        # weight layout [in_c, out_c/groups, kh, kw] in paddle
+        out = jax.lax.conv_transpose(
+            v, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+            strides=stride,
+            padding=pad_amt,
+            rhs_dilation=dilation,
+            dimension_numbers=(data_format, "OIHW", data_format),
+            transpose_kernel=True,
+        ).astype(v.dtype)
+        if rest:
+            out = out + rest[0].reshape((1, -1, 1, 1))
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, op_name="conv2d_transpose")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
+
+    def fn(v):
+        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
+                                     pads if not isinstance(pad, str) else pad)
+
+    return apply(fn, _t(x), op_name="max_pool2d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
+
+    def fn(v):
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                       pads if not isinstance(pad, str) else pad)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and pad not in ("VALID",):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                           pads if not isinstance(pad, str) else pad)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return apply(fn, _t(x), op_name="avg_pool2d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v4 = v
+        else:
+            n, h, w, c = v.shape
+            v4 = jnp.transpose(v, (0, 3, 1, 2))
+        oh, ow = out_hw
+        assert h % oh == 0 and w % ow == 0, "adaptive pool requires divisible sizes"
+        v5 = v4.reshape(n, c, oh, h // oh, ow, w // ow)
+        out = v5.mean(axis=(3, 5))
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(fn, _t(x), op_name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        oh, ow = out_hw
+        assert h % oh == 0 and w % ow == 0
+        v5 = v.reshape(n, c, oh, h // oh, ow, w // ow)
+        return v5.max(axis=(3, 5))
+
+    return apply(fn, _t(x), op_name="adaptive_max_pool2d")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def fn(v, *rest):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    """Routes to the Pallas kernel on TPU (paddle_tpu.ops.rms_norm);
+    reference: rms_norm CUDA kernel (SURVEY.md §2.2)."""
+    from ..ops import rms_norm as _rms
+    return _rms.rms_norm(_t(x), _t(weight), epsilon=epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    c_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW", "NC") else -1
+
+    if training and not use_global_stats:
+        # compute batch stats; update running stats in-place (host-side semantic)
+        def fn(v, *rest):
+            axes = tuple(i for i in range(v.ndim) if i != (c_axis % v.ndim))
+            mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+            var = jnp.var(v.astype(jnp.float32), axis=axes)
+            shape = [1] * v.ndim
+            shape[c_axis % v.ndim] = -1
+            out = (v.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].astype(jnp.float32).reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + rest[i].astype(jnp.float32).reshape(shape)
+            return out.astype(v.dtype), mean, var
+
+        args = [_t(x)]
+        if weight is not None:
+            args.append(_t(weight))
+        if bias is not None:
+            args.append(_t(bias))
+        out, mean, var = apply(fn, *args, op_name="batch_norm")
+        # update running stats (no grad flow)
+        if running_mean is not None and not isinstance(mean._value, jax.core.Tracer):
+            rm = running_mean._value * momentum + mean._value * (1 - momentum)
+            rv = running_var._value * momentum + var._value * (1 - momentum)
+            running_mean._value = rm.astype(running_mean._value.dtype)
+            running_var._value = rv.astype(running_var._value.dtype)
+        elif running_mean is not None:
+            # under jit tracing: functional update recorded on the tensor
+            running_mean._value = (running_mean._value * momentum
+                                   + mean._value * (1 - momentum)).astype(running_mean.dtype)
+            running_var._value = (running_var._value * momentum
+                                  + var._value * (1 - momentum)).astype(running_var.dtype)
+        return out
+
+    def fn_eval(v, m, s, *rest):
+        shape = [1] * v.ndim
+        shape[c_axis % v.ndim] = -1
+        out = (v.astype(jnp.float32) - m.astype(jnp.float32).reshape(shape)) * \
+            jax.lax.rsqrt(s.astype(jnp.float32).reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(v.dtype)
+
+    args = [_t(x), _t(running_mean), _t(running_var)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn_eval, *args, op_name="batch_norm_eval")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    def fn(v, *rest):
+        n, c = v.shape[0], v.shape[1]
+        g = num_groups
+        rest_shape = v.shape[2:]
+        vg = v.reshape((n, g, c // g) + rest_shape).astype(jnp.float32)
+        axes = tuple(range(2, vg.ndim))
+        mean = vg.mean(axis=axes, keepdims=True)
+        var = vg.var(axis=axes, keepdims=True)
+        out = ((vg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = (1, c) + (1,) * len(rest_shape)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args, op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def fn(v, *rest):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+        shape = (1, -1) + (1,) * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args, op_name="instance_norm")
+
+
+# ---------------------------------------------------------------------------
+# padding / resize
+# ---------------------------------------------------------------------------
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def fn(v):
+        if len(pad) == v.ndim * 2:
+            widths = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(v.ndim)]
+        else:
+            # paddle convention: pad pairs run innermost-dim first
+            # ([left, right, top, bottom, ...] — W before H), over the spatial
+            # dims of the given data_format.
+            nd = len(pad) // 2
+            if data_format in ("NHWC", "NLC", "NDHWC"):
+                spatial = list(range(1, v.ndim - 1))
+            else:
+                spatial = list(range(2, v.ndim))
+            widths = [(0, 0)] * v.ndim
+            for i in range(nd):
+                dim = spatial[len(spatial) - 1 - i]
+                widths[dim] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply(fn, _t(x), op_name="pad")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            if size is not None:
+                oh, ow = _pair(size)
+            else:
+                sf = _pair(scale_factor) if not isinstance(scale_factor, (int, float)) \
+                    else (scale_factor, scale_factor)
+                oh, ow = int(h * sf[0]), int(w * sf[1])
+            method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic",
+                      "area": "linear"}[mode]
+            vt = jnp.transpose(v, (0, 2, 3, 1))
+            out = jax.image.resize(vt, (n, oh, ow, c), method=method)
+            return jnp.transpose(out, (0, 3, 1, 2)).astype(v.dtype)
+        raise NotImplementedError(data_format)
+
+    return apply(fn, _t(x), op_name="interpolate")
+
+
+upsample = interpolate
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        l = patches.shape[2] * patches.shape[3]
+        return patches.reshape(n, c * k[0] * k[1], l)
+
+    return apply(fn, _t(x), op_name="unfold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v6 = v.reshape(n, c // (r * r), r, r, h, w)
+        v6 = jnp.transpose(v6, (0, 1, 4, 2, 5, 3))
+        return v6.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply(fn, _t(x), op_name="pixel_shuffle")
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Reference: paddle/phi/kernels/gpu/cross_entropy_kernel.cu; fused
+    softmax+CE in fp32 for stability."""
+
+    def fn(logits, lab, *rest):
+        lg = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(lg, 1e-30))
+        if soft_label:
+            tgt = lab.astype(jnp.float32)
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            if rest:
+                loss = loss * jnp.sum(rest[0] * tgt, axis=axis)
+            return _reduce_loss(loss, reduction)
+        li = lab.astype(jnp.int32)
+        if li.ndim == logp.ndim:
+            li = jnp.squeeze(li, axis=axis)
+        mask = li != ignore_index
+        safe_li = jnp.where(mask, li, 0)
+        nclass = logp.shape[axis]
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(safe_li, nclass, axis=axis)
+            tgt = onehot * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_li, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+        if rest:  # class weights
+            loss = loss * jnp.take(rest[0], safe_li)
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        return _reduce_loss(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lab, *rest):
+        li = lab.astype(jnp.int32)
+        mask = li != ignore_index
+        safe_li = jnp.where(mask, li, 0)
+        loss = -jnp.take_along_axis(logp, safe_li[..., None], axis=-1)[..., 0]
+        if rest:
+            loss = loss * jnp.take(rest[0], safe_li)
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        return _reduce_loss(loss, reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                 _t(input), _t(label), op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                 _t(input), _t(label), op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return apply(fn, _t(input), _t(label), op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *rest):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        base = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            logsig = jax.nn.log_sigmoid(z32)
+            log1msig = jax.nn.log_sigmoid(-z32)
+            base = -(pw * y32 * logsig + (1 - y32) * log1msig)
+        if weight is not None:
+            base = base * rest[i]
+        return _reduce_loss(base, reduction)
+
+    args = [_t(logit), _t(label)]
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(fn, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, tgt):
+        loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply(fn, _t(input), _t(label), op_name="kl_div")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), _t(input), _t(label),
+                 op_name="square_error_cost")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(lambda a, b, y: _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin),
+                                              reduction),
+                 _t(input), _t(other), _t(label), op_name="margin_ranking_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply(fn, _t(x1), _t(x2), op_name="cosine_similarity")
+
+
+# ---------------------------------------------------------------------------
+# attention (routes to Pallas on TPU)
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Parity with python/paddle/nn/functional/flash_attention.py::
+    scaled_dot_product_attention (SURVEY.md §2.2 flash_attn row); lowers to the
+    Pallas flash-attention kernel on TPU, jnp reference otherwise.
+    Layout: [batch, seqlen, nheads, headdim] (paddle convention)."""
+    from ..ops import flash_attention as fa
+    return fa.scaled_dot_product_attention(
+        _t(query), _t(key), _t(value), attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    from ..ops import flash_attention as fa
+    out = fa.scaled_dot_product_attention(
+        _t(query), _t(key), _t(value), dropout_p=dropout, is_causal=causal,
+        training=training)
+    return (out, None) if return_softmax else (out, None)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(lab):
+        n = lab.shape[-1]
+        return lab * (1 - epsilon) + epsilon / n
+    return apply(fn, _t(label), op_name="label_smooth")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.roll(v5[:, :, :fold], -1, axis=1).at[:, -1].set(0.0)
+        right = jnp.roll(v5[:, :, fold:2 * fold], 1, axis=1).at[:, 0].set(0.0)
+        rest = v5[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply(fn, _t(x), op_name="temporal_shift")

@@ -1,0 +1,406 @@
+"""``nn.Layer`` — module container.
+
+Parity with the reference's python/paddle/nn/layer/layers.py (``Layer``:
+parameter/sublayer registries, hooks, state_dict — SURVEY.md §2.5 user-API
+row). Parameters are ``Parameter`` tensors; the functional bridge
+(paddle_tpu.jit) lifts them into pytrees for compiled training steps.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class ParamAttr:
+    """Parity with paddle.ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 trainable=True, regularizer=None, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"invalid param attr {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------ attrs
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) and params is not None:
+            for d in (subs, buffers):
+                d.pop(name, None) if d else None
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params.pop(name)
+                object.__setattr__(self, name, None)
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            if isinstance(value, Tensor) or value is None:
+                buffers[name] = value
+            else:
+                buffers[name] = Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # ------------------------------------------------------------- creation
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer or (
+            I.Constant(0.0) if is_bias else I.XavierUniform())
+        value = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + "." + pname if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + "." + bname if name else bname), b
+
+    def _traverse(self, prefix: str, include_sublayers: bool):
+        yield prefix, self
+        if include_sublayers:
+            for sname, s in self._sub_layers.items():
+                if s is None:
+                    continue
+                sub_prefix = prefix + "." + sname if prefix else sname
+                yield from s._traverse(sub_prefix, True)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = [layer for _, layer in self._traverse("", True)]
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        for name, layer in self._traverse(prefix, True):
+            if not include_self and layer is self:
+                continue
+            yield name, layer
+
+    def children(self) -> Iterator["Layer"]:
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------- modes
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # ------------------------------------------------------------- state
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix, include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[(name + "." + bname) if name else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if tuple(val.shape) != tuple(tgt._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: saved {tuple(val.shape)} vs "
+                    f"current {tuple(tgt._value.shape)}")
+            tgt._value = val.astype(tgt._value.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------- dtype/device
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(d)
+            for _, b in self.named_buffers():
+                if b is not None and jnp.issubdtype(b._value.dtype, jnp.floating):
+                    b._value = b._value.astype(d)
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ------------------------------------------------------------- hooks/call
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + ("\n  ".join(sub_repr)))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n" + "\n".join(lines) + "\n"
+        return main + ")"
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, s in enumerate(sublayers):
+                self.add_sublayer(str(i), s)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx % len(self))] = layer
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def insert(self, index, layer):
+        items = list(self._sub_layers.values())
+        items.insert(index, layer)
+        self._sub_layers = collections.OrderedDict(
+            (str(i), l) for i, l in enumerate(items))
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], collections.OrderedDict):
+            for name, l in layers[0].items():
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                if isinstance(l, tuple):
+                    self.add_sublayer(l[0], l[1])
+                else:
+                    self.add_sublayer(str(i), l)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self)), parameter)
+        return self

@@ -1,0 +1,17 @@
+"""TPU kernel library (Pallas + XLA fallbacks).
+
+This package is the rebuild of the reference's fused CUDA kernel corpus
+(SURVEY.md §2.2): flash_attn → flash_attention.py, rms_norm → rms_norm.py,
+fused_rope → rope.py, ring attention (PaddleNLP ring_flash_attention) →
+ring_attention.py, fused_linear_param_grad_add → fused_linear.py,
+MoE global_scatter/gather + capacity → moe_ops.py,
+fused_multi_transformer → fused_transformer_block.py.
+
+Every kernel has: a Pallas TPU path, an XLA (jnp) reference path used on CPU
+and as the numerics oracle in tests, and a custom_vjp so both paths are
+differentiable. Selection honours FLAGS_use_pallas_kernels.
+"""
+
+from . import flash_attention, rms_norm, rope, moe_ops, ring_attention  # noqa: F401
+from . import fused_linear, fused_transformer_block  # noqa: F401
+from . import paged_attention  # noqa: F401

@@ -1,0 +1,38 @@
+"""Shared helpers for the kernel library."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..flags import flag_value
+
+
+# Platform strings that are NOT a TPU. The axon PJRT plugin registers the
+# real chip under platform "axon" (xla_bridge warns "Platform 'axon' is
+# experimental"), so membership is tested negatively: any accelerator that
+# is not a CPU/GPU-family backend is treated as a TPU for kernel selection.
+_NON_TPU_PLATFORMS = ("cpu", "gpu", "cuda", "rocm", "metal", "interpreter")
+
+
+def is_tpu_platform(platform: str) -> bool:
+    """Single source of the platform policy (bench.py reuses it)."""
+    return platform not in _NON_TPU_PLATFORMS
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    try:
+        return is_tpu_platform(jax.devices()[0].platform)
+    except Exception:
+        return False
+
+
+def use_pallas() -> bool:
+    return on_tpu() and flag_value("use_pallas_kernels")
+
+
+def next_multiple(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

@@ -1,0 +1,383 @@
+"""Flash attention (forward + backward) — Pallas TPU kernels + XLA fallback.
+
+Rebuild of the reference's ``flash_attn`` path: CUDA glue
+paddle/phi/kernels/gpu/flash_attn_kernel.cu + vendored libflashattn, Python
+surface python/paddle/nn/functional/flash_attention.py (SURVEY.md §2.2).
+Here the kernel itself is written in Pallas (online-softmax tiling over KV
+blocks; fp32 accumulators in VMEM; LSE saved for the backward pass), which is
+the TPU-native equivalent of FlashAttention-2.
+
+Internal layout: (BH, S, D) with batch*heads flattened into the leading grid
+dimension. Public entry points accept the paddle layout (B, S, H, D).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import use_pallas
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+from .. import random as _random
+
+_NEG_INF = -1e30
+
+
+def _mult(a: int, b: int) -> bool:
+    return a % b == 0
+
+
+# ===========================================================================
+# Forward kernel
+# ===========================================================================
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, bq, bk, nkv):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (j * bk < (i + 1) * bq) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (BQ, BK)
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(row >= col, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        m = m_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        # lse is carried as (BH, 1, S): a lane-major row per bh so the block
+        # shape (1, 1, bq) satisfies Mosaic's (sublane, lane) tiling rule.
+        lse_ref[0, 0] = (m + jnp.log(safe_l))[:, 0]
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nkv = sq // bq, sk // bk
+    grid = (bh, nq, nkv)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nkv=nkv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse[:, 0]
+
+
+# ===========================================================================
+# Backward kernels
+# ===========================================================================
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, bq, bk, nkv):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (j * bk < (i + 1) * bq) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(row >= col, p, 0.0)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = ((i + 1) * bq > j * bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(row >= col, p, 0.0)
+        pt = p.astype(do.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nkv = sq // bq, sk // bk
+    # lse/delta travel as (BH, 1, S) — see _fwd_kernel note on Mosaic tiling.
+    lse3 = lse[:, None, :]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nkv=nkv),
+        grid=(bh, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )(q, k, v, g, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nkv, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+    )(q, k, v, g, lse3, delta)
+    return dq, dk, dv
+
+
+# ===========================================================================
+# XLA reference path (oracle + fallback), layout (BH, S, D)
+# ===========================================================================
+def _attn_ref(q, k, v, scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ===========================================================================
+# custom_vjp dispatcher
+# ===========================================================================
+def _pick_blocks(sq, sk):
+    def pick(s):
+        for b in (512, 256, 128):
+            if s % b == 0:
+                return b
+        return None
+    return pick(sq), pick(sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_bhsd(q, k, v, scale, causal):
+    """(BH, S, D) flash attention; differentiable; pallas on TPU."""
+    out, _ = _fa_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _pallas_ok(q, k):
+    bq, bk = _pick_blocks(q.shape[1], k.shape[1])
+    return use_pallas() and bq is not None and bk is not None and _mult(q.shape[2], 128)
+
+
+def _fa_fwd(q, k, v, scale, causal):
+    if _pallas_ok(q, k):
+        bq, bk = _pick_blocks(q.shape[1], k.shape[1])
+        out, lse = _flash_fwd_pallas(q, k, v, scale, causal, bq, bk)
+        return out, (q, k, v, out, lse)
+    out = _attn_ref(q, k, v, scale, causal)
+    return out, (q, k, v, out, None)
+
+
+def _fa_bwd(scale, causal, res, g):
+    q, k, v, out, lse = res
+    if lse is not None and _pallas_ok(q, k):
+        bq, bk = _pick_blocks(q.shape[1], k.shape[1])
+        return _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk)
+    _, vjp = jax.vjp(lambda a, b, c: _attn_ref(a, b, c, scale, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ===========================================================================
+# Public paddle-layout entry points
+# ===========================================================================
+def _sdpa_array(q, k, v, *, scale, causal):
+    """(B, S, H, D) in/out; handles GQA by repeating KV heads."""
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hq, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hq, v.shape[1], d)
+    out = flash_attention_bhsd(qt, kt, vt, scale, causal)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+def _sdpa_masked(q, k, v, mask, *, scale, dropout_p, dropout_key, causal):
+    """XLA path with arbitrary mask / dropout. (B, S, H, D)."""
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(cm, s, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, _NEG_INF)
+        else:
+            s = s + mask.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def scaled_dot_product_attention(query: Tensor, key: Tensor, value: Tensor,
+                                 attn_mask=None, dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None):
+    """Paddle-layout (B, S, H, D) attention. Reference surface:
+    python/paddle/nn/functional/flash_attention.py (SURVEY.md §2.2)."""
+    d = query.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    drop = dropout_p if training else 0.0
+    if attn_mask is None and drop == 0.0:
+        return apply(lambda a, b, c: _sdpa_array(a, b, c, scale=sc, causal=is_causal),
+                     query, key, value, op_name="flash_attention")
+    dkey = _random.next_key()
+    if attn_mask is not None:
+        return apply(
+            lambda a, b, c, m: _sdpa_masked(a, b, c, m, scale=sc, dropout_p=drop,
+                                            dropout_key=dkey, causal=is_causal),
+            query, key, value, attn_mask if isinstance(attn_mask, Tensor) else Tensor(attn_mask),
+            op_name="attention_masked")
+    return apply(
+        lambda a, b, c: _sdpa_masked(a, b, c, None, scale=sc, dropout_p=drop,
+                                     dropout_key=dkey, causal=is_causal),
+        query, key, value, op_name="attention_dropout")

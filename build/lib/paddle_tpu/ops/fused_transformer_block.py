@@ -1,0 +1,207 @@
+"""Fused decoder-stack kernel — the ``fused_multi_transformer`` rebuild.
+
+Reference: paddle/fluid/operators/fused/fused_multi_transformer_op.cu(.h):§0 —
+a megakernel that loops over all decoder layers inside ONE op: per layer
+pre-LayerNorm → QKV GEMM → FMHA (with KV cache + ``time_step`` decode path) →
+out-proj → residual → FFN-LN → FFN1 → act → FFN2 → residual. Python surface:
+python/paddle/incubate/nn/functional/fused_transformer.py:§0 and the
+``FusedMultiTransformer`` layer (SURVEY.md §2.2).
+
+TPU-native design: the layer loop is a ``lax.scan`` over stacked parameters
+(one XLA computation for the whole stack — the compile-time analogue of the
+reference's in-kernel loop), attention goes through the Pallas flash kernel
+for prefill and a fused masked-softmax decode path for ``time_step`` steps,
+and LayerNorm/residual/FFN fuse under XLA. KV cache layout is
+``[L, 2, B, nh, S_max, hd]`` (k=0 / v=1), decode writes one slot per step.
+
+Stacked parameter pytree (leading dim L = num layers):
+  ln_scale, ln_bias        [L, H]
+  qkv_w [L, H, 3H], qkv_b  [L, 3H]
+  out_w [L, H, H],  out_b  [L, H]
+  ffn_ln_scale/bias        [L, H]
+  ffn1_w [L, H, F], ffn1_b [L, F]
+  ffn2_w [L, F, H], ffn2_b [L, H]
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import flash_attention as fa
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def layer_norm_array(x, scale, bias, eps=1e-5):
+    """fp32-accumulated LayerNorm (fused by XLA; parity with the reference's
+    in-kernel LN in fused_multi_transformer_op.cu.h:§0)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _split_heads(qkv, num_heads):
+    # (B, S, 3H) -> 3 × (B, nh, S, hd)
+    b, s, three_h = qkv.shape
+    h = three_h // 3
+    hd = h // num_heads
+    qkv = qkv.reshape(b, s, 3, num_heads, hd)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    return q, k, v
+
+
+def _prefill_attention(q, k, v, attn_mask, causal=True):
+    b, nh, s, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    if attn_mask is None:
+        out = fa.flash_attention_bhsd(
+            q.reshape(b * nh, s, hd), k.reshape(b * nh, s, hd),
+            v.reshape(b * nh, s, hd), scale, causal)
+        return out.reshape(b, nh, s, hd)
+    logits = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        logits = jnp.where(jnp.tril(jnp.ones((s, s), bool)), logits, -jnp.inf)
+    logits = logits + attn_mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bnkd->bnqd", p, v)
+
+
+def _decode_attention(q, k_cache, v_cache, cur_len, seq_lens=None,
+                      new_span=None):
+    """Single-step attention against the cache: q (B, nh, 1, hd),
+    cache (B, nh, Smax, hd); positions >= cur_len masked out.
+
+    ``seq_lens`` (B,) handles ragged batches: prefix positions are valid only
+    below each sequence's own prefill length, while ``new_span=(start, s)``
+    (the slots the current step just wrote) stays valid for everyone — the
+    reference kernel gets the same effect from its decode attn_mask
+    (fused_multi_transformer_op.cu.h:§0).
+    """
+    hd = q.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bnqd,bnkd->bnqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(k_cache.shape[2])
+    if seq_lens is None:
+        valid = pos[None, None, None, :] < cur_len
+    else:
+        start, s = new_span
+        prefix = pos[None, :] < seq_lens[:, None]           # (B, Smax)
+        new = (pos >= start) & (pos < start + s)
+        valid = (prefix | new[None, :])[:, None, None, :]
+    logits = jnp.where(valid, logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bnkd->bnqd", p, v_cache)
+
+
+def _one_layer(x, p, *, num_heads, act, eps, attn_mask, kv_cache, time_step,
+               seq_lens=None):
+    """One fused decoder layer. Returns (y, (k, v)) where k/v are this
+    layer's new cache contents (or the per-step k/v in decode mode)."""
+    b, s, h = x.shape
+    xn = layer_norm_array(x, p["ln_scale"], p["ln_bias"], eps)
+    qkv = xn @ p["qkv_w"] + p["qkv_b"]
+    q, k, v = _split_heads(qkv, num_heads)
+
+    if kv_cache is not None and time_step is not None:
+        k_cache, v_cache = kv_cache
+        k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, time_step, axis=2)
+        v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, time_step, axis=2)
+        attn = _decode_attention(q, k_cache, v_cache, time_step + s,
+                                 seq_lens=seq_lens, new_span=(time_step, s))
+        new_kv = (k_cache, v_cache)
+    else:
+        attn = _prefill_attention(q, k, v, attn_mask)
+        new_kv = (k, v)
+
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h)
+    x = x + (attn @ p["out_w"] + p["out_b"]).astype(x.dtype)
+
+    xn = layer_norm_array(x, p["ffn_ln_scale"], p["ffn_ln_bias"], eps)
+    f = _ACTS[act](xn @ p["ffn1_w"] + p["ffn1_b"])
+    x = x + (f @ p["ffn2_w"] + p["ffn2_b"]).astype(x.dtype)
+    return x, new_kv
+
+
+def fused_multi_transformer_array(
+        x, params, *, num_heads: int, act: str = "gelu", epsilon: float = 1e-5,
+        attn_mask=None, cache_kv=None, time_step: Optional[int] = None,
+        max_cache_len: Optional[int] = None, seq_lens=None):
+    """Run the whole decoder stack as one scanned computation.
+
+    Prefill (``time_step=None``): causal flash attention; when
+    ``max_cache_len`` is set, returns a right-padded KV cache ready for
+    decode. Decode (``time_step`` set, S==1): reads/updates ``cache_kv``
+    in place (functionally) and attends over the valid prefix.
+
+    Returns ``(out, cache_kv)`` — ``cache_kv`` is ``[L, 2, B, nh, Sc, hd]``
+    or None when no cache was requested.
+    """
+    L = params["ln_scale"].shape[0]
+    del L  # scan length is implied by the stacked leading dim
+
+    if time_step is not None:
+        if cache_kv is None:
+            raise ValueError("decode mode (time_step set) requires cache_kv")
+
+        def step(carry, layer_in):
+            p, kv = layer_in
+            y, new_kv = _one_layer(
+                carry, p, num_heads=num_heads, act=act, eps=epsilon,
+                attn_mask=None, kv_cache=(kv[0], kv[1]), time_step=time_step,
+                seq_lens=seq_lens)
+            return y, jnp.stack(new_kv)
+
+        out, new_cache = lax.scan(step, x, (params, cache_kv))
+        return out, new_cache
+
+    def step(carry, p):
+        y, (k, v) = _one_layer(
+            carry, p, num_heads=num_heads, act=act, eps=epsilon,
+            attn_mask=attn_mask, kv_cache=None, time_step=None)
+        return y, jnp.stack([k, v])
+
+    out, kv = lax.scan(step, x, params)
+    if max_cache_len is None and cache_kv is None:
+        return out, None
+    target = max_cache_len or cache_kv.shape[4]
+    s = x.shape[1]
+    pad = target - s
+    if pad < 0:
+        raise ValueError(f"sequence {s} exceeds cache length {target}")
+    kv = jnp.pad(kv, ((0, 0), (0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+    return out, kv
+
+
+def init_stacked_block_params(num_layers, hidden, ffn_hidden, seed=0,
+                              dtype=jnp.float32):
+    """Convenience init for the stacked parameter pytree (tests/benches)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+
+    def w(*shape, scale=0.02):
+        return jnp.asarray(rng.normal(0, scale, shape), dtype)
+
+    L, H, F = num_layers, hidden, ffn_hidden
+    return {
+        "ln_scale": jnp.ones((L, H), dtype), "ln_bias": jnp.zeros((L, H), dtype),
+        "qkv_w": w(L, H, 3 * H), "qkv_b": jnp.zeros((L, 3 * H), dtype),
+        "out_w": w(L, H, H), "out_b": jnp.zeros((L, H), dtype),
+        "ffn_ln_scale": jnp.ones((L, H), dtype),
+        "ffn_ln_bias": jnp.zeros((L, H), dtype),
+        "ffn1_w": w(L, H, F), "ffn1_b": jnp.zeros((L, F), dtype),
+        "ffn2_w": w(L, F, H), "ffn2_b": jnp.zeros((L, H), dtype),
+    }

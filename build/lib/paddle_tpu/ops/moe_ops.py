@@ -1,0 +1,214 @@
+"""MoE dispatch/capacity ops.
+
+Rebuild of the reference's CUDA capacity kernels and collective dispatch ops
+(SURVEY.md §2.4 EP row): ``number_count``, ``limit_by_capacity``,
+``prune_gate_by_capacity``, ``random_routing``
+(paddle/fluid/operators/collective/global_scatter_op.* and phi capacity
+kernels, file:§0) — here as pure-jnp ops XLA fuses, plus the dense
+GShard-style dispatch/combine einsums that replace global_scatter /
+global_gather. On an ``expert``-sharded mesh the einsum's expert dim IS the
+alltoall: GSPMD lowers the (N,E,C)×(N,d) contraction to an ICI all_to_all.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def number_count(gate_idx, upper_range: int):
+    """Histogram of expert assignments: out[e] = #tokens routed to e
+    (reference number_count op)."""
+    return jnp.bincount(gate_idx.reshape(-1).astype(jnp.int32),
+                        length=upper_range)
+
+
+def position_in_expert(gate_idx, num_experts: int):
+    """For each token, its arrival position within its expert's queue
+    (cumulative count of earlier tokens with the same expert)."""
+    one_hot = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot  # (N, E)
+    return pos.sum(axis=-1) - 1  # (N,) zero-based
+
+
+def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
+    """Clamp per-expert counts at capacity (reference limit_by_capacity):
+    returns the admitted counts."""
+    cap = jnp.asarray(capacity)
+    if cap.ndim == 0:
+        cap = jnp.full(expert_count.shape, cap)
+    return jnp.minimum(expert_count, cap)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,
+                           n_worker: int = 1):
+    """Set gate_idx to -1 for tokens beyond their expert's capacity
+    (reference prune_gate_by_capacity)."""
+    pos = position_in_expert(gate_idx, n_expert)
+    cap = expert_count[gate_idx]
+    return jnp.where(pos < cap, gate_idx, -1)
+
+
+def random_routing(topk_idx, topk_value, prob, topk: int = 2):
+    """GShard 2nd-expert random drop: keep expert #2 only when
+    2*value > prob (reference random_routing op). prob ~ U[0,1) per token."""
+    if topk != 2:
+        raise ValueError("random_routing supports topk=2 only")
+    keep = (2.0 * topk_value[:, 1]) > prob
+    second = jnp.where(keep, topk_idx[:, 1], -1)
+    return jnp.stack([topk_idx[:, 0], second], axis=1)
+
+
+def dispatch_combine_masks(gate_idx, gate_prob, num_experts: int,
+                           capacity: int):
+    """Dense GShard dispatch: returns
+      dispatch (N,E,C) bool — token n goes to slot c of expert e
+      combine  (N,E,C) f32  — same mask scaled by the gate prob.
+    Tokens with gate_idx -1 (pruned) or beyond capacity drop out.
+    """
+    valid = gate_idx >= 0
+    safe_idx = jnp.where(valid, gate_idx, 0)
+    oh_e = jax.nn.one_hot(safe_idx, num_experts, dtype=jnp.int32)
+    oh_e = oh_e * valid[:, None].astype(jnp.int32)
+    pos = jnp.cumsum(oh_e, axis=0) * oh_e  # 1-based where routed
+    pos = pos.sum(axis=-1) - 1  # (N,), -1 where unrouted
+    in_cap = (pos >= 0) & (pos < capacity)
+    keep = (valid & in_cap).astype(jnp.float32)
+    oh_c = jax.nn.one_hot(jnp.where(in_cap, pos, 0), capacity,
+                          dtype=jnp.float32)
+    disp = jnp.einsum("ne,nc->nec", oh_e.astype(jnp.float32), oh_c)
+    disp = disp * keep[:, None, None]
+    combine = disp * gate_prob[:, None, None]
+    return disp, combine
+
+
+def dispatch_masks_topk(gate_idx, num_experts: int, capacity: int):
+    """Per-choice dispatch masks with joint capacity ordering (GShard:
+    choice k's tokens queue after admitted tokens of choices < k). Returns a
+    list of K raw (N,E,C) float32 masks — index-only, no gradient path, so
+    callers can treat them as constants and keep probs differentiable."""
+    n, K = gate_idx.shape
+    masks = []
+    admitted = jnp.zeros((num_experts,), jnp.int32)
+    for k in range(K):
+        idx = gate_idx[:, k]
+        valid = idx >= 0
+        safe = jnp.where(valid, idx, 0)
+        oh = jax.nn.one_hot(safe, num_experts, dtype=jnp.int32) * \
+            valid[:, None].astype(jnp.int32)
+        pos = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1 + admitted[safe]
+        in_cap = valid & (pos >= 0) & (pos < capacity)
+        keep = in_cap.astype(jnp.float32)
+        oh_c = jax.nn.one_hot(jnp.where(in_cap, pos, 0), capacity,
+                              dtype=jnp.float32)
+        disp = jnp.einsum("ne,nc->nec", oh.astype(jnp.float32), oh_c) * \
+            keep[:, None, None]
+        masks.append(disp)
+        admitted = admitted + (oh * in_cap[:, None].astype(jnp.int32)
+                               ).sum(axis=0)
+    return masks
+
+
+def dispatch_combine_topk(gate_idx, gate_prob, num_experts: int,
+                          capacity: int):
+    """Joint top-K dispatch (GShard ordering: choice k's tokens queue after
+    the admitted tokens of choices < k), so (token, k) pairs never collide
+    in an expert's capacity slots. Returns summed (N,E,C) dispatch and
+    combine masks."""
+    masks = dispatch_masks_topk(gate_idx, num_experts, capacity)
+    disp_sum = sum(masks)
+    comb_sum = sum(m * gate_prob[:, k][:, None, None]
+                   for k, m in enumerate(masks))
+    return disp_sum, comb_sum
+
+
+def moe_dispatch(x, dispatch_mask):
+    """(N,d),(N,E,C) -> (E,C,d): the global_scatter equivalent — under an
+    expert-sharded mesh XLA turns this contraction into the alltoall."""
+    return jnp.einsum("nec,nd->ecd", dispatch_mask, x)
+
+
+def moe_combine(expert_out, combine_mask):
+    """(E,C,d),(N,E,C) -> (N,d): global_gather equivalent."""
+    return jnp.einsum("nec,ecd->nd", combine_mask, expert_out)
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel execution inside shard_map (the ragged alltoall of
+# global_scatter/global_gather over an ICI 'expert' axis — SURVEY §2.4 EP)
+# ---------------------------------------------------------------------------
+def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
+                          w1_local, w2_local, axis_name: str,
+                          num_experts: int, capacity: int, act=None,
+                          b1_local=None, b2_local=None):
+    """Expert-parallel MoE FFN with PRE-COMPUTED gating (any gate works:
+    naive/GShard/Switch indices with -1 = pruned token drop out of the
+    dispatch masks). Call inside shard_map; see :func:`expert_parallel_ffn`
+    for the data-path description.
+    """
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    if num_experts % n:
+        raise ValueError(f"num_experts {num_experts} must be divisible by "
+                         f"'{axis_name}' axis size {n}")
+    e_local = num_experts // n
+    if act is None:
+        act = jax.nn.gelu
+
+    disp, comb = dispatch_combine_topk(gate_idx_local, gate_prob_local,
+                                       num_experts, capacity)
+    in_dtype = x_local.dtype
+    slots = moe_dispatch(x_local.astype(jnp.float32), disp)  # (E, C, d)
+
+    d_model = x_local.shape[-1]
+    z = slots.reshape(n, e_local, capacity, d_model)
+    # chunk i (this device's dispatch FOR expert-group i) goes to device i;
+    # received leading dim then indexes the SOURCE device
+    z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0)
+    z = jnp.swapaxes(z, 0, 1).reshape(e_local, n * capacity, d_model)
+
+    h = jnp.einsum("ecd,edf->ecf", z.astype(in_dtype), w1_local)
+    if b1_local is not None:
+        h = h + b1_local[:, None, :]
+    h = act(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2_local)              # (E_local, nC, d)
+    if b2_local is not None:
+        y = y + b2_local[:, None, :]
+
+    y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    y = y.reshape(num_experts, capacity, d_model)
+    return moe_combine(y.astype(jnp.float32), comb).astype(in_dtype)
+
+
+def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
+                        axis_name: str, num_experts: int, capacity: int,
+                        topk: int = 1, act=None):
+    """Run a MoE FFN with experts sharded over ``axis_name``.
+
+    Call inside shard_map. Per device: T_local tokens, E_local =
+    num_experts/n experts (w1_local (E_local, d, ff), w2_local
+    (E_local, ff, d)); gating is over ALL experts (gate weights
+    replicated → gate_logits_local (T_local, num_experts)).
+
+    Data path (the reference's global_scatter → expert → global_gather,
+    SURVEY §3.2 MoE):
+      local dispatch (T_local, E, C) → (E, C, d)
+      all_to_all over the expert axis → (E_local, n·C, d) per device
+      local expert FFN
+      inverse all_to_all → local combine back to (T_local, d)
+    """
+    from jax import lax
+
+    probs = jax.nn.softmax(gate_logits_local.astype(jnp.float32), axis=-1)
+    if topk == 1:
+        gate_idx = jnp.argmax(probs, axis=-1)[:, None]       # (T, 1)
+        gate_prob = jnp.take_along_axis(probs, gate_idx, axis=-1)
+    else:
+        gate_prob, gate_idx = lax.top_k(probs, topk)
+    return expert_parallel_apply(x_local, gate_idx, gate_prob, w1_local,
+                                 w2_local, axis_name, num_experts, capacity,
+                                 act=act)

@@ -1,0 +1,291 @@
+"""Paged KV-cache attention + block-table cache manager.
+
+The serving-side replacement for the reference's contiguous CacheKV in
+fused_multi_transformer (paddle/fluid/operators/fused/
+fused_multi_transformer_op.cu.h:§0 — SURVEY.md §2.2, §2.7 #18): KV lives in
+fixed-size *pages*; each sequence owns a list of pages via a block table,
+so ragged batches don't reserve max_len × batch HBM and finished sequences
+return pages to the pool immediately (vLLM-style, and the layout of the
+TPU ragged-paged-attention kernels referenced in PAPERS.md).
+
+Two compute paths behind one dispatcher (:func:`paged_attention`):
+
+* XLA fallback — gather of the sequence's pages + masked softmax, fused by
+  XLA; runs everywhere (CPU tests included).
+* Pallas kernel (:func:`paged_attention_pallas`) — the block table rides
+  scalar prefetch, each grid step streams exactly ONE physical page
+  HBM→VMEM (Mosaic double-buffers consecutive steps), online-softmax
+  accumulation in VMEM scratch. HBM traffic is precisely the pages each
+  sequence owns — the point of paging on a bandwidth-bound decode.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # additive mask fill AND m_ref init — must stay identical
+
+
+# ---------------------------------------------------------------------------
+# Array-level op
+# ---------------------------------------------------------------------------
+
+def paged_attention_array(q, k_pages, v_pages, block_tables, seq_lens,
+                          scale: Optional[float] = None):
+    """Decode-time attention over paged KV.
+
+    q:            (B, nh, d)        — one query token per sequence
+    k_pages:      (P, page, nkv, d) — global page pool
+    v_pages:      (P, page, nkv, d)
+    block_tables: (B, max_pages) int32 — page ids per sequence (pad: 0)
+    seq_lens:     (B,) int32 — valid KV length per sequence
+    Returns (B, nh, d).
+    """
+    b, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    rep = nh // nkv
+
+    # gather each sequence's pages: (B, max_pages, page, nkv, d)
+    k = jnp.take(k_pages, block_tables, axis=0)
+    v = jnp.take(v_pages, block_tables, axis=0)
+    k = k.reshape(b, max_pages * page, nkv, d)
+    v = v.reshape(b, max_pages * page, nkv, d)
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    mask = jnp.arange(max_pages * page)[None, :] < seq_lens[:, None]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)
+
+
+def paged_write_array(k_pages, v_pages, k_new, v_new, block_tables, positions):
+    """Write one token's K/V into its page slot.
+
+    k_new/v_new: (B, nkv, d); positions: (B,) absolute position of the new
+    token. Returns updated (k_pages, v_pages).
+    """
+    page = k_pages.shape[1]
+    page_idx = positions // page          # (B,) which logical page
+    page_off = positions % page           # (B,) slot within the page
+    phys = jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0]
+    k_pages = k_pages.at[phys, page_off].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, page_off].set(v_new.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+# ---------------------------------------------------------------------------
+# Host-side page pool (the allocator metadata; device arrays hold the data)
+# ---------------------------------------------------------------------------
+
+class PagedKVCacheManager:
+    """Page pool + per-sequence block tables.
+
+    The reference's KV memory comes from the C++ caching allocator
+    (SURVEY.md §2.1 allocators row); on TPU the pool is one pre-allocated
+    device array per layer and this class manages only host metadata
+    (free list, per-sequence page lists) — no device allocation per step.
+    Page 0 is reserved as the pad/garbage page so padded block-table slots
+    always point at valid memory.
+    """
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+        self.page_size = page_size
+        self.num_pages = num_pages
+        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # 0 reserved
+        self._tables: dict = {}   # seq_id -> List[int]
+        self._lens: dict = {}     # seq_id -> int
+
+    # -- allocation ---------------------------------------------------------
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return len(self._free) >= self._pages_for(n_tokens)
+
+    def _pages_for(self, n_tokens: int) -> int:
+        return (n_tokens + self.page_size - 1) // self.page_size
+
+    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+        """Reserve pages for a new sequence of n_tokens (prefill)."""
+        need = self._pages_for(n_tokens)
+        if len(self._free) < need:
+            raise MemoryError(
+                f"KV pool exhausted: need {need} pages, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = pages
+        self._lens[seq_id] = n_tokens
+        return pages
+
+    def extend(self, seq_id, n_new: int = 1) -> None:
+        """Grow a sequence; acquires a page on boundary crossings."""
+        cur = self._lens[seq_id]
+        new_len = cur + n_new
+        have = len(self._tables[seq_id])
+        need = self._pages_for(new_len)
+        for _ in range(need - have):
+            if not self._free:
+                raise MemoryError("KV pool exhausted on extend")
+            self._tables[seq_id].append(self._free.pop())
+        self._lens[seq_id] = new_len
+
+    def free(self, seq_id) -> None:
+        self._free.extend(reversed(self._tables.pop(seq_id)))
+        self._lens.pop(seq_id)
+
+    # -- views for the op ---------------------------------------------------
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def block_tables(self, seq_ids) -> Tuple[np.ndarray, np.ndarray]:
+        """(block_tables (B, max_pages), seq_lens (B,)) for a batch;
+        padded slots point at reserved page 0."""
+        tables = [self._tables[s] for s in seq_ids]
+        width = max(len(t) for t in tables)
+        bt = np.zeros((len(tables), width), np.int32)
+        for i, t in enumerate(tables):
+            bt[i, :len(t)] = t
+        lens = np.asarray([self._lens[s] for s in seq_ids], np.int32)
+        return bt, lens
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel (TPU): double-buffered page fetch via scalar-prefetched
+# block tables — the ragged-paged-attention pattern (PAPERS.md)
+# ---------------------------------------------------------------------------
+def _paged_decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, page: int,
+                         n_pages: int, scale: float, nh: int, nkv: int,
+                         d: int):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = seq_lens_ref[b]
+    # skip pages entirely beyond this sequence's length
+    run = j * page < seq_len
+
+    @pl.when(run)
+    def _compute():
+        rep = nh // nkv
+        q = q_ref[0].astype(jnp.float32)            # (nh, d)
+        k = k_ref[0].astype(jnp.float32)            # (page, nkv, d)
+        v = v_ref[0].astype(jnp.float32)
+        qg = q.reshape(nkv, rep, d)
+        # (nkv, rep, d) x (page, nkv, d) -> (nkv, rep, page)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (nkv, rep, page), 2)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        s2 = s.reshape(nh, page)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)                     # (nh, page)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        pg = p.reshape(nkv, rep, page)
+        # (nkv, rep, page) x (page, nkv, d) -> (nkv, rep, d)
+        pv = jax.lax.dot_general(
+            pg, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv.reshape(nh, d)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Pallas decode kernel: same contract as paged_attention_array.
+
+    Each grid step fetches ONE physical page via the scalar-prefetched
+    block table (Mosaic double-buffers the HBM→VMEM stream), so HBM
+    traffic is exactly the pages each sequence owns — the fused
+    gather+softmax the XLA fallback approximates.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, seq_lens
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, nh, d), lambda bi, j, bt, sl: (bi, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda bi, j, bt, sl: (bt[bi, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda bi, j, bt, sl: (bt[bi, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d), lambda bi, j, bt, sl: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, n_pages=max_pages, scale=s,
+        nh=nh, nkv=nkv, d=d)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, d), v_pages.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                    scale: Optional[float] = None):
+    """Dispatcher: Pallas kernel on TPU (FLAGS_use_pallas_kernels), XLA
+    gather fallback elsewhere. Same contract as paged_attention_array."""
+    from ._common import use_pallas
+    if use_pallas():
+        return paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                      seq_lens, scale)
+    return paged_attention_array(q, k_pages, v_pages, block_tables,
+                                 seq_lens, scale)

@@ -1,0 +1,148 @@
+"""Ring attention — blockwise attention with KV rotation over a context
+(``sep``) mesh axis.
+
+Rebuild of the reference's ring-flash-attention layer (model-zoo
+ring_flash_attention.py consuming core sep groups + batch_isend_irecv —
+SURVEY.md §5.7 mechanism 3), designed TPU-first: the KV block rotates around
+the ICI ring via ``lax.ppermute`` (XLA double-buffers the permute against the
+block computation), and per-block results merge with online-softmax (log-sum-
+exp) rescaling, so memory stays O(S_local) per device while attending to the
+full sequence. Complements the Ulysses all_to_all variant (models/llama.py);
+pick per config (`sep_mode`).
+
+Causality uses *global* positions: device i holds contiguous chunk i, so a KV
+block that originated at chunk j is fully visible when j < i, causal when
+j == i, and fully masked when j > i.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ..core.dispatch import apply
+from ..parallel import mesh as _mesh
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, scale):
+    """(B,H,Sq,D)x(B,H,Sk,D) -> normalized out (B,H,Sq,D), lse (B,H,Sq).
+    fp32 softmax accumulation; bias is additive (0 / -inf mask)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + bias
+    m = jnp.max(s, axis=-1)
+    # fully-masked rows: keep m finite so exp() stays 0 without NaNs
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    # floor keeps 1/l^2 in the divide's gradient finite in fp32 (a 1e-30
+    # floor overflows to inf and poisons the backward with 0*inf NaNs)
+    l_safe = jnp.maximum(l, 1e-12)
+    lse = jnp.where(l > 0, m_safe + jnp.log(l_safe), _NEG_INF)
+    out = out / l_safe[..., None]
+    return out, lse
+
+
+def _merge(out1, lse1, out2, lse2):
+    """Online-softmax merge of two normalized partial results. Fully-masked
+    sides carry lse = -1e30 (finite), so their weight underflows to exactly 0
+    and the other side's weight to 1 — no extra guarding needed."""
+    lse_new = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse_new)
+    w2 = jnp.exp(lse2 - lse_new)
+    return out1 * w1[..., None] + out2 * w2[..., None], lse_new
+
+
+def ring_attention_array(q, k, v, axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Per-device blockwise ring attention, called inside shard_map.
+
+    q, k, v: (B, S_local, H, D) paddle layout (GQA: H_kv may divide H).
+    Returns (B, S_local, H, D).
+    """
+    b, s_loc, hq, d = q.shape
+    hk = k.shape[2]
+    rep = hq // hk
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    # (B, H, S, D) internal layout; KV rotates with its ORIGINAL hk heads —
+    # the GQA head repeat happens per-round after the permute, so ring ICI
+    # traffic is not inflated by hq/hk
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    p_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    q_pos = my * s_loc + jnp.arange(s_loc)
+    acc = jnp.zeros((b, hq, s_loc, d), jnp.float32)
+    lse = jnp.full((b, hq, s_loc), _NEG_INF, jnp.float32)
+
+    kv = (kt, vt)
+    for r in range(p_size):
+        src = (my - r) % p_size  # chunk id currently held
+
+        def compute(kv_pair):
+            kr, vr = kv_pair
+            if rep != 1:
+                kr = jnp.repeat(kr, rep, axis=1)
+                vr = jnp.repeat(vr, rep, axis=1)
+            if causal:
+                k_pos = src * s_loc + jnp.arange(s_loc)
+                bias = jnp.where(k_pos[None, :] <= q_pos[:, None],
+                                 0.0, _NEG_INF)[None, None]
+            else:
+                bias = jnp.zeros((1, 1, s_loc, s_loc), jnp.float32)
+            return _block_attn(qt, kr, vr, bias, scale)
+
+        def skip(kv_pair):
+            return (jnp.zeros((b, hq, s_loc, d), jnp.float32),
+                    jnp.full((b, hq, s_loc), _NEG_INF, jnp.float32))
+
+        if causal:
+            # chunks strictly ahead of this device are fully masked: skip
+            # both matmuls (their result is all-zero / -inf anyway)
+            out_r, lse_r = lax.cond(src > my, skip, compute, kv)
+        else:
+            out_r, lse_r = compute(kv)
+        acc, lse = _merge(acc, lse, out_r, lse_r)
+        if r + 1 < p_size:
+            kv = tuple(lax.ppermute(t, axis_name, perm) for t in kv)
+
+    return acc.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_flash_attention(query, key, value, group=None, causal: bool = True,
+                         scale: Optional[float] = None, axis: str = "sep"):
+    """Eager/global-array entry: inputs (B, S, H, D) with S the FULL
+    sequence; runs the ring program over the mesh's ``sep`` (context) axis
+    and returns the full-sequence result. Differentiable (tape-recorded)."""
+    mesh = _mesh.ensure_mesh() if group is None else group.mesh
+    ax = getattr(group, "axis", axis)
+    deg = mesh.shape.get(ax, 1)
+
+    def fn(qv, kv, vv):
+        if deg <= 1:
+            from . import flash_attention as fa
+            return fa._sdpa_array(qv, kv, vv, scale=scale or
+                                  1.0 / math.sqrt(qv.shape[-1]), causal=causal)
+        prog = shard_map(
+            partial(ring_attention_array, axis_name=ax, causal=causal,
+                    scale=scale),
+            mesh=mesh, in_specs=(P(None, ax), P(None, ax), P(None, ax)),
+            out_specs=P(None, ax), check_vma=False)
+        return prog(qv, kv, vv)
+
+    return apply(fn, query, key, value, op_name="ring_flash_attention")

@@ -1,0 +1,180 @@
+"""Fused RMSNorm (forward + backward) — Pallas TPU kernel with XLA fallback.
+
+Rebuild of the reference's ``rms_norm`` CUDA kernel
+(paddle/phi/kernels/gpu/rms_norm_kernel.cu, python wrapper
+python/paddle/incubate/nn/functional/fused_rms_norm.py — SURVEY.md §2.2).
+
+Math (fp32 accumulation regardless of input dtype):
+    inv = rsqrt(mean(x^2, -1) + eps);  y = x * inv * w
+    dx  = inv * (w*g) - x * inv^3 / H * sum(w*g*x, -1)
+    dw  = sum_batch(g * x * inv)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import use_pallas, next_multiple
+from ..core.dispatch import apply
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (numerics oracle; used on CPU and in tests)
+# ---------------------------------------------------------------------------
+def _rms_norm_ref(x, w, eps):
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+_BLOCK_ROWS = 256
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    y_ref[...] = (x * inv * w).astype(y_ref.dtype)
+    inv_ref[...] = jnp.broadcast_to(inv, inv_ref.shape)
+
+
+def _bwd_kernel(x_ref, w_ref, inv_ref, g_ref, dx_ref, dw_ref):
+    # dw is a (1, h) accumulator revisited by every grid step (TPU grid is
+    # sequential): Mosaic rejects a (1, h) block into an (nb, h) array
+    # (row-block 1 < 8), but a block equal to the whole array is legal.
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    inv = inv_ref[:, :1]
+    h = x.shape[-1]
+    wg = w * g
+    dot = jnp.sum(wg * x, axis=-1, keepdims=True)
+    dx = inv * wg - x * (inv ** 3) * (dot / h)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[...] += jnp.sum(g * x * inv, axis=0, keepdims=True)
+
+
+def _pick_block_rows(rows: int) -> int:
+    for br in (256, 128, 64, 32, 16, 8):
+        if rows % br == 0:
+            return br
+    return 0
+
+
+def _pallas_fwd(x2, w, eps, interpret=False):
+    rows, h = x2.shape
+    br = _pick_block_rows(rows)
+    grid = (rows // br,)
+    y, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        ],
+    )(x2, w.reshape(1, h))
+    return y, inv
+
+
+def _pallas_bwd(x2, w, inv, g2, interpret=False):
+    rows, h = x2.shape
+    br = _pick_block_rows(rows)
+    nb = rows // br
+    dx, dw_part = pl.pallas_call(
+        _bwd_kernel,
+        grid=(nb,),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
+        ],
+    )(x2, w.reshape(1, h), inv, g2)
+    return dx, dw_part.reshape(h)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm_array(x, w, eps=1e-6):
+    y, _ = _rms_fwd(x, w, eps)
+    return y
+
+
+def _rms_fwd(x, w, eps):
+    h = x.shape[-1]
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    if use_pallas() and h % 128 == 0 and _pick_block_rows(rows):
+        x2 = x.reshape(rows, h)
+        y, inv = _pallas_fwd(x2, w, eps)
+        return y.reshape(x.shape), (x, w, inv)
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    y = (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+    return y, (x, w, inv)
+
+
+def _rms_bwd(eps, res, g):
+    x, w, inv = res
+    h = x.shape[-1]
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    if use_pallas() and inv.ndim == 2 and inv.shape == (rows, 128):
+        dx, dw = _pallas_bwd(x.reshape(rows, h), w, inv, g.reshape(rows, h))
+        return dx.reshape(x.shape), dw.astype(w.dtype)
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    if inv.ndim == 2 and inv.shape[-1] == 128:  # pallas fwd residual, xla bwd
+        inv = inv[:, :1].reshape(x.shape[:-1] + (1,))
+    wg = wf * gf
+    dot = jnp.sum(wg * xf, axis=-1, keepdims=True)
+    dx = (inv * wg - xf * (inv ** 3) * (dot / h)).astype(x.dtype)
+    dw = jnp.sum(gf * xf * inv, axis=tuple(range(x.ndim - 1))).astype(w.dtype)
+    return dx, dw
+
+
+rms_norm_array.defvjp(_rms_fwd, _rms_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level API
+# ---------------------------------------------------------------------------
+def rms_norm(x, weight, epsilon=1e-6):
+    return apply(lambda xv, wv: rms_norm_array(xv, wv, epsilon), x, weight,
+                 op_name="rms_norm")

@@ -1,0 +1,53 @@
+"""Rotary position embedding (fused_rope equivalent).
+
+Reference: fused_rope CUDA kernel family under paddle/fluid/operators/fused/
+(SURVEY.md §2.2 "other fused family"). On TPU, rope is a cheap elementwise op
+that XLA fuses into the surrounding attention projections, so the XLA form IS
+the fused form; a Pallas variant adds nothing measurable.
+
+Convention: NeoX/Llama half-rotation. Layout (B, S, H, D).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def build_rope_cache(seq_len: int, head_dim: int, base: float = 10000.0,
+                     dtype=jnp.float32, position_offset: int = 0):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(position_offset, position_offset + seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def apply_rope_array(q, k, cos, sin):
+    """q, k: (B, S, H, D); cos/sin: (S, D) or (B, S, D)."""
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+def fused_rotary_position_embedding(q: Tensor, k: Tensor, cos, sin):
+    """Parity with paddle.incubate.nn.functional.fused_rotary_position_embedding."""
+    cos_v = cos._value if isinstance(cos, Tensor) else cos
+    sin_v = sin._value if isinstance(sin, Tensor) else sin
+    return apply(lambda a, b: apply_rope_array(a, b, cos_v, sin_v), q, k,
+                 op_name="fused_rope")

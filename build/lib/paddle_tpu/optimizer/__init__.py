@@ -1,0 +1,5 @@
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Lamb, RMSProp, Adagrad,
+)
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
+from . import lr  # noqa: F401

@@ -1,0 +1,79 @@
+"""Gradient clipping.
+
+Parity with python/paddle/nn/clip.py of the reference (``ClipGradByGlobalNorm``
+et al; SURVEY.md §2.5). Clip objects transform a list of (param, grad) pairs;
+the hybrid-parallel variant (distributed.fleet) extends global-norm with
+cross-mesh-axis psums.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, jnp.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return jnp.asarray(0.0, jnp.float32)
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def _clip(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        gnorm = self.global_norm([g for _, g in clippable])
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out

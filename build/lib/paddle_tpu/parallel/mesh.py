@@ -1,0 +1,85 @@
+"""Global device-mesh management — the TPU-native replacement for the
+reference's process-group world.
+
+Reference: CommunicateTopology builds a cartesian rank topology and one NCCL
+communicator per axis-group (python/paddle/distributed/fleet/base/topology.py,
+SURVEY.md §2.4 hybrid row). Here the SAME cartesian structure is ONE
+``jax.sharding.Mesh`` whose named axes are the parallelism dimensions; "comm
+groups" become mesh-axis handles, and collectives lower to XLA ICI/DCN ops.
+
+Axis order follows the reference's hybrid order ["dp", "pp", "sharding",
+"sep", "mp"] (+ "expert" folded over sharding×mp for MoE), so rank→coordinate
+math matches Fleet's.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+HYBRID_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh: List[Optional[Mesh]] = [None]
+
+
+def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None,
+               order: Optional[Sequence[str]] = None) -> Mesh:
+    """Build a Mesh over all devices with the hybrid axis order.
+
+    degrees: mapping axis -> parallel degree; missing axes get 1. Any leftover
+    device count is folded into 'dp'. ``order`` changes the device-assignment
+    order (reference hybrid_configs['order']); axis names stay the same.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    axis_order = tuple(order) if order else HYBRID_ORDER
+    if set(axis_order) != set(HYBRID_ORDER):
+        missing = set(HYBRID_ORDER) - set(axis_order)
+        axis_order = tuple(axis_order) + tuple(sorted(missing))
+    degs = {ax: int(degrees.get(ax, 1)) for ax in axis_order}
+    known = int(np.prod([d for d in degs.values()]))
+    if degs["dp"] == 1 and n % known == 0 and n // known > 1:
+        degs["dp"] = n // known
+        known = n
+    if known != n:
+        raise ValueError(
+            f"product of parallel degrees {degs} = {known} != #devices {n}")
+    shape = tuple(degs[ax] for ax in axis_order)
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, axis_order)
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    _global_mesh[0] = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh[0]
+
+
+def ensure_mesh(degrees: Optional[Dict[str, int]] = None) -> Mesh:
+    if _global_mesh[0] is None:
+        set_global_mesh(build_mesh(degrees or {}))
+    return _global_mesh[0]
+
+
+def axis_degree(axis: str) -> int:
+    m = get_global_mesh()
+    if m is None or axis not in m.shape:
+        return 1
+    return m.shape[axis]
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    m = get_global_mesh()
+    if m is None:
+        return None
+    return NamedSharding(m, P(*spec))
+
+
+def current_axis_names() -> Tuple[str, ...]:
+    m = get_global_mesh()
+    return tuple(m.axis_names) if m is not None else ()

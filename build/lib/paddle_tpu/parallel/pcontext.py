@@ -1,0 +1,43 @@
+"""Manual-parallel execution context.
+
+When the hybrid engine runs model code inside ``jax.shard_map`` (pp>1 or
+explicit-collective mode), layers must issue explicit ``lax.psum`` /
+``all_gather`` over named mesh axes — the Megatron execution style of the
+reference's mp_layers (python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py, SURVEY.md §2.4). Outside shard_map (GSPMD
+path / eager), the same layers run with sharding annotations instead.
+
+This context tells layer code which mode it is in and which axis names carry
+which parallelism dimension.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+_state = {
+    "manual": False,          # True inside shard_map
+    "axes": {},               # parallelism name -> mesh axis name, e.g. {"mp": "mp"}
+}
+
+
+def in_manual_mode() -> bool:
+    return _state["manual"]
+
+
+def manual_axis(kind: str) -> Optional[str]:
+    """Mesh axis name for 'mp' / 'dp' / 'pp' / 'sharding' / 'sep' / 'expert',
+    or None if that dimension is not active (degree 1)."""
+    return _state["axes"].get(kind)
+
+
+@contextlib.contextmanager
+def manual_parallel(axes: Dict[str, str]):
+    prev = dict(_state)
+    _state["manual"] = True
+    _state["axes"] = dict(axes)
+    try:
+        yield
+    finally:
+        _state.update(prev)

@@ -1,0 +1,138 @@
+"""Pipeline-parallel execution over a mesh axis (shard_map + ppermute).
+
+TPU-native rebuild of the reference's PipelineParallel engine
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py — SURVEY.md §2.4 PP row). Instead of NCCL
+send/recv between trainer processes, the whole pipeline is ONE compiled XLA
+program: stages live on submeshes of the ``pp`` axis, activations rotate with
+``lax.ppermute`` over ICI, and the microbatch loop is a ``lax.scan`` — XLA
+overlaps the permute DMA with the next microbatch's compute, which is the
+latency-hiding the reference gets from its separate comm stream.
+
+Schedule: GPipe-style fill-drain (all-forward then AD-driven all-backward).
+The bubble fraction is (S-1)/(M+S-1); interleaved/1F1B variants change peak
+memory, not bubble math, and remat (jax.checkpoint on stage_fn) recovers the
+memory the way 1F1B would.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(stage_fn: Callable, stage_params: Any, microbatches,
+                  axis_name: str = "pp"):
+    """Run inside shard_map. Executes the fill-drain pipeline.
+
+    stage_fn(params, x) -> y : one stage's computation (same structure on
+        every stage; per-stage weights come pre-sliced by shard_map).
+    microbatches: (M, ...) — microbatch-major input, replicated over the pp
+        axis (only stage 0 reads it).
+    Returns (M, ...) outputs — valid on the LAST stage, zeros elsewhere.
+
+    This is exactly the one-chunk-per-device special case of the
+    interleaved schedule below; delegating keeps a single scan skeleton.
+    """
+    lifted = jax.tree_util.tree_map(lambda a: a[None], stage_params)
+    return pipeline_spmd_interleaved(stage_fn, lifted, microbatches,
+                                     num_chunks=1, axis_name=axis_name)
+
+
+def last_stage_broadcast(x, axis_name: str = "pp"):
+    """Broadcast the last pp-stage's value to all stages (psum of a mask)."""
+    S = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(sid == S - 1, x, jnp.zeros_like(x)), axis_name)
+
+
+def stage_slice_info(axis_name: str = "pp"):
+    """(stage_id, num_stages) inside shard_map."""
+    return lax.axis_index(axis_name), lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual pipeline) schedule
+# ---------------------------------------------------------------------------
+def interleave_chunk_order(num_stages: int, num_chunks: int):
+    """Host-side pre-permutation for the stacked chunk-param array.
+
+    Model chunk j (contiguous layer block j of S*v) lives on device j % S
+    (Megatron interleave assignment). shard_map shards the leading dim in
+    contiguous blocks, so the stacked array must be reordered such that
+    device d's block [d*v:(d+1)*v] holds model chunks (d, d+S, d+2S, ...):
+    order[d*v + i] = d + i*S.
+    """
+    return [d + i * num_stages
+            for d in range(num_stages) for i in range(num_chunks)]
+
+
+def pipeline_spmd_interleaved(chunk_fn, chunk_params, microbatches,
+                              num_chunks: int, axis_name: str = "pp"):
+    """Interleaved virtual-pipeline schedule as ONE systolic scan.
+
+    Reference: PipelineParallelWithInterleave (SURVEY.md §2.4 PP row).
+    Each device holds ``v = num_chunks`` model chunks (chunk_params leaves:
+    leading dim v, pre-arranged via :func:`interleave_chunk_order`). Every
+    scan tick performs exactly one chunk-step per device and one ring
+    ppermute; the work item of device d at tick t is
+
+        w = t - d,  local chunk slot i = (w % (S*v)) // S,
+        microbatch m = (w // (S*v)) * S + (w % S)
+
+    which makes the ring deliver precisely the activation each device
+    needs one tick before it needs it (the Megatron interleave order,
+    with chunk boundaries crossing the ring seam d=S-1 → d=0 landing on
+    slot i+1). Fill/drain bubble: S-1 *chunk*-ticks out of M*v + S - 1
+    total — the v-fold bubble reduction over fill-drain, expressed so XLA
+    overlaps the ppermute DMA with the next tick's compute.
+
+    microbatches: (M, ...) with M % S == 0, replicated over the pp axis.
+    Returns (M, ...) outputs — valid on the LAST stage, zeros elsewhere.
+    """
+    S = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    v = num_chunks
+    M = microbatches.shape[0]
+    if v > 1 and M % S != 0:
+        # the (slot, m) decomposition below needs whole microbatch groups;
+        # v == 1 reduces to m = w, valid for any M
+        raise ValueError(f"microbatch count {M} must divide by stages {S}")
+    bad = [a.shape[0] for a in jax.tree_util.tree_leaves(chunk_params)
+           if a.shape[0] != v]
+    if bad:
+        # dynamic_index_in_dim clamps, which would silently reuse a chunk
+        raise ValueError(
+            f"chunk_params leaves must have leading dim {v}, got {bad}")
+    total_work = M * v
+    T = total_work + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    state = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outs = jnp.zeros(microbatches.shape, microbatches.dtype)
+
+    def step(carry, t):
+        state, outs = carry
+        w = t - d
+        valid = jnp.logical_and(w >= 0, w < total_work)
+        wc = jnp.clip(w, 0, total_work - 1)
+        slot = (wc % (S * v)) // S
+        m = (wc // (S * v)) * S + (wc % S)
+        inject = microbatches[m]
+        x = jnp.where(jnp.logical_and(d == 0, slot == 0), inject, state)
+        p_slot = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, slot, 0, keepdims=False),
+            chunk_params)
+        y = chunk_fn(p_slot, x)
+        emit = jnp.logical_and(valid,
+                               jnp.logical_and(d == S - 1, slot == v - 1))
+        outs = jnp.where(
+            emit, lax.dynamic_update_index_in_dim(outs, y, m, 0), outs)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    (state, outs), _ = lax.scan(step, (state, outs), jnp.arange(T))
+    return outs

@@ -1,0 +1,18 @@
+"""``paddle_tpu.profiler`` — tracing/profiling parity surface.
+
+Rebuild of paddle.profiler (reference: python/paddle/profiler/profiler.py,
+utils.py, profiler_statistic.py; C++ host tracer
+paddle/fluid/platform/profiler/host_tracer.cc — SURVEY.md §5.1). TPU-first:
+device-side spans come from the XLA profiler (``jax.profiler`` xplane traces,
+viewable in TensorBoard/XProf) rather than CUPTI; the framework keeps its own
+host-span recorder (the HostEventRecorder equivalent) for `RecordEvent`
+annotations, the executor/dataloader hooks, and chrome-tracing export.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, make_scheduler,
+    export_chrome_tracing, export_protobuf,
+)
+from .record import RecordEvent, record_function, host_recorder  # noqa: F401
+from .statistic import SortedKeys, summary  # noqa: F401
+from . import statistic as profiler_statistic  # noqa: F401

@@ -1,0 +1,253 @@
+"""Profiler with scheduler-windowed capture + exporters.
+
+Reference: paddle.profiler.Profiler / make_scheduler / export_chrome_tracing
+(python/paddle/profiler/profiler.py — SURVEY.md §5.1). State machine parity:
+CLOSED → READY (warmup) → RECORD → RECORD_AND_RETURN on the last active
+step, driven by ``Profiler.step()``. Device-side capture delegates to
+``jax.profiler.start_trace/stop_trace`` (xplane/TensorBoard) when
+ProfilerTarget.TPU is requested.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from enum import Enum
+from typing import Callable, List, Optional, Sequence
+
+from .record import HostSpan, RecordEvent, host_recorder
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last record step of a window
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # parity alias — maps to the accelerator trace
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """Window scheduler (parity with paddle.profiler.make_scheduler):
+    skip_first steps CLOSED, then cycles of closed/ready/record; ``repeat=0``
+    cycles forever."""
+    if closed < 0 or ready < 0 or record <= 0:
+        raise ValueError("closed/ready must be >=0 and record >= 1")
+    cycle = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_scheduler(_step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Returns an on_trace_ready callback writing chrome://tracing JSON."""
+
+    def handler(prof: "Profiler") -> None:
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{worker}_time_{int(time.time()*1000)}.paddle_trace.json")
+        events = []
+        for sp in prof.collected_spans:
+            events.append({
+                "name": sp.name, "cat": sp.event_type, "ph": "X",
+                "ts": sp.start_ns / 1000.0,
+                "dur": (sp.end_ns - sp.start_ns) / 1000.0,
+                "pid": sp.pid, "tid": sp.tid,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        prof.last_export_path = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Parity shim: the xplane protobuf comes from the jax profiler dump
+    (``jax.profiler.start_trace(log_dir)``); host spans are exported as
+    chrome tracing next to it."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    """Scheduler-windowed profiler (parity: paddle.profiler.Profiler).
+
+    ``targets`` containing TPU/GPU turns on the XLA device trace
+    (jax.profiler) for the capture window; CPU host spans are always
+    recorded while a window is active.
+    """
+
+    def __init__(self, *, targets: Optional[Sequence[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 log_dir: str = "./profiler_log"):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if callable(scheduler):
+            self.scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            # (start, end) step-range shorthand, as in the reference
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        elif scheduler is None:
+            self.scheduler = _default_scheduler
+        else:
+            raise TypeError(f"bad scheduler: {scheduler!r}")
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.log_dir = log_dir
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self.collected_spans: List[HostSpan] = []
+        self.last_export_path: Optional[str] = None
+        self._device_tracing = False
+        self._step_event: Optional[RecordEvent] = None
+        self._benchmark = _TimerStats()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._begin_step_span()
+
+    def stop(self) -> None:
+        self._end_step_span()
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._capture_off(export=True)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None) -> None:
+        """Advance one training step; drives the window state machine."""
+        self._end_step_span()
+        self._benchmark.record_step(num_samples)
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(prev, self.current_state)
+        self._begin_step_span()
+
+    def __enter__(self) -> "Profiler":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- internals ----------------------------------------------------------
+
+    def _transition(self, prev: ProfilerState, new: ProfilerState) -> None:
+        was_rec = prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        is_rec = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if not was_rec and is_rec:
+            self._capture_on()
+        elif was_rec and prev == ProfilerState.RECORD_AND_RETURN:
+            self._capture_off(export=True)
+            if is_rec:  # back-to-back windows
+                self._capture_on()
+        elif was_rec and not is_rec:
+            self._capture_off(export=True)
+
+    def _capture_on(self) -> None:
+        if self.timer_only:
+            return
+        host_recorder.clear()
+        host_recorder.enabled = True
+        if any(t in (ProfilerTarget.TPU, ProfilerTarget.GPU,
+                     ProfilerTarget.CUSTOM_DEVICE) for t in self.targets):
+            try:
+                import jax.profiler as jprof
+                jprof.start_trace(self.log_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _capture_off(self, export: bool) -> None:
+        if self.timer_only:
+            return
+        host_recorder.enabled = False
+        self.collected_spans = host_recorder.drain()
+        if self._device_tracing:
+            try:
+                import jax.profiler as jprof
+                jprof.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        if export and self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def _begin_step_span(self) -> None:
+        if host_recorder.enabled:
+            self._step_event = RecordEvent(
+                f"ProfileStep#{self.step_num}", "ProfileStep")
+            self._step_event.begin()
+
+    def _end_step_span(self) -> None:
+        if self._step_event is not None:
+            self._step_event.end()
+            self._step_event = None
+
+    # -- reporting ----------------------------------------------------------
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        from .statistic import summary as _summary
+        return _summary(self.collected_spans, sorted_by=sorted_by,
+                        time_unit=time_unit)
+
+    def step_info(self, unit: Optional[str] = None) -> str:
+        return self._benchmark.info()
+
+
+class _TimerStats:
+    """reads/ips bookkeeping behind Profiler.step_info (reference
+    benchmark() timer)."""
+
+    def __init__(self):
+        self.last_t = None
+        self.durs: List[float] = []
+        self.samples: List[int] = []
+
+    def record_step(self, num_samples: Optional[int]) -> None:
+        t = time.perf_counter()
+        if self.last_t is not None:
+            self.durs.append(t - self.last_t)
+            self.samples.append(num_samples or 0)
+        self.last_t = t
+
+    def info(self) -> str:
+        if not self.durs:
+            return "no steps recorded"
+        avg = sum(self.durs) / len(self.durs)
+        total_samples = sum(self.samples)
+        ips = (total_samples / sum(self.durs)) if total_samples else 0.0
+        return (f"avg batch_cost: {avg*1000:.3f} ms, ips: {ips:.3f} samples/s")

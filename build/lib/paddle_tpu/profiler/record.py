@@ -1,0 +1,113 @@
+"""Host span recorder + RecordEvent annotation API.
+
+Reference: RecordEvent (python/paddle/profiler/utils.py) backed by the C++
+thread-local HostEventRecorder (paddle/fluid/platform/profiler/
+host_tracer.cc — SURVEY.md §5.1). Here the recorder is a process-global,
+thread-aware span list; when a capture is active each span additionally
+enters a ``jax.profiler.TraceAnnotation`` so it shows up in XLA xplane
+traces (TensorBoard) correlated with device activity.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, NamedTuple, Optional
+
+
+class HostSpan(NamedTuple):
+    name: str
+    event_type: str
+    start_ns: int
+    end_ns: int
+    tid: int
+    pid: int
+
+
+class _HostRecorder:
+    """HostEventRecorder equivalent: lock-guarded span sink, armed only
+    while a Profiler capture window is active (zero overhead otherwise)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: List[HostSpan] = []
+        self.enabled = False
+
+    def emit(self, span: HostSpan) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def drain(self) -> List[HostSpan]:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        return spans
+
+    def clear(self) -> None:
+        self.drain()
+
+
+host_recorder = _HostRecorder()
+
+_MAIN_PID = threading.main_thread().ident or 0
+
+
+class RecordEvent:
+    """User annotation span (parity: paddle.profiler.RecordEvent).
+
+    Usable as a context manager or via explicit begin()/end(). Event types
+    mirror the reference's TracerEventType names (UserDefined, Operator,
+    Dataloader, Communication, Forward, Backward, Optimization...).
+    """
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start_ns: Optional[int] = None
+        self._jax_ann = None
+
+    def begin(self) -> None:
+        self._start_ns = time.perf_counter_ns()
+        if host_recorder.enabled:
+            try:
+                import jax.profiler as jprof
+                self._jax_ann = jprof.TraceAnnotation(self.name)
+                self._jax_ann.__enter__()
+            except Exception:
+                self._jax_ann = None
+
+    def end(self) -> None:
+        if self._start_ns is None:
+            return
+        if self._jax_ann is not None:
+            try:
+                self._jax_ann.__exit__(None, None, None)
+            finally:
+                self._jax_ann = None
+        if host_recorder.enabled:
+            host_recorder.emit(HostSpan(
+                self.name, self.event_type, self._start_ns,
+                time.perf_counter_ns(),
+                threading.get_ident(), _MAIN_PID))
+        self._start_ns = None
+
+    def __enter__(self) -> "RecordEvent":
+        self.begin()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+def record_function(name: str, event_type: str = "UserDefined"):
+    """Decorator form of RecordEvent."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(name, event_type):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    return deco

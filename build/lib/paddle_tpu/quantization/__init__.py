@@ -1,0 +1,172 @@
+"""Weight-only quantization for serving.
+
+Rebuild of the reference's int8 serving path (fused_multi_transformer_int8
+— paddle/fluid/operators/fused/fused_multi_transformer_int8_op.cu:§0 — and
+the paddle.nn.quant weight_only_linear surface; SURVEY.md §2.2). TPU-first
+rationale: decode is HBM-bandwidth-bound, so storing weights int8 halves
+the bytes the MXU waits on; dequantization is expressed as a multiply that
+XLA fuses into the matmul (no separate dequant pass, mirroring the CUDA
+kernel's in-register dequant).
+
+Symmetric per-output-channel scales (int8, [-127, 127]); "weight_only_int4"
+packs two nibbles per byte with the same scale scheme.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import initializer as I
+
+
+def weight_quantize(w, algo: str = "weight_only_int8"):
+    """w: (in, out) float → (quantized weights, per-out-channel scales).
+
+    Parity with paddle.nn.quant.weight_quantize. int8: values in
+    [-127, 127]; int4: [-7, 7] packed two-per-byte along the input dim.
+    """
+    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    wf = wv.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)                     # (out,)
+    if algo == "weight_only_int8":
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(wf / scale[None, :]), -127, 127) \
+            .astype(jnp.int8)
+        return q, scale
+    if algo == "weight_only_int4":
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(wf / scale[None, :]), -7, 7).astype(jnp.int8)
+        if q.shape[0] % 2:
+            raise ValueError("int4 packing needs an even input dim")
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        return (lo | hi).astype(jnp.int8), scale
+    raise ValueError(f"unknown algo {algo!r}")
+
+
+def weight_dequantize(q, scale, algo: str = "weight_only_int8"):
+    """Inverse of weight_quantize. Accepts stacked layouts too: q
+    (..., in, out) with scale (..., out) — the broadcast keeps per-layer
+    scales aligned (quantize_stacked_params format)."""
+    if algo == "weight_only_int8":
+        return q.astype(jnp.float32) * scale[..., None, :]
+    if algo == "weight_only_int4":
+        u = q.astype(jnp.uint8)
+        lo = (u & 0x0F).astype(jnp.int8)
+        hi = ((u >> 4) & 0x0F).astype(jnp.int8)
+        # sign-extend 4-bit two's complement
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        # Packed axis is the INPUT dim (axis -2): row 2i came from lo[i],
+        # row 2i+1 from hi[i]. Interleave there so stacked (L, in/2, out)
+        # layouts unpack to (L, in, out) — stacking on axis 1 only worked
+        # for 2-D q.
+        full = jnp.stack([lo, hi], axis=-2)
+        full = full.reshape(q.shape[:-2] + (2 * q.shape[-2], q.shape[-1]))
+        return full.astype(jnp.float32) * scale[..., None, :]
+    raise ValueError(f"unknown algo {algo!r}")
+
+
+_ALGOS = {"int8": "weight_only_int8", "weight_only_int8": "weight_only_int8",
+          "int4": "weight_only_int4", "weight_only_int4": "weight_only_int4"}
+
+
+def weight_only_linear(x, weight, weight_scale, bias=None,
+                       weight_dtype: str = "int8"):
+    """Parity with paddle.nn.quant.weight_only_linear: x @ dequant(w) + b.
+    The dequant multiply fuses into the matmul under XLA."""
+    if weight_dtype not in _ALGOS:
+        raise ValueError(f"unknown weight_dtype {weight_dtype!r}; "
+                         f"expected one of {sorted(_ALGOS)}")
+    algo = _ALGOS[weight_dtype]
+
+    def fn(xv, qv, sv, *rest):
+        w = weight_dequantize(qv, sv, algo).astype(jnp.float32)
+        y = jnp.matmul(xv.astype(jnp.float32), w)
+        if rest:
+            y = y + rest[0]
+        return y.astype(xv.dtype)
+
+    args = [x, weight, weight_scale] + ([bias] if bias is not None else [])
+    return apply(fn, *args, op_name="weight_only_linear")
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in Linear whose weight is stored int8/int4 (serving layer;
+    parity with paddle.nn.quant.qat-exported weight-only linears)."""
+
+    def __init__(self, in_features, out_features, weight_dtype: str = "int8",
+                 has_bias: bool = True):
+        super().__init__()
+        if weight_dtype not in _ALGOS:
+            raise ValueError(f"unknown weight_dtype {weight_dtype!r}")
+        if _ALGOS[weight_dtype] == "weight_only_int4" and in_features % 2:
+            raise ValueError("int4 packing needs an even in_features")
+        self.weight_dtype = weight_dtype
+        store_rows = (in_features if _ALGOS[weight_dtype] == "weight_only_int8"
+                      else in_features // 2)
+        self.weight = self.create_parameter(
+            (store_rows, out_features),
+            default_initializer=I.Constant(0.0))
+        self.weight._value = jnp.zeros((store_rows, out_features), jnp.int8)
+        self.weight.trainable = False
+        self.weight.stop_gradient = True
+        self.weight_scale = self.create_parameter(
+            (out_features,), default_initializer=I.Constant(1.0))
+        self.weight_scale.trainable = False
+        self.weight_scale.stop_gradient = True
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+
+    @classmethod
+    def from_linear(cls, linear, weight_dtype: str = "int8"):
+        w = linear.weight._value
+        qcls = cls(int(w.shape[0]), int(w.shape[1]),
+                   weight_dtype=weight_dtype,
+                   has_bias=linear.bias is not None)
+        algo = _ALGOS[weight_dtype]  # cls() above validated the name
+        q, s = weight_quantize(w, algo)
+        qcls.weight._value = q
+        qcls.weight_scale._value = s
+        if linear.bias is not None:
+            qcls.bias._value = linear.bias._value
+        return qcls
+
+    def forward(self, x):
+        return weight_only_linear(x, self.weight, self.weight_scale,
+                                  self.bias, self.weight_dtype)
+
+
+def quantize_stacked_params(params: dict, keys=None,
+                            algo: str = "weight_only_int8") -> dict:
+    """Quantize a stacked-param dict (models/llama layout): each selected
+    (L, in, out) weight becomes {"q": int8, "scale": (L, out)}. The llama
+    serving paths (forward_stacked / prefill / decode, contiguous and
+    paged) consume this format directly — dequant happens inside the
+    per-layer einsums (models/llama.py::_dense)."""
+    if algo != "weight_only_int8":
+        raise ValueError(
+            "stacked-param quantization supports weight_only_int8 (int4's "
+            "nibble packing changes the contraction-dim shape the layer "
+            "einsums expect)")
+    keys = keys or ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                    "lm_head")
+    out = dict(params)
+    for k in keys:
+        if k not in params:
+            continue
+        w = params[k]
+        if w.ndim == 3:
+            qs = [weight_quantize(w[i], algo) for i in range(w.shape[0])]
+            out[k] = {"q": jnp.stack([q for q, _ in qs]),
+                      "scale": jnp.stack([s for _, s in qs])}
+        else:
+            q, s = weight_quantize(w, algo)
+            out[k] = {"q": q, "scale": s}
+    return out

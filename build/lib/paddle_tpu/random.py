@@ -1,0 +1,84 @@
+"""Framework RNG state.
+
+Rebuild of the reference's generator/RNG plane (paddle/phi/core/generator.cc,
+python/paddle — ``paddle.seed``; SURVEY.md §2.4 RNGStatesTracker row) on jax
+PRNG keys. A single global key advances by fold-in counter; distributed
+per-mesh-axis RNG lives in paddle_tpu.distributed.meta_parallel.random.
+
+Under ``jit`` tracing, the compiled-step machinery (paddle_tpu.jit) installs a
+*traced* key so dropout masks differ per call without retracing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class _RNGState:
+    """Key creation is lazy: materialising a jax PRNG key initialises the
+    backend, and importing the package must not dial the TPU (the launcher
+    process, for one, never touches a device)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._base_key = None
+        self.counter = 0
+        self.traced_key = None  # set by jit machinery during trace
+
+    @property
+    def base_key(self):
+        if self._base_key is None:
+            self._base_key = jax.random.key(self.seed)
+        return self._base_key
+
+    @base_key.setter
+    def base_key(self, key):
+        self._base_key = key
+
+    def next_key(self):
+        if self.traced_key is not None:
+            self.counter += 1
+            return jax.random.fold_in(self.traced_key, self.counter)
+        self.counter += 1
+        return jax.random.fold_in(self.base_key, self.counter)
+
+
+_state = _RNGState(0)
+
+
+def seed(s: int) -> None:
+    """Parity with ``paddle.seed``."""
+    global _state
+    _state = _RNGState(int(s))
+
+
+def next_key():
+    return _state.next_key()
+
+
+def get_rng_state():
+    return (_state.counter, _state.base_key)
+
+
+def set_rng_state(state) -> None:
+    _state.counter, _state.base_key = state
+
+
+class traced_key_scope:
+    """Install a traced key for the duration of a jit trace."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        self.prev = (_state.traced_key, _state.counter)
+        _state.traced_key = self.key
+        _state.counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        _state.traced_key, _state.counter = self.prev
+        return False

@@ -1,0 +1,135 @@
+"""``paddle_tpu.static`` — minimal static-graph-surface parity.
+
+The reference's static graph engine (ProgramDesc + StandaloneExecutor,
+SURVEY.md §2.1) is replaced wholesale by jax tracing + XLA; what user code
+actually consumes from ``paddle.static`` in dygraph-era scripts is
+``InputSpec``, kept here.
+"""
+
+from __future__ import annotations
+
+from ..core.dtype import convert_dtype
+
+
+class InputSpec:
+    """Shape/dtype declaration for jit/save surfaces (reference:
+    python/paddle/static/input.py:§0)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+
+# ---------------------------------------------------------------------------
+# Executor / inference-model IO (SURVEY.md §2.1 standalone-executor row)
+# ---------------------------------------------------------------------------
+class Program:
+    """A compiled program handle. The reference's ProgramDesc/PIR Program is
+    replaced by a serialized StableHLO module (jit.save); this wrapper gives
+    Executor.run a feed/fetch surface over it."""
+
+    def __init__(self, translated=None):
+        self._translated = translated
+        n = len(translated.input_spec) if translated is not None else 0
+        self.feed_names = [f"x{i}" for i in range(n)]
+        n_out = translated.n_outputs if translated is not None else 0
+        self.fetch_names = [f"out{i}" for i in range(n_out)]
+
+    def __call__(self, *args):
+        return self._translated(*args)
+
+
+class CompiledProgram(Program):
+    """Parity alias (reference: paddle.static.CompiledProgram)."""
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "graph-building static mode is replaced by jax tracing; use "
+        "paddle_tpu.jit.to_static / jit.save, then Executor.run on the "
+        "loaded program (SURVEY.md §3.4: jax.jit replaces this engine)")
+
+
+default_startup_program = default_main_program
+
+
+class Executor:
+    """Runs loaded inference programs (reference: StandaloneExecutor via
+    paddle.static.Executor.run — SURVEY.md §3.4). Compilation, scheduling,
+    streams and GC all live in XLA; run() is dispatch + fetch."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: "Program" = None, feed=None, fetch_list=None,
+            return_numpy: bool = True):
+        import numpy as _np
+        if program is None or program._translated is None:
+            raise ValueError("Executor.run needs a loaded Program "
+                             "(static.load_inference_model)")
+        feed = feed or {}
+        args = []
+        for name in program.feed_names:
+            if name not in feed:
+                raise ValueError(f"missing feed '{name}' "
+                                 f"(expected {program.feed_names})")
+            args.append(feed[name])
+        out = program(*args)
+        outs = list(out) if isinstance(out, tuple) else [out]
+        program.fetch_names = [f"out{i}" for i in range(len(outs))]
+        vals = [o._value for o in outs]
+        if fetch_list:
+            idx = []
+            for f in fetch_list:
+                if isinstance(f, int):
+                    idx.append(f)
+                elif isinstance(f, str) and f.startswith("out") \
+                        and f[3:].isdigit():
+                    idx.append(int(f[3:]))
+                else:
+                    raise ValueError(
+                        f"unknown fetch {f!r}; valid fetches are indices or "
+                        f"{program.fetch_names}")
+            vals = [vals[i] for i in idx]
+        return [(_np.asarray(v) if return_numpy else v) for v in vals]
+
+    def close(self):
+        pass
+
+
+def load_inference_model(path_prefix: str, executor: "Executor" = None):
+    """Returns (program, feed_names, fetch_names) — reference signature."""
+    from ..jit.save_load import load as _load
+    prog = Program(_load(path_prefix))
+    return prog, list(prog.feed_names), prog.fetch_names
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, layer=None,
+                         input_spec=None):
+    """Save a Layer as an inference program (jit.save under the hood).
+
+    The reference extracts a pruned ProgramDesc from feed/fetch vars; here
+    the model must be passed explicitly (``layer`` + ``input_spec``, where
+    input_spec defaults to ``feed_vars`` when those are InputSpecs/arrays).
+    """
+    from ..jit.save_load import save as _save
+    target = layer if layer is not None else program
+    spec = input_spec or feed_vars
+    if target is None:
+        raise ValueError("save_inference_model needs layer= (an nn.Layer)")
+    _save(target, path_prefix, input_spec=spec)

@@ -1,0 +1,19 @@
+"""paddle_tpu.vision — vision model zoo, transforms, datasets.
+
+Rebuild of the reference's python/paddle/vision/ (SURVEY.md §2.5 "Vision model
+zoo": models/resnet.py, datasets/, transforms/). Models are built from the
+framework's nn layers so they run through the same jax/XLA compute path
+(NCHW public layout; XLA lays out convs for the MXU internally).
+"""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+
+from .models import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+    VGG, vgg11, vgg13, vgg16, vgg19,
+    MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
+    LeNet,
+)

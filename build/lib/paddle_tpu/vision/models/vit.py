@@ -1,0 +1,111 @@
+"""Vision Transformer (ViT) on the fused attention/FFN blocks.
+
+Workload #5's transformer-vision surface (SURVEY.md §6: ViT-L is one of
+the five benchmark configs). Pre-LN encoder built from the same fused
+incubate blocks as the language models — patch embedding is a strided
+Conv2D (one MXU matmul per patch grid), class token + learned positions,
+mean/cls pooling head. Reference surface: the model-zoo
+VisionTransformer family.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...incubate.nn.layer.fused_transformer import (
+    FusedFeedForward, FusedMultiHeadAttention)
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.common_layers import Conv2D, LayerNorm, Linear
+from ...nn.layer import Layer, LayerList
+
+
+class PatchEmbed(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        if img_size % patch_size:
+            raise ValueError("img_size must divide by patch_size")
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                           stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # (B, E, H/p, W/p)
+        b, e = x.shape[0], x.shape[1]
+        return x.reshape([b, e, -1]).transpose([0, 2, 1])  # (B, N, E)
+
+
+class ViTEncoderLayer(Layer):
+    def __init__(self, embed_dim, num_heads, mlp_ratio=4.0, epsilon=1e-6):
+        super().__init__()
+        self.attn = FusedMultiHeadAttention(
+            embed_dim, num_heads, normalize_before=True, epsilon=epsilon)
+        self.ffn = FusedFeedForward(
+            embed_dim, int(embed_dim * mlp_ratio), activation="gelu",
+            normalize_before=True, epsilon=epsilon)
+
+    def forward(self, x):
+        return self.ffn(self.attn(x, causal=False))
+
+
+class VisionTransformer(Layer):
+    """ViT backbone + classification head (class_num=0 → features only)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 class_num=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, epsilon=1e-6, representation_size=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            (1, 1, embed_dim), default_initializer=I.Normal(0.0, 0.02))
+        self.pos_embed = self.create_parameter(
+            (1, n + 1, embed_dim), default_initializer=I.Normal(0.0, 0.02))
+        self.blocks = LayerList([
+            ViTEncoderLayer(embed_dim, num_heads, mlp_ratio, epsilon)
+            for _ in range(depth)])
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = (Linear(embed_dim, class_num) if class_num > 0 else None)
+
+    def forward_features(self, x):
+        from ...core.dispatch import apply
+        x = self.patch_embed(x)
+
+        def add_tokens(xv, cls, pos):
+            b = xv.shape[0]
+            cls_b = jnp.broadcast_to(cls, (b,) + cls.shape[1:])
+            return jnp.concatenate([cls_b, xv], axis=1) + pos
+
+        x = apply(add_tokens, x, self.cls_token, self.pos_embed,
+                  op_name="vit_tokens")
+        for blk in self.blocks:
+            x = blk(x)
+        return self.norm(x)
+
+    def forward(self, x):
+        feats = self.forward_features(x)
+        cls = feats[:, 0]
+        return self.head(cls) if self.head is not None else cls
+
+
+def vit_base_patch16_224(**kwargs):
+    return VisionTransformer(img_size=224, patch_size=16, embed_dim=768,
+                             depth=12, num_heads=12, **kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    return VisionTransformer(img_size=224, patch_size=16, embed_dim=1024,
+                             depth=24, num_heads=16, **kwargs)
+
+
+def vit_tiny_test(**kwargs):
+    """Small config for tests/CI."""
+    base = dict(img_size=16, patch_size=4, in_chans=3, class_num=10,
+                embed_dim=32, depth=2, num_heads=4)
+    base.update(kwargs)
+    return VisionTransformer(**base)

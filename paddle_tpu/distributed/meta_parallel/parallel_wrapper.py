@@ -60,6 +60,16 @@ class HybridParallelModel(Layer):
             if self._strategy is not None and self._strategy.amp:
                 from ... import amp as _amp
                 c = self._strategy.amp_configs
+                if c.get("dtype", "bfloat16") == "float16":
+                    # fp16 needs the GradScaler state machine (scale loss,
+                    # skip on inf/nan) which is not wired into the compiled
+                    # step; bf16 is the TPU path and needs no scaling
+                    raise ValueError(
+                        "strategy.amp with dtype float16 is not supported "
+                        "in the compiled hybrid step (loss scaling state "
+                        "machine is eager-only) — use dtype bfloat16, or "
+                        "drive fp16 training eagerly with the scaler from "
+                        "distributed_optimizer().get_loss_scaler()")
                 base_loss = loss_fn
 
                 def loss_fn(model, *batch, _base=base_loss, _c=c):

@@ -13,9 +13,14 @@ Two execution paths:
   from one host; XLA/async dispatch overlaps them across devices when stage
   parameters are sharded onto pp submeshes.
 * **Compiled scan path** — for homogeneous decoder stacks the hybrid engine
-  compiles the whole fill-drain pipeline into one XLA program with ppermute
-  rotation (parallel/pipeline.py); used by the transformer models and the
-  benchmark (models/llama.py).
+  compiles the whole pipeline into one XLA program with ppermute rotation
+  (parallel/pipeline.py); used by the transformer models and the benchmark
+  (models/llama.py). Three schedules: fill-drain (pipeline_spmd),
+  interleaved virtual-pipeline (pipeline_spmd_interleaved), and true
+  memory-scheduled 1F1B (pipeline_1f1b — hand-scheduled forward+backward
+  with O(S) in-flight activations; benchmarks/bench_pipeline.py measured
+  ~30x lower temp memory and ~3x faster steps than fill-drain+AD on the
+  8-device CPU mesh at M=32).
 """
 
 from __future__ import annotations

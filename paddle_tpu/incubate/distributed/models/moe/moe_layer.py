@@ -38,17 +38,20 @@ def _ffn_parts(expert):
     computing different numerics)."""
     from .....nn.common_layers import Linear
 
-    linears, acts, others = [], [], 0
+    seq = []  # traversal-ordered ("linear"|"act", payload)
     for _, sub in expert.named_sublayers(include_self=True):
         if isinstance(sub, Linear):
-            linears.append(sub)
+            seq.append(("linear", sub))
         elif type(sub).__name__ in _ACTS:
-            acts.append(_ACTS[type(sub).__name__])
+            seq.append(("act", _ACTS[type(sub).__name__]))
         elif not list(sub.children()):  # unrecognized leaf layer
-            others += 1
-    if len(linears) != 2 or len(acts) != 1 or others:
+            return None
+    # order matters: gelu(x@w1)@w2 != gelu(x@w1@w2); shapes alone cannot
+    # disambiguate when d_model == intermediate
+    if [k for k, _ in seq] != ["linear", "act", "linear"]:
         return None
-    l1, l2 = linears
+    l1, l2 = seq[0][1], seq[2][1]
+    acts = [seq[1][1]]
     if l1.weight.shape[1] != l2.weight.shape[0] or \
             l1.weight.shape[0] != l2.weight.shape[1]:
         return None

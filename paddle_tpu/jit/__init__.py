@@ -24,6 +24,57 @@ from .. import random as _random
 from .functional import bind, param_arrays, buffer_arrays, tree_unwrap, tree_wrap
 
 
+class RecompileWarning(UserWarning):
+    """A compiled function saw a new input signature and recompiled."""
+
+
+class CompileGuard:
+    """Input-signature guard for jit boundaries — the SOT-guard equivalent
+    (reference: python/paddle/jit/sot/ bytecode guards, SURVEY.md §2.5
+    dy2static row / §7 hard-part #3).
+
+    jax.jit retraces silently on any shape/dtype/pytree change; this guard
+    makes every such cache miss VISIBLE: ``recompile_count`` counts misses
+    after the first compile and each miss emits a :class:`RecompileWarning`
+    naming the signature drift, so a shape leak in a training loop cannot
+    silently recompile per step.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._sigs: set = set()
+        self.recompile_count = 0
+
+    @staticmethod
+    def signature(*trees):
+        import jax as _jax
+
+        leaves, treedef = _jax.tree_util.tree_flatten(trees)
+        return (treedef,) + tuple(
+            (getattr(v, "shape", ()), str(getattr(v, "dtype", type(v).__name__)))
+            for v in leaves)
+
+    def check(self, *trees) -> bool:
+        """Record the call signature; returns True when it misses the cache
+        (first call does not count as a recompile)."""
+        import warnings
+
+        sig = self.signature(*trees)
+        if sig in self._sigs:
+            return False
+        miss = bool(self._sigs)
+        self._sigs.add(sig)
+        if miss:
+            self.recompile_count += 1
+            warnings.warn(
+                f"{self.name}: input signature changed (seen "
+                f"{len(self._sigs)} distinct signatures) -> XLA recompile "
+                f"#{self.recompile_count}. Pad/bucket inputs to stable "
+                "shapes to avoid per-step compilation.",
+                RecompileWarning, stacklevel=3)
+        return miss
+
+
 class StaticFunction:
     """jit-compiled forward (inference/eval) over an imperative fn/Layer."""
 
@@ -32,6 +83,7 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._jitted = None
+        self.guard = CompileGuard(getattr(fn, "__name__", "to_static"))
 
     def _build(self):
         layer = self._layer
@@ -55,8 +107,14 @@ class StaticFunction:
         params = param_arrays(self._layer) if self._layer else {}
         buffers = buffer_arrays(self._layer) if self._layer else {}
         key = _random.next_key()
-        out = self._jitted(params, buffers, key, tree_unwrap(args), tree_unwrap(kwargs))
+        uargs, ukwargs = tree_unwrap(args), tree_unwrap(kwargs)
+        self.guard.check(uargs, ukwargs)
+        out = self._jitted(params, buffers, key, uargs, ukwargs)
         return tree_wrap(out)
+
+    @property
+    def recompile_count(self) -> int:
+        return self.guard.recompile_count
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
@@ -94,6 +152,7 @@ class TrainStep:
         self.optimizer = optimizer
         self._donate = donate
         self._jitted = None
+        self.guard = CompileGuard(type(self).__name__)
         # materialise optimizer state for every trainable param now
         self._trainable = [
             (name, p) for name, p in model.named_parameters() if p.trainable
@@ -130,10 +189,9 @@ class TrainStep:
         lr_mults = {n: p.optimize_attr.get("learning_rate", 1.0)
                     for n, p in self._trainable}
         need_clip = {n: getattr(p, "need_clip", True) for n, p in self._trainable}
-        # honour AdamW.apply_decay_param_fun in the compiled path too
-        decay_fn = getattr(opt, "_apply_decay_param_fun", None)
-        wd_on = {n: (decay_fn is None or decay_fn(p.name))
-                 for n, p in self._trainable}
+        # honour per-param decay exclusion (AdamW.apply_decay_param_fun,
+        # Lamb.exclude_from_weight_decay_fn) in the compiled path too
+        wd_on = {n: opt._decay_enabled(p) for n, p in self._trainable}
 
         def step(params, opt_state, buffers, batch, lr, step_i, key):
             with _random.traced_key_scope(key):
@@ -176,6 +234,7 @@ class TrainStep:
     def __call__(self, *batch):
         if self._jitted is None:
             self._build()
+        self.guard.check(tree_unwrap(batch))
         opt = self.optimizer
         opt._step_count += 1
         params = param_arrays(self.model)

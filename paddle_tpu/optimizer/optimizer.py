@@ -83,6 +83,15 @@ class Optimizer:
     def _update(self, value, grad, state: Dict[str, Any], lr, step):
         raise NotImplementedError
 
+    def _decay_enabled(self, p: Parameter) -> bool:
+        """Whether weight decay applies to this param (AdamW's
+        apply_decay_param_fun / Lamb's exclude_from_weight_decay_fn);
+        consulted by both the eager step and the compiled TrainStep."""
+        fn = getattr(self, "_apply_decay_param_fun", None)
+        if fn is not None:
+            return bool(fn(p.name))
+        return True
+
     # -- eager step ----------------------------------------------------------
     def step(self):
         self._step_count += 1
@@ -91,15 +100,18 @@ class Optimizer:
                         if p._grad_value is not None and p.trainable]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
+        saved_wd = self._weight_decay
         for p, g in params_grads:
             if g is None:
                 continue
             state = self._state_of(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            self._weight_decay = saved_wd if self._decay_enabled(p) else 0.0
             new_v, new_state = self._update(p._value, g, dict(state), plr,
                                             self._step_count)
             p._value = new_v
             self._accumulators[id(p)] = new_state
+        self._weight_decay = saved_wd
 
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list:
@@ -297,6 +309,11 @@ class Lamb(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_enabled(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return False
+        return super()._decay_enabled(p)
 
     def _init_state(self, p):
         s = super()._init_state(p)

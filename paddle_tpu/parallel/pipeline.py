@@ -136,3 +136,121 @@ def pipeline_spmd_interleaved(chunk_fn, chunk_params, microbatches,
 
     (state, outs), _ = lax.scan(step, (state, outs), jnp.arange(T))
     return outs
+
+
+# ---------------------------------------------------------------------------
+# True 1F1B: hand-scheduled forward+backward, bounded activation memory
+# ---------------------------------------------------------------------------
+def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
+                  labels, loss_fn: Callable, axis_name: str = "pp"):
+    """Memory-scheduled 1F1B pipeline: ONE scan carrying forward AND
+    backward work, with per-stage activation buffers of depth 2S instead of
+    the fill-drain schedule's M in-flight microbatches.
+
+    Reference: PipelineParallel 1F1B (python/paddle/distributed/fleet/
+    meta_parallel/pipeline_parallel.py — SURVEY.md §2.4 PP row). There, each
+    microbatch's backward runs as soon as its grad arrives, freeing that
+    microbatch's activations; here the same clock is compiled into one SPMD
+    program:
+
+        F(m, d) at tick  t = d + m              (fill-drain forward clock)
+        B(m, d) at tick  t = 2S - 2 - d + m     (drains one tick behind the
+                                                 downstream stage's B)
+
+    Each tick a device runs (masked) one F and one B; boundary activations
+    live in a (2S, ...) rotating buffer — slot m % 2S is written by F and
+    consumed (then overwritten 2S microbatches later) by B, so peak
+    activation memory is O(S · microbatch), independent of M. The backward
+    recomputes the stage forward from the stored boundary input (jax.vjp at
+    B time) — the same FLOP tradeoff as fill-drain + remat, but with the
+    1F1B memory profile the reference gets from eager per-microbatch
+    backward. Bubble: 2(S-1) of M + 2S - 2 ticks.
+
+    stage_fn(p, x) -> y; loss_fn(y, label) -> scalar (applied on the LAST
+    stage; its gradient seeds the backward).
+    microbatches, labels: (M, ...) replicated over the pp axis.
+    Returns (mean_loss, grads) — loss valid on the last stage (broadcast it
+    with :func:`last_stage_broadcast`), grads a pytree like stage_params
+    (each stage's slice holds ∑_m of ITS stage's param grads, fp32).
+    """
+    S = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    depth = 2 * S
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    T = M + 2 * S - 2
+
+    # shard_map slices the stacked (S, ...) params to (1, ...) per stage;
+    # drop that stage dim so stage_fn sees its own weights directly
+    bad = [a.shape[0] for a in jax.tree_util.tree_leaves(stage_params)
+           if a.shape[0] != 1]
+    if bad:
+        raise ValueError(
+            f"stage_params leaves must arrive stage-sliced (leading dim 1 "
+            f"under shard_map in_specs P(axis)), got leading dims {bad}")
+    stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+    x_shape = microbatches.shape[1:]
+    last = S - 1
+
+    def fwd_only(p, x):
+        return stage_fn(p, x)
+
+    def step(carry, t):
+        fwd_state, grad_state, act_buf, gacc, loss_acc = carry
+
+        # ---- forward tick: F(m_f, d) at t = d + m_f --------------------
+        m_f = jnp.clip(t - d, 0, M - 1)
+        f_valid = jnp.logical_and(t - d >= 0, t - d < M)
+        x_in = jnp.where(d == 0, microbatches[m_f], fwd_state)
+        y = stage_fn(stage_params, x_in)
+        slot_f = m_f % depth
+        act_buf = jnp.where(
+            f_valid,
+            lax.dynamic_update_index_in_dim(act_buf, x_in, slot_f, 0),
+            act_buf)
+
+        # ---- backward tick: B(m_b, d) at t = 2S-2-d + m_b --------------
+        wb = t - (2 * S - 2 - d)
+        m_b = jnp.clip(wb, 0, M - 1)
+        b_valid = jnp.logical_and(wb >= 0, wb < M)
+        x_saved = lax.dynamic_index_in_dim(act_buf, m_b % depth, 0,
+                                           keepdims=False)
+        # one vjp per tick; the seed is the loss gradient on the last stage
+        # (loss_fn is parameter-free — a trainable head belongs in stage_fn)
+        # and the ring-received gy elsewhere
+        lab = labels[m_b]
+        y_b, vjp = jax.vjp(fwd_only, stage_params, x_saved)
+        loss_m, gy_loss = jax.value_and_grad(
+            lambda yy: loss_fn(yy, lab))(y_b)
+        is_last = d == last
+        gy = jnp.where(is_last, gy_loss.astype(y_b.dtype), grad_state)
+        gp, gx = vjp(gy)
+
+        gacc = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(b_valid, g, 0.0).astype(acc.dtype),
+            gacc, gp)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(b_valid, is_last), loss_m, 0.0)
+
+        # ---- rings ------------------------------------------------------
+        fwd_state = lax.ppermute(jnp.where(f_valid, y, jnp.zeros_like(y)),
+                                 axis_name, fwd_perm)
+        grad_state = lax.ppermute(jnp.where(b_valid, gx, jnp.zeros_like(gx)),
+                                  axis_name, bwd_perm)
+        return (fwd_state, grad_state, act_buf, gacc, loss_acc), None
+
+    fwd0 = jnp.zeros(x_shape, microbatches.dtype)
+    grad0 = jnp.zeros(x_shape, microbatches.dtype)
+    buf0 = jnp.zeros((depth,) + x_shape, microbatches.dtype)
+    gacc0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
+    carry, _ = lax.scan(step, (fwd0, grad0, buf0, gacc0,
+                               jnp.zeros((), jnp.float32)), jnp.arange(T))
+    _, _, _, gacc, loss_acc = carry
+    # mean-over-microbatches semantics for both outputs (matches
+    # grad(mean_m loss_m)); restore the stage dim so out_specs P(axis)
+    # reassembles the stack
+    gacc = jax.tree_util.tree_map(lambda a: a[None] / M, gacc)
+    return loss_acc / M, gacc

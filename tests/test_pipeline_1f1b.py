@@ -1,0 +1,121 @@
+"""True 1F1B pipeline schedule (VERDICT round-1 item 6).
+
+Parity: loss and stage-param grads must equal the serial AD oracle. Memory:
+the compiled program's activation footprint must stay flat in the microbatch
+count M (the fill-drain forward scan + AD grows linearly in M)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel import pipeline as ppipe
+
+S, H, MB = 4, 16, 4  # stages, width, per-microbatch rows
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss_fn(y, lab):
+    return jnp.mean((y - lab) ** 2)
+
+
+def _setup(M, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w": (rng.randn(S, H, H) * (1.0 / np.sqrt(H))).astype(np.float32),
+        "b": np.zeros((S, H), np.float32),
+    }
+    x = rng.randn(M, MB, H).astype(np.float32)
+    lab = rng.randn(M, MB, H).astype(np.float32)
+    return params, x, lab
+
+
+def _oracle(params, x, lab):
+    def full(params):
+        def one(xm, labm):
+            h = xm
+            for s in range(S):
+                h = _stage_fn({"w": params["w"][s], "b": params["b"][s]}, h)
+            return _loss_fn(h, labm)
+        return jnp.mean(jax.vmap(one)(x, lab))
+    loss, grads = jax.value_and_grad(full)(
+        jax.tree_util.tree_map(jnp.asarray, params))
+    return float(loss), grads
+
+
+def _build_1f1b(mesh, M):
+    def prog(params, x, lab):
+        loss, grads = ppipe.pipeline_1f1b(_stage_fn, params, x, lab,
+                                          _loss_fn, axis_name="pp")
+        return ppipe.last_stage_broadcast(loss, "pp"), grads
+
+    return jax.jit(jax.shard_map(
+        prog, mesh=mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
+        out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+        check_vma=False))
+
+
+def test_1f1b_matches_serial_oracle():
+    M = 8
+    params, x, lab = _setup(M)
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+    loss, grads = _build_1f1b(mesh, M)(params, x, lab)
+    # pipeline sums per-mb losses then /M, oracle means over M: same
+    ref_loss, ref_grads = _oracle(params, x, lab)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["w"]),
+                               np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["b"]),
+                               np.asarray(ref_grads["b"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _fill_drain_step(mesh):
+    """fill-drain forward scan + AD backward (the pre-existing schedule),
+    as a loss+grads program for the memory comparison."""
+    def fd_stage_fn(p, x):  # pipeline_spmd hands the (1, ...) shard slice
+        return _stage_fn(jax.tree_util.tree_map(lambda a: a[0], p), x)
+
+    def prog(params, x, lab):
+        def loss_of(params):
+            out = ppipe.pipeline_spmd(fd_stage_fn, params, x, axis_name="pp")
+            out = ppipe.last_stage_broadcast(out, "pp")
+            return jnp.mean(jax.vmap(_loss_fn)(out, lab))
+        return jax.value_and_grad(loss_of)(params)
+
+    return jax.jit(jax.shard_map(
+        prog, mesh=mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
+        out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+        check_vma=False))
+
+
+def test_1f1b_activation_memory_flat_in_microbatches():
+    """Peak temp memory of the 1F1B program must NOT scale with M (buffers
+    are depth 2S); the fill-drain+AD program's does. Compiled memory
+    analysis is the measurement (CPU backend reports temp_size_in_bytes)."""
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+
+    def temp_bytes(build, M):
+        params, x, lab = _setup(M)
+        c = build(mesh, M) if build is _build_1f1b else build(mesh)
+        lowered = c.lower(params, x, lab)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    t8 = temp_bytes(_build_1f1b, 8)
+    t32 = temp_bytes(_build_1f1b, 32)
+    f8 = temp_bytes(lambda mesh: _fill_drain_step(mesh), 8)
+    f32 = temp_bytes(lambda mesh: _fill_drain_step(mesh), 32)
+    # 4x more microbatches: 1F1B temp grows only with the (M,...) in/out
+    # buffers; fill-drain's AD residuals grow ~linearly
+    assert t32 < 2.2 * t8, (t8, t32)
+    assert f32 > 2.8 * f8, (f8, f32)
+    assert t32 < f32, (t32, f32)
+    print(f"temp bytes: 1f1b M=8 {t8} M=32 {t32}; "
+          f"fill-drain M=8 {f8} M=32 {f32}")
