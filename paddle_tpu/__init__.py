@@ -23,6 +23,8 @@ from .core.dtype import (  # noqa: F401
 )
 from .core.math_ops import *  # noqa: F401,F403
 from .core.math_ops import sum, max, min, abs, all, any, pow, round  # noqa: F401
+from .core import op_schema as _op_schema  # noqa: E402
+_op_schema.install(globals())  # schema-generated ops (only missing names)
 from .creation import (  # noqa: F401
     to_tensor, zeros, ones, full, empty, zeros_like, ones_like, full_like,
     empty_like, arange, linspace, logspace, eye, meshgrid, diag_embed,
@@ -51,7 +53,7 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "static", "quantization",
-                "linalg"):
+                "linalg", "fft", "sparse", "distribution"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -1,0 +1,609 @@
+"""Single-source op schema: one table drives the public API entry, the
+numpy oracle test, the dtype sweep, and the gradient check.
+
+Rebuild of the reference's YAML op definitions + OpTest harness
+(paddle/phi/api/yaml/ops.yaml, paddle/phi/api/generator/*.py,
+test/legacy_test/op_test.py — SURVEY.md §2.1 op-codegen row, §4 op-test
+row). The reference generates C++ APIs and grad nodes from YAML and tests
+every op on every backend with per-dtype tolerances; here each
+:class:`OpSpec` carries
+
+* ``fn``      — the jax implementation (vjp comes free via the tape),
+* ``oracle``  — an independent numpy reference,
+* ``sample``  — example-argument generator (shapes per case),
+* ``dtypes`` / per-dtype ``tol`` — the sweep matrix,
+* ``grad``    — whether to finite-difference-check the tape gradient.
+
+``install()`` materialises a paddle-shaped public wrapper (through the
+dispatch funnel, so AMP / nan-inf checks / profiler spans apply) for every
+spec not already hand-written; tests/test_op_schema.py consumes the same
+table, so adding ONE spec adds the API and its fp32+bf16 oracle coverage.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply
+from .tensor import Tensor
+
+
+# per-dtype default relative tolerances (reference OpTest: fp32 1e-5-ish,
+# bf16 ~1e-2 — bf16 has 8 mantissa bits)
+DEFAULT_TOL = {"float32": 2e-5, "bfloat16": 2e-2, "float16": 2e-3}
+
+
+@dataclass
+class OpSpec:
+    name: str
+    fn: Callable                        # jax impl: fn(*arrays, **attrs)
+    oracle: Callable                    # numpy impl: oracle(*nparrays, **attrs)
+    sample: Callable                    # sample(rng) -> (args tuple, attrs dict)
+    dtypes: Tuple[str, ...] = ("float32", "bfloat16")
+    tol: Dict[str, float] = field(default_factory=dict)
+    atol: float = 1e-6
+    grad: bool = True                   # finite-difference check (fp32 only)
+    grad_arg: int = 0                   # which positional arg to diff against
+    n_outputs: int = 1
+    integer_inputs: Tuple[int, ...] = ()  # positions NOT cast to the dtype
+
+    def tolerance(self, dtype: str) -> float:
+        return self.tol.get(dtype, DEFAULT_TOL.get(dtype, 1e-5))
+
+
+OPS: Dict[str, OpSpec] = {}
+
+
+def register(spec: OpSpec) -> OpSpec:
+    assert spec.name not in OPS, f"duplicate op spec {spec.name}"
+    OPS[spec.name] = spec
+    return spec
+
+
+def make_public(spec: OpSpec) -> Callable:
+    """Public paddle-shaped wrapper for a spec (Tensor in/out, dispatch
+    funnel for AMP/nan-inf/profiler)."""
+
+    def op(*args, **attrs):
+        attrs.pop("name", None)
+        return apply(functools.partial(spec.fn, **attrs), *args,
+                     op_name=spec.name)
+
+    op.__name__ = spec.name
+    op.__qualname__ = spec.name
+    op.__doc__ = (f"``{spec.name}`` — generated from the single-source op "
+                  f"schema (core/op_schema.py); oracle-tested on "
+                  f"{'/'.join(spec.dtypes)}.")
+    return op
+
+
+def install(namespace: dict, only_missing: bool = True) -> list:
+    """Install public wrappers for every registered spec into ``namespace``
+    (e.g. paddle_tpu's module dict). Returns the installed names."""
+    added = []
+    for name, spec in OPS.items():
+        if only_missing and name in namespace and namespace[name] is not None:
+            continue
+        namespace[name] = make_public(spec)
+        added.append(name)
+    return added
+
+
+# ===========================================================================
+# specs — tensor ops the round-1 corpus lacked (reference:
+# paddle/phi/kernels/{cpu,gpu}/*_kernel.* — SURVEY.md §2.1 kernel corpus)
+# ===========================================================================
+def _r(shape):
+    def gen(rng):
+        return (rng.randn(*shape).astype(np.float32),), {}
+    return gen
+
+
+def _seg_ids(n, m):
+    def gen(rng):
+        data = rng.randn(n, 4).astype(np.float32)
+        ids = np.sort(rng.randint(0, m, n)).astype(np.int32)
+        return (data, ids), {"num_segments": m}
+    return gen
+
+
+def _np_segment(reduce):
+    def oracle(data, ids, num_segments):
+        out_shape = (num_segments,) + data.shape[1:]
+        init = {"sum": 0.0, "mean": 0.0,
+                "max": -np.inf, "min": np.inf}[reduce]
+        out = np.full(out_shape, init, np.float32)
+        cnt = np.zeros((num_segments,), np.int64)
+        for i, s in enumerate(ids):
+            if reduce in ("sum", "mean"):
+                out[s] += data[i]
+            elif reduce == "max":
+                out[s] = np.maximum(out[s], data[i])
+            else:
+                out[s] = np.minimum(out[s], data[i])
+            cnt[s] += 1
+        if reduce == "mean":
+            out = out / np.maximum(cnt, 1)[(...,) + (None,) * (data.ndim - 1)]
+        if reduce in ("max", "min"):
+            out[cnt == 0] = 0.0  # paddle zeroes empty segments
+        return out
+    return oracle
+
+
+def _jax_segment(reduce):
+    def fn(data, ids, num_segments):
+        f = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+             "min": jax.ops.segment_min}.get(reduce)
+        if reduce == "mean":
+            s = jax.ops.segment_sum(data, ids, num_segments)
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                      num_segments)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (-1,) + (1,) * (data.ndim - 1))
+        out = f(data, ids, num_segments)
+        if reduce in ("max", "min"):
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                      num_segments)
+            mask = (cnt > 0).reshape((-1,) + (1,) * (data.ndim - 1))
+            out = jnp.where(mask, out, 0.0)
+        return out.astype(data.dtype)
+    return fn
+
+
+for _red in ("sum", "mean", "max", "min"):
+    register(OpSpec(
+        name=f"segment_{_red}",
+        fn=_jax_segment(_red),
+        oracle=_np_segment(_red),
+        sample=_seg_ids(16, 5),
+        integer_inputs=(1,),
+        grad=_red in ("sum", "mean"),
+        tol={"bfloat16": 4e-2},
+    ))
+
+
+register(OpSpec(
+    name="index_add",
+    fn=lambda x, index, value, axis=0: (
+        x + jnp.zeros_like(x).at[
+            (slice(None),) * (axis % x.ndim) + (index,)].add(value)),
+    oracle=lambda x, index, value, axis=0: _np_index_add(x, index, value, axis),
+    sample=lambda rng: ((rng.randn(8, 4).astype(np.float32),
+                         rng.randint(0, 8, 5).astype(np.int32),
+                         rng.randn(5, 4).astype(np.float32)), {"axis": 0}),
+    integer_inputs=(1,),
+    grad_arg=0,
+))
+
+
+def _np_index_add(x, index, value, axis):
+    out = x.astype(np.float64).copy()
+    for i, ix in enumerate(index):
+        sl = [slice(None)] * x.ndim
+        sl[axis] = ix
+        out[tuple(sl)] += value[i]
+    return out.astype(x.dtype)
+
+
+register(OpSpec(
+    name="trace",
+    fn=lambda x, offset=0, axis1=0, axis2=1: jnp.trace(
+        x, offset=offset, axis1=axis1, axis2=axis2),
+    oracle=lambda x, offset=0, axis1=0, axis2=1: np.trace(
+        x, offset=offset, axis1=axis1, axis2=axis2),
+    sample=lambda rng: ((rng.randn(5, 6).astype(np.float32),),
+                        {"offset": 1}),
+))
+
+register(OpSpec(
+    name="nanmedian",
+    fn=lambda x, axis=None, keepdim=False: jnp.nanmedian(
+        x, axis=axis, keepdims=keepdim),
+    oracle=lambda x, axis=None, keepdim=False: np.nanmedian(
+        x, axis=axis, keepdims=keepdim),
+    sample=lambda rng: ((np.where(rng.rand(6, 7) < 0.2, np.nan,
+                                  rng.randn(6, 7)).astype(np.float32),),
+                        {"axis": 1}),
+    grad=False,
+))
+
+register(OpSpec(
+    name="histogram",
+    fn=lambda x, bins=100, min=0.0, max=0.0: jnp.histogram(
+        x, bins=bins,
+        range=None if (min == 0.0 and max == 0.0) else (min, max))[0],
+    oracle=lambda x, bins=100, min=0.0, max=0.0: np.histogram(
+        x, bins=bins,
+        range=None if (min == 0.0 and max == 0.0) else (min, max))[0],
+    sample=lambda rng: ((rng.randn(64).astype(np.float32),),
+                        {"bins": 8, "min": -2.0, "max": 2.0}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="bucketize",
+    # int64 only materialises under jax_enable_x64; default to int32 to
+    # avoid a per-call truncation warning with identical results
+    fn=lambda x, sorted_sequence, out_int32=False, right=False:
+        jnp.searchsorted(sorted_sequence, x,
+                         side="right" if right else "left").astype(jnp.int32),
+    oracle=lambda x, sorted_sequence, out_int32=False, right=False:
+        np.searchsorted(sorted_sequence, x,
+                        side="right" if right else "left"),
+    sample=lambda rng: ((rng.randn(10).astype(np.float32),
+                         np.sort(rng.randn(6)).astype(np.float32)), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="rot90",
+    fn=lambda x, k=1, axes=(0, 1): jnp.rot90(x, k=k, axes=tuple(axes)),
+    oracle=lambda x, k=1, axes=(0, 1): np.rot90(x, k=k, axes=tuple(axes)),
+    sample=lambda rng: ((rng.randn(4, 5).astype(np.float32),), {"k": 3}),
+))
+
+register(OpSpec(
+    name="diff",
+    fn=lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis),
+    oracle=lambda x, n=1, axis=-1: np.diff(x, n=n, axis=axis),
+    sample=lambda rng: ((rng.randn(4, 9).astype(np.float32),), {"n": 2}),
+))
+
+register(OpSpec(
+    name="logcumsumexp",
+    fn=lambda x, axis=-1: jax.lax.associative_scan(
+        jnp.logaddexp, x, axis=axis),
+    oracle=lambda x, axis=-1: np.log(np.cumsum(
+        np.exp(x.astype(np.float64)), axis=axis)),
+    sample=lambda rng: ((rng.randn(4, 8).astype(np.float32),), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="renorm",
+    fn=lambda x, p=2.0, axis=0, max_norm=1.0: _jax_renorm(x, p, axis, max_norm),
+    oracle=lambda x, p=2.0, axis=0, max_norm=1.0: _np_renorm(x, p, axis, max_norm),
+    sample=lambda rng: ((rng.randn(5, 6).astype(np.float32) * 3,),
+                        {"p": 2.0, "axis": 0, "max_norm": 1.0}),
+))
+
+
+def _jax_renorm(x, p, axis, max_norm):
+    ax = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x.astype(jnp.float32)) ** p,
+                    axis=ax, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return (x * factor).astype(x.dtype)
+
+
+def _np_renorm(x, p, axis, max_norm):
+    ax = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = np.sum(np.abs(x.astype(np.float64)) ** p,
+                   axis=ax, keepdims=True) ** (1.0 / p)
+    factor = np.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return (x * factor).astype(x.dtype)
+
+
+register(OpSpec(
+    name="logaddexp",
+    fn=jnp.logaddexp,
+    oracle=np.logaddexp,
+    sample=lambda rng: ((rng.randn(6, 6).astype(np.float32),
+                         rng.randn(6, 6).astype(np.float32)), {}),
+))
+
+register(OpSpec(
+    name="hypot",
+    # same impl as the hand-written math_ops.hypot (which install() keeps):
+    # the overflow-safe jnp.hypot, so the spec tests the live op either way
+    fn=jnp.hypot,
+    oracle=np.hypot,
+    sample=lambda rng: ((rng.randn(6).astype(np.float32),
+                         rng.randn(6).astype(np.float32)), {}),
+))
+
+register(OpSpec(
+    name="copysign",
+    fn=jnp.copysign,
+    oracle=np.copysign,
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),
+                         rng.randn(8).astype(np.float32)), {}),
+    grad=False,
+))
+
+register(OpSpec(
+    name="frexp",
+    fn=lambda x: jnp.frexp(x),
+    oracle=lambda x: np.frexp(x),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+    n_outputs=2,
+))
+
+register(OpSpec(
+    name="ldexp",
+    fn=lambda x, y: jnp.ldexp(x, y),
+    oracle=lambda x, y: np.ldexp(x, y),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),
+                         rng.randint(-3, 3, 8).astype(np.int32)), {}),
+    dtypes=("float32",),
+    integer_inputs=(1,),
+    grad=False,
+))
+
+register(OpSpec(
+    name="vander",
+    fn=lambda x, n=None, increasing=False: jnp.vander(
+        x, N=n, increasing=increasing),
+    oracle=lambda x, n=None, increasing=False: np.vander(
+        x, N=n, increasing=increasing),
+    sample=lambda rng: ((rng.randn(5).astype(np.float32),),
+                        {"n": 4, "increasing": True}),
+    dtypes=("float32",),
+))
+
+# --- elementwise / special functions ---------------------------------------
+for _name, _jf, _nf, _gen, _grad in [
+    ("heaviside", lambda x, y: jnp.heaviside(x, y), np.heaviside,
+     lambda rng: ((rng.randn(8).astype(np.float32),
+                   rng.rand(8).astype(np.float32)), {}), False),
+    ("nextafter", jnp.nextafter, np.nextafter,
+     lambda rng: ((rng.randn(8).astype(np.float32),
+                   rng.randn(8).astype(np.float32)), {}), False),
+    ("i0", lambda x: jnp.i0(x), lambda x: np.i0(x),
+     lambda rng: ((rng.randn(8).astype(np.float32),), {}), False),
+    ("sinc", jnp.sinc, np.sinc,
+     lambda rng: ((rng.randn(8).astype(np.float32),), {}), True),
+    ("signbit", jnp.signbit, np.signbit,
+     lambda rng: ((rng.randn(8).astype(np.float32),), {}), False),
+    ("deg2rad", jnp.deg2rad, np.deg2rad,
+     lambda rng: ((rng.randn(8).astype(np.float32) * 90,), {}), True),
+    ("rad2deg", jnp.rad2deg, np.rad2deg,
+     lambda rng: ((rng.randn(8).astype(np.float32),), {}), True),
+    ("xlogy", lambda x, y: jnp.where(x == 0, 0.0, x * jnp.log(y)),
+     lambda x, y: np.where(x == 0, 0.0, x * np.log(y)),
+     lambda rng: ((rng.rand(8).astype(np.float32),
+                   rng.rand(8).astype(np.float32) + 0.1), {}), True),
+    ("logit", lambda x, eps=1e-6: jnp.log(
+        jnp.clip(x, eps, 1 - eps) / (1 - jnp.clip(x, eps, 1 - eps))),
+     lambda x, eps=1e-6: np.log(
+         np.clip(x, eps, 1 - eps) / (1 - np.clip(x, eps, 1 - eps))),
+     lambda rng: ((rng.rand(8).astype(np.float32),), {}), True),
+    ("nansum", lambda x, axis=None, keepdim=False: jnp.nansum(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.nansum(
+         x, axis=axis, keepdims=keepdim),
+     lambda rng: ((np.where(rng.rand(5, 6) < 0.2, np.nan,
+                            rng.randn(5, 6)).astype(np.float32),),
+                  {"axis": 1}), False),
+    ("nanmean", lambda x, axis=None, keepdim=False: jnp.nanmean(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.nanmean(
+         x, axis=axis, keepdims=keepdim),
+     lambda rng: ((np.where(rng.rand(5, 6) < 0.2, np.nan,
+                            rng.randn(5, 6)).astype(np.float32),),
+                  {"axis": 1}), False),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen, grad=_grad,
+                    dtypes=("float32",) if _name in
+                    ("nextafter", "signbit", "i0") else ("float32", "bfloat16")))
+
+
+# --- integer ops ------------------------------------------------------------
+for _name, _jf, _nf in [
+    ("gcd", jnp.gcd, np.gcd),
+    ("lcm", jnp.lcm, np.lcm),
+    ("bitwise_left_shift", jnp.left_shift, np.left_shift),
+    ("bitwise_right_shift", jnp.right_shift, np.right_shift),
+]:
+    register(OpSpec(
+        name=_name, fn=_jf, oracle=_nf,
+        sample=(lambda rng: ((rng.randint(1, 40, 8).astype(np.int32),
+                              rng.randint(1, 6, 8).astype(np.int32)), {})),
+        dtypes=("int32",), integer_inputs=(0, 1), grad=False))
+
+
+# --- linalg-adjacent --------------------------------------------------------
+register(OpSpec(
+    name="addmm",
+    fn=lambda input, x, y, beta=1.0, alpha=1.0: beta * input + alpha * (x @ y),
+    oracle=lambda input, x, y, beta=1.0, alpha=1.0:
+        beta * input + alpha * (x @ y),
+    sample=lambda rng: ((rng.randn(4, 6).astype(np.float32),
+                         rng.randn(4, 5).astype(np.float32),
+                         rng.randn(5, 6).astype(np.float32)),
+                        {"beta": 0.5, "alpha": 2.0}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="cross",
+    fn=lambda x, y, axis=-1: jnp.cross(x, y, axis=axis),
+    oracle=lambda x, y, axis=-1: np.cross(x, y, axis=axis),
+    sample=lambda rng: ((rng.randn(4, 3).astype(np.float32),
+                         rng.randn(4, 3).astype(np.float32)), {}),
+))
+
+register(OpSpec(
+    name="cdist",
+    fn=lambda x, y, p=2.0: _jax_cdist(x, y, p),
+    oracle=lambda x, y, p=2.0: _np_cdist(x, y, p),
+    sample=lambda rng: ((rng.randn(5, 3).astype(np.float32),
+                         rng.randn(6, 3).astype(np.float32)), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+
+def _jax_cdist(x, y, p):
+    d = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
+    return jnp.sum(d ** p, axis=-1) ** (1.0 / p)
+
+
+def _np_cdist(x, y, p):
+    d = np.abs(x[..., :, None, :] - y[..., None, :, :]).astype(np.float64)
+    if p == 2.0:
+        return np.sqrt((d * d).sum(-1) + 1e-12)
+    return (d ** p).sum(-1) ** (1.0 / p)
+
+
+register(OpSpec(
+    name="pdist",
+    fn=lambda x, p=2.0: _jax_cdist(x, x, p)[
+        tuple(jnp.triu_indices(x.shape[0], k=1))],
+    oracle=lambda x, p=2.0: _np_cdist(x, x, p)[
+        np.triu_indices(x.shape[0], k=1)],
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32),), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="clip_by_norm",
+    fn=lambda x, max_norm: x * jnp.minimum(
+        1.0, max_norm / (jnp.sqrt(jnp.sum(
+            x.astype(jnp.float32) ** 2)) + 1e-12)).astype(x.dtype),
+    oracle=lambda x, max_norm: x * min(
+        1.0, max_norm / (np.sqrt((x.astype(np.float64) ** 2).sum()) + 1e-12)),
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32) * 3,),
+                        {"max_norm": 1.0}),
+))
+
+register(OpSpec(
+    name="block_diag",
+    fn=lambda *xs: jax.scipy.linalg.block_diag(*xs),
+    oracle=lambda *xs: _np_block_diag(*xs),
+    sample=lambda rng: ((rng.randn(2, 3).astype(np.float32),
+                         rng.randn(3, 2).astype(np.float32)), {}),
+))
+
+
+def _np_block_diag(*xs):
+    rows = sum(a.shape[0] for a in xs)
+    cols = sum(a.shape[1] for a in xs)
+    out = np.zeros((rows, cols), xs[0].dtype)
+    r = c = 0
+    for a in xs:
+        out[r:r + a.shape[0], c:c + a.shape[1]] = a
+        r += a.shape[0]
+        c += a.shape[1]
+    return out
+
+
+# --- indexing ---------------------------------------------------------------
+def _jax_take(x, index, mode="raise"):
+    n = x.size
+    if mode == "raise":
+        # paddle errors on out-of-range; enforceable only on concrete
+        # (eager) indices — under tracing fall back to wrap, documented
+        try:
+            lo, hi = int(jnp.min(index)), int(jnp.max(index))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            lo, hi = -n, n - 1
+        if lo < -n or hi >= n:
+            raise IndexError(
+                f"take: index out of range for {n} elements "
+                f"(min {lo}, max {hi}); use mode='wrap' or 'clip'")
+        mode = "wrap"  # in-range negatives behave pythonically
+    return jnp.take(x.reshape(-1), index,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+register(OpSpec(
+    name="take",
+    fn=_jax_take,
+    oracle=lambda x, index, mode="raise": np.take(
+        x.reshape(-1), index, mode="clip" if mode == "clip" else "wrap"),
+    sample=lambda rng: ((rng.randn(4, 5).astype(np.float32),
+                         rng.randint(0, 20, 7).astype(np.int32)), {}),
+    integer_inputs=(1,),
+))
+
+register(OpSpec(
+    name="index_fill",
+    fn=lambda x, index, axis, value: x.at[
+        (slice(None),) * (axis % x.ndim) + (index,)].set(value),
+    oracle=lambda x, index, axis, value: _np_index_fill(x, index, axis, value),
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32),
+                         rng.permutation(6)[:3].astype(np.int32)),
+                        {"axis": 0, "value": 9.0}),
+    integer_inputs=(1,),
+))
+
+
+def _np_index_fill(x, index, axis, value):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = value
+    return out
+
+
+register(OpSpec(
+    name="triu_indices",
+    fn=lambda row, col=None, offset=0: jnp.stack(
+        jnp.triu_indices(row, k=offset, m=col or row)),
+    oracle=lambda row, col=None, offset=0: np.stack(
+        np.triu_indices(row, k=offset, m=col or row)),
+    sample=lambda rng: ((), {"row": 5, "offset": 1}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="tril_indices",
+    fn=lambda row, col=None, offset=0: jnp.stack(
+        jnp.tril_indices(row, k=offset, m=col or row)),
+    oracle=lambda row, col=None, offset=0: np.stack(
+        np.tril_indices(row, k=offset, m=col or row)),
+    sample=lambda rng: ((), {"row": 5, "offset": -1}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+
+# --- vision rearrangement ---------------------------------------------------
+register(OpSpec(
+    name="pixel_unshuffle",
+    fn=lambda x, downscale_factor, data_format="NCHW": _jax_pixel_unshuffle(
+        x, downscale_factor),
+    oracle=lambda x, downscale_factor, data_format="NCHW":
+        _np_pixel_unshuffle(x, downscale_factor),
+    sample=lambda rng: ((rng.randn(2, 3, 4, 4).astype(np.float32),),
+                        {"downscale_factor": 2}),
+))
+
+
+def _jax_pixel_unshuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+
+def _np_pixel_unshuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+
+register(OpSpec(
+    name="channel_shuffle",
+    fn=lambda x, groups, data_format="NCHW": x.reshape(
+        x.shape[0], groups, x.shape[1] // groups, *x.shape[2:]).swapaxes(
+            1, 2).reshape(x.shape),
+    oracle=lambda x, groups, data_format="NCHW": x.reshape(
+        x.shape[0], groups, x.shape[1] // groups, *x.shape[2:]).swapaxes(
+            1, 2).reshape(x.shape),
+    sample=lambda rng: ((rng.randn(2, 6, 3, 3).astype(np.float32),),
+                        {"groups": 3}),
+))

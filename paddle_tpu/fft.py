@@ -1,0 +1,84 @@
+"""``paddle_tpu.fft`` — discrete Fourier transform namespace.
+
+Rebuild of python/paddle/fft.py over phi FFT kernels
+(paddle/phi/kernels/funcs/fft.* — SURVEY.md §2.1 kernel corpus; listed as a
+round-1 gap in VERDICT "missing op families"). All transforms lower to XLA's
+FFT HLO via jnp.fft; gradients flow through the eager tape (jax FFTs are
+differentiable).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+
+def _norm(norm):
+    if norm in (None, "backward", "forward", "ortho"):
+        return norm or "backward"
+    raise ValueError(f"invalid norm {norm!r}")
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), x,
+                     op_name=jfn.__name__)
+    return op
+
+
+def _wrap2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda v: jfn(v, s=s, axes=tuple(axes),
+                                   norm=_norm(norm)), x,
+                     op_name=jfn.__name__)
+    return op
+
+
+def _wrapn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda v: jfn(v, s=s,
+                                   axes=None if axes is None else tuple(axes),
+                                   norm=_norm(norm)), x,
+                     op_name=jfn.__name__)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(
+        v, axes=None if axes is None else tuple(axes)), x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(
+        v, axes=None if axes is None else tuple(axes)), x,
+        op_name="ifftshift")

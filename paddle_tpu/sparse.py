@@ -1,0 +1,255 @@
+"""``paddle_tpu.sparse`` — COO/CSR sparse tensors.
+
+Rebuild of paddle's sparse surface (python/paddle/sparse/, phi
+SparseCooTensor/SparseCsrTensor — paddle/phi/core/sparse_coo_tensor.cc,
+SURVEY.md §2.1 DenseTensor row; flagged absent in VERDICT round 1).
+
+TPU-first design: a sparse tensor is (indices, values) with a STATIC nnz —
+XLA needs static shapes, so operations preserve nnz (coalescing with a
+fixed output budget) and compute lowers to gather/segment ops on the MXU
+rather than dynamic sparse kernels. This mirrors how the reference's
+SelectedRows (rows + dense chunk) represents embedding gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .core.dispatch import apply
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` (ndim, nnz) int32, ``values`` (nnz,
+    *dense_dims), dense ``shape``. Duplicate coordinates are allowed and sum
+    on densification (paddle semantics before coalesce)."""
+
+    def __init__(self, indices, values, shape):
+        self.indices = _unwrap(indices).astype(jnp.int32)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self.shape = tuple(int(s) for s in shape)
+        if self.indices.ndim != 2:
+            raise ValueError("indices must be (sparse_ndim, nnz)")
+
+    # -- introspection ------------------------------------------------------
+    def nnz(self) -> int:
+        return int(self.indices.shape[1])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversions --------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        shape = self.shape
+        sd = self.indices.shape[0]
+
+        def fn(idx, vals):
+            flat_shape = (int(np.prod(shape[:sd])),) + tuple(shape[sd:])
+            strides = np.cumprod((1,) + shape[:sd][::-1])[::-1][1:]
+            strides = jnp.asarray(np.ascontiguousarray(strides), jnp.int32)
+            flat_idx = jnp.sum(idx * strides[:, None], axis=0)
+            dense = jnp.zeros(flat_shape, vals.dtype).at[flat_idx].add(vals)
+            return dense.reshape(shape)
+
+        return apply(fn, Tensor(self.indices), self.values,
+                     op_name="sparse_to_dense")
+
+    def coalesce(self, max_nnz: Optional[int] = None) -> "SparseCooTensor":
+        """Merge duplicate coordinates (static nnz: merged slots keep one
+        representative, freed slots park at coordinate 0 with value 0 —
+        to_dense output is identical). With ``max_nnz`` the result is
+        trimmed to that budget: distinct coordinates occupy a prefix after
+        the merge, so the trim is lossless whenever the distinct count fits
+        (checked eagerly; a traced overflow cannot be detected)."""
+        sd = self.indices.shape[0]
+        strides = np.cumprod((1,) + self.shape[:sd][::-1])[::-1][1:]
+        strides = jnp.asarray(np.ascontiguousarray(strides), jnp.int32)
+        flat = jnp.sum(self.indices * strides[:, None], axis=0)
+        uniq, inv = jnp.unique(flat, return_inverse=True,
+                               size=flat.shape[0], fill_value=-1)
+        summed = jax.ops.segment_sum(self.values._value, inv, flat.shape[0])
+        keep = uniq >= 0
+        safe = jnp.where(keep, uniq, 0)
+        new_idx = jnp.stack([(safe // s) % d for s, d in
+                             zip(np.ascontiguousarray(strides),
+                                 self.shape[:sd])])
+        vals = jnp.where(
+            keep.reshape((-1,) + (1,) * (self.values._value.ndim - 1)),
+            summed, 0.0)
+        out = SparseCooTensor(new_idx, Tensor(vals.astype(self.values._value.dtype)),
+                              self.shape)
+        if max_nnz is not None and max_nnz < out.nnz():
+            try:
+                distinct = int(jnp.sum(keep))
+            except Exception:
+                distinct = None  # traced: trust the caller's budget
+            if distinct is not None and distinct > max_nnz:
+                raise ValueError(
+                    f"coalesce: {distinct} distinct coordinates exceed "
+                    f"max_nnz={max_nnz}")
+            # jnp.unique pads fill_value at the END: distinct coords occupy
+            # the prefix, so a head-trim is lossless within the budget
+            out = SparseCooTensor(out.indices[:, :max_nnz],
+                                  Tensor(out.values._value[:max_nnz]),
+                                  self.shape)
+        return out
+
+    # -- math ---------------------------------------------------------------
+    def __add__(self, other: "SparseCooTensor") -> "SparseCooTensor":
+        """Sparse + sparse. The result is coalesced and, when the combined
+        support fits, trimmed back to max(nnz_a, nnz_b) — so a repeated
+        accumulation over a fixed support (the SelectedRows embedding-grad
+        loop) keeps a STATIC nnz instead of growing (and recompiling) every
+        step. Disjoint supports keep the full nnz_a + nnz_b."""
+        if not isinstance(other, SparseCooTensor):
+            raise TypeError("sparse + dense: use to_dense() explicitly")
+        if other.shape != self.shape:
+            raise ValueError("shape mismatch")
+        idx = jnp.concatenate([self.indices, other.indices], axis=1)
+        vals = Tensor(jnp.concatenate([self.values._value,
+                                       other.values._value], axis=0))
+        merged = SparseCooTensor(idx, vals, self.shape)
+        budget = max(self.nnz(), other.nnz())
+        try:
+            return merged.coalesce(max_nnz=budget)
+        except ValueError:  # combined support larger than either input
+            return merged.coalesce()
+
+    def __mul__(self, scalar):
+        return SparseCooTensor(self.indices, self.values * scalar, self.shape)
+
+    __rmul__ = __mul__
+
+    def matmul(self, dense) -> Tensor:
+        """(M, K) sparse @ (K, N) dense → (M, N) dense, via gather over K
+        and a segment-sum over the row coordinate (MXU-free scatter form —
+        the SelectedRows-style embedding-gradient product)."""
+        if len(self.shape) != 2 or self.indices.shape[0] != 2:
+            raise ValueError("matmul needs a 2-D sparse matrix")
+        m = self.shape[0]
+
+        def fn(idx, vals, d):
+            rows, cols = idx[0], idx[1]
+            contrib = vals[:, None] * d[cols]            # (nnz, N)
+            return jax.ops.segment_sum(contrib, rows, m)
+
+        return apply(fn, Tensor(self.indices), self.values,
+                     dense if isinstance(dense, Tensor) else Tensor(dense),
+                     op_name="sparse_matmul")
+
+    def transpose(self, perm: Sequence[int]) -> "SparseCooTensor":
+        perm = list(perm)
+        new_idx = self.indices[jnp.asarray(perm, jnp.int32)]
+        return SparseCooTensor(new_idx, self.values,
+                               tuple(self.shape[p] for p in perm))
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows (M+1,), cols (nnz,), values (nnz,)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = _unwrap(crows).astype(jnp.int32)
+        self.cols = _unwrap(cols).astype(jnp.int32)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    def nnz(self) -> int:
+        return int(self.cols.shape[0])
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def to_dense(self) -> Tensor:
+        m, n = self.shape
+
+        def fn(crows, cols, vals):
+            counts = crows[1:] - crows[:-1]
+            rows = jnp.repeat(jnp.arange(m, dtype=jnp.int32), counts,
+                              total_repeat_length=cols.shape[0])
+            return jnp.zeros((m, n), vals.dtype).at[rows, cols].add(vals)
+
+        return apply(fn, Tensor(self.crows), Tensor(self.cols), self.values,
+                     op_name="sparse_csr_to_dense")
+
+
+# -- constructors (paddle.sparse API names) ---------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    idx = _unwrap(indices)
+    vals = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(x) for x in np.asarray(idx).max(axis=1) + 1)
+    t = SparseCooTensor(idx, vals, shape)
+    t.values.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    vals = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def to_sparse_coo(dense: Tensor, sparse_dim: Optional[int] = None,
+                  nnz: Optional[int] = None) -> SparseCooTensor:
+    """Densify → COO with a static nnz budget (default: exact count at trace
+    time via host round-trip; pass ``nnz`` to keep it jit-friendly)."""
+    v = _unwrap(dense)
+    sd = sparse_dim or v.ndim
+    flat = np.asarray(v.reshape((-1,) + v.shape[sd:]))
+    mask = np.any(flat != 0, axis=tuple(range(1, flat.ndim))) \
+        if flat.ndim > 1 else flat != 0
+    pos = np.nonzero(mask)[0]
+    if nnz is not None:
+        pos = pos[:nnz]
+        pad = nnz - pos.size
+        if pad > 0:
+            pos = np.concatenate([pos, np.zeros(pad, pos.dtype)])
+    idx = np.stack(np.unravel_index(pos, v.shape[:sd]))
+    vals = flat[pos]
+    if nnz is not None and pad > 0:
+        vals = vals.copy()
+        vals[len(pos) - pad:] = 0
+    return SparseCooTensor(jnp.asarray(idx, jnp.int32), Tensor(jnp.asarray(vals)),
+                           v.shape)
+
+
+# -- functional surface ------------------------------------------------------
+def add(a: SparseCooTensor, b: SparseCooTensor) -> SparseCooTensor:
+    return a + b
+
+
+def matmul(a: SparseCooTensor, dense) -> Tensor:
+    return a.matmul(dense)
+
+
+def relu(a: SparseCooTensor) -> SparseCooTensor:
+    from .nn import functional as F
+    return SparseCooTensor(a.indices, F.relu(a.values), a.shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))

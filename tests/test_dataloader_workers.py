@@ -6,6 +6,8 @@ import pytest
 
 from paddle_tpu import io
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 class SquareDataset(io.Dataset):
     def __init__(self, n):

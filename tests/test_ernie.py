@@ -8,6 +8,8 @@ import paddle_tpu as paddle
 from paddle_tpu import optimizer
 from paddle_tpu.models import ernie as E
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 def test_forward_shapes_and_pooler():
     paddle.seed(0)

@@ -1,0 +1,166 @@
+"""FFT, sparse COO/CSR, and distribution namespaces (round-1 gap families:
+VERDICT "missing op families" — FFT, SelectedRows/sparse, distribution ops).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, sparse, distribution as D
+
+
+# -- fft ---------------------------------------------------------------------
+def test_fft_roundtrip_and_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    got = np.asarray(fft.fft(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    back = np.asarray(fft.ifft(paddle.to_tensor(got))._value)
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+    r = np.asarray(fft.rfft(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(r, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    rr = np.asarray(fft.irfft(paddle.to_tensor(r), n=16)._value)
+    np.testing.assert_allclose(rr, x, rtol=1e-4, atol=1e-4)
+
+    x2 = rng.randn(4, 8, 8).astype(np.float32)
+    got2 = np.asarray(fft.fft2(paddle.to_tensor(x2))._value)
+    np.testing.assert_allclose(got2, np.fft.fft2(x2), rtol=1e-4, atol=1e-4)
+
+    f = np.asarray(fft.fftfreq(8, d=0.5)._value)
+    np.testing.assert_allclose(f, np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    sh = np.asarray(fft.fftshift(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(sh, np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_fft_gradients_flow():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(8).astype(np.float32))
+    x.stop_gradient = False
+    y = fft.rfft(x)
+    mag = (y.abs() ** 2).sum()  # |.| of a complex tensor is real
+    mag.backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+# -- sparse ------------------------------------------------------------------
+def test_sparse_coo_to_dense_and_matmul():
+    indices = np.array([[0, 1, 2, 1], [1, 0, 2, 2]], np.int32)
+    values = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    st = sparse.sparse_coo_tensor(indices, values, shape=(3, 4))
+    assert st.nnz() == 4 and st.is_sparse_coo()
+    dense = np.zeros((3, 4), np.float32)
+    for (r, c), v in zip(indices.T, values):
+        dense[r, c] += v
+    np.testing.assert_allclose(np.asarray(st.to_dense()._value), dense)
+
+    rhs = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    out = np.asarray(st.matmul(paddle.to_tensor(rhs))._value)
+    np.testing.assert_allclose(out, dense @ rhs, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_coalesce_merges_duplicates():
+    indices = np.array([[0, 0, 1], [1, 1, 0]], np.int32)  # (0,1) twice
+    values = np.array([1.0, 5.0, 2.0], np.float32)
+    st = sparse.sparse_coo_tensor(indices, values, shape=(2, 2)).coalesce()
+    np.testing.assert_allclose(np.asarray(st.to_dense()._value),
+                               [[0, 6], [2, 0]])
+
+
+def test_sparse_add_scale_relu_transpose():
+    a = sparse.sparse_coo_tensor([[0], [0]], [2.0], shape=(2, 2))
+    b = sparse.sparse_coo_tensor([[1], [1]], [-3.0], shape=(2, 2))
+    s = sparse.add(a, b) * 2.0
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value),
+                               [[4, 0], [0, -6]])
+    r = sparse.relu(s)
+    np.testing.assert_allclose(np.asarray(r.to_dense()._value),
+                               [[4, 0], [0, 0]])
+    t = a.transpose([1, 0])
+    assert t.shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value),
+                               [[2, 0], [0, 0]])
+
+
+def test_sparse_csr_and_from_dense():
+    dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+    csr = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 2], [1., 2., 3.],
+                                   shape=(2, 3))
+    np.testing.assert_allclose(np.asarray(csr.to_dense()._value), dense)
+    coo = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    np.testing.assert_allclose(np.asarray(coo.to_dense()._value), dense)
+    assert sparse.is_sparse(coo) and sparse.is_sparse(csr)
+
+
+def test_sparse_matmul_gradients():
+    indices = np.array([[0, 1], [1, 0]], np.int32)
+    st = sparse.sparse_coo_tensor(indices, [1.0, 2.0], shape=(2, 2),
+                                  stop_gradient=False)
+    rhs = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    st.matmul(rhs).sum().backward()
+    assert st.values.grad is not None
+    np.testing.assert_allclose(np.asarray(st.values.grad._value), [1.0, 1.0])
+
+
+# -- distributions -----------------------------------------------------------
+def test_normal_distribution():
+    paddle.seed(0)
+    n = D.Normal(loc=1.0, scale=2.0)
+    s = n.sample((5000,))
+    sv = np.asarray(s._value)
+    assert abs(sv.mean() - 1.0) < 0.15 and abs(sv.std() - 2.0) < 0.15
+    lp = float(n.log_prob(paddle.to_tensor(np.float32(1.0)))._value)
+    np.testing.assert_allclose(lp, -np.log(2.0) - 0.5 * np.log(2 * np.pi),
+                               rtol=1e-5)
+    ent = float(n.entropy()._value)
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * np.log(2 * np.pi)
+                               + np.log(2.0), rtol=1e-5)
+    kl = float(D.kl_divergence(n, D.Normal(1.0, 2.0))._value)
+    assert abs(kl) < 1e-6
+
+
+def test_uniform_bernoulli_categorical():
+    paddle.seed(1)
+    u = D.Uniform(low=-1.0, high=3.0)
+    s = np.asarray(u.sample((4000,))._value)
+    assert s.min() >= -1.0 and s.max() < 3.0
+    np.testing.assert_allclose(float(u.entropy()._value), np.log(4.0),
+                               rtol=1e-6)
+
+    b = D.Bernoulli(probs=np.float32(0.3))
+    sb = np.asarray(b.sample((4000,))._value)
+    assert abs(sb.mean() - 0.3) < 0.05
+
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = D.Categorical(logits=logits)
+    sc = np.asarray(c.sample((8000,))._value)
+    freq = np.bincount(sc, minlength=3) / sc.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    lp = np.asarray(c.log_prob(paddle.to_tensor(
+        np.array([0, 2], np.int64)))._value)
+    np.testing.assert_allclose(lp, np.log([0.2, 0.5]), rtol=1e-5)
+    kl = float(D.kl_divergence(c, D.Categorical(logits=logits))._value)
+    assert abs(kl) < 1e-6
+
+
+def test_sparse_add_keeps_static_nnz_on_fixed_support():
+    """Accumulating over a fixed support must not grow nnz (static shapes
+    for XLA — review finding round 2)."""
+    idx = np.array([[0, 1, 2], [1, 0, 2]], np.int32)
+    g = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 3.0], shape=(3, 3))
+    for _ in range(4):
+        g = g + sparse.sparse_coo_tensor(idx, [1.0, 1.0, 1.0], shape=(3, 3))
+    assert g.nnz() == 3, g.nnz()
+    dense = np.zeros((3, 3), np.float32)
+    dense[0, 1], dense[1, 0], dense[2, 2] = 5.0, 6.0, 7.0
+    np.testing.assert_allclose(np.asarray(g.to_dense()._value), dense)
+
+
+def test_take_raises_out_of_range():
+    import pytest
+    x = paddle.to_tensor(np.arange(20, dtype=np.float32))
+    with pytest.raises(IndexError, match="out of range"):
+        paddle.take(x, paddle.to_tensor(np.array([25], np.int32)))
+    # clip mode still works
+    got = paddle.take(x, paddle.to_tensor(np.array([25], np.int32)),
+                      mode="clip")
+    assert float(got._value[0]) == 19.0

@@ -17,6 +17,8 @@ from paddle_tpu.distributed import topology as topo
 from paddle_tpu.parallel import mesh as pmesh
 from paddle_tpu.models import llama as L
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def _clean_mesh():

@@ -1,11 +1,15 @@
 """GPT family on the fused decoder stack: forward parity vs an unfused
 reference implementation, training step, KV-cache generation parity."""
 
+import pytest
+
 import numpy as np
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.models import gpt as G
+
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
 
 def _ref_forward(model: G.GPTForCausalLM, ids: np.ndarray) -> np.ndarray:

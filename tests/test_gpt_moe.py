@@ -14,6 +14,8 @@ from paddle_tpu.distributed import topology as topo
 from paddle_tpu.parallel import mesh as pmesh
 from paddle_tpu.models.gpt_moe import GPTMoEForCausalLM, gpt_moe_tiny
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def _clean_mesh():

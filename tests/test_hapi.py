@@ -12,6 +12,8 @@ from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping, ModelCheckpoint,
 from paddle_tpu.io import Dataset
 from paddle_tpu.metric import Accuracy
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 class XorDataset(Dataset):
     """Tiny separable problem: y = (x0 > 0) ^ (x1 > 0)."""

@@ -12,6 +12,8 @@ import paddle_tpu as paddle
 from paddle_tpu import inference, jit, nn
 from paddle_tpu.static import InputSpec
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 def _mlp():
     paddle.seed(7)

@@ -13,6 +13,8 @@ from paddle_tpu.models import llama as L
 from paddle_tpu.parallel import mesh as pmesh
 from paddle_tpu.distributed import topology as topo_mod
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def reset_mesh():

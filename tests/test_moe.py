@@ -13,6 +13,8 @@ from paddle_tpu.incubate.distributed.models.moe import (
     ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate, SwitchGate)
 from paddle_tpu.ops import moe_ops
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 def test_number_count():
     idx = jnp.asarray([0, 2, 2, 1, 2, 0])

@@ -69,15 +69,24 @@ def _inputs(shapes):
     return [RNG.randn(*s).astype(np.float32) + 0.1 for s in shapes]
 
 
+# per-dtype tolerances (reference OpTest style: bf16 ~1e-2 relative)
+_DTYPE_TOL = {"float32": (1e-4, 1e-5), "bfloat16": (3e-2, 3e-2)}
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
 @pytest.mark.parametrize("name,pfn,nfn,shapes,check_grad",
                          CASES, ids=[c[0] for c in CASES])
-def test_op_oracle(name, pfn, nfn, shapes, check_grad):
+def test_op_oracle(name, pfn, nfn, shapes, check_grad, dtype):
     arrays = _inputs(shapes)
-    tensors = [paddle.to_tensor(a) for a in arrays]
+    tensors = [paddle.to_tensor(a).astype(dtype) for a in arrays]
     out = pfn(*tensors)
-    ref = nfn(*arrays)
-    np.testing.assert_allclose(np.asarray(out._value), ref,
-                               rtol=1e-4, atol=1e-5, err_msg=name)
+    ref = nfn(*[a.astype(np.float64) for a in arrays])
+    rtol, atol = _DTYPE_TOL[dtype]
+    np.testing.assert_allclose(
+        np.asarray(out._value, np.float64), ref,
+        rtol=rtol, atol=atol, err_msg=f"{name}[{dtype}]")
+    if dtype != "float32":
+        return  # finite differences only meaningful at fp32
     if not check_grad:
         return
     # analytic grad of sum(out) vs central finite differences on input 0

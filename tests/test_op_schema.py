@@ -1,0 +1,102 @@
+"""OpTest-style sweep over the single-source op schema.
+
+Reference: test/legacy_test/op_test.py (SURVEY.md §4 op-test row) — every op
+runs against its independent numpy oracle on every dtype in its matrix with
+per-dtype tolerances, plus a finite-difference gradient check (fp32).
+Adding an OpSpec in core/op_schema.py automatically adds these cases."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.op_schema import OPS
+
+
+def _cast_args(args, spec, dtype):
+    out = []
+    for i, a in enumerate(args):
+        if i in spec.integer_inputs or not np.issubdtype(
+                np.asarray(a).dtype, np.floating):
+            out.append(a)
+        else:
+            out.append(np.asarray(a).astype(dtype))
+    return out
+
+
+_CASES = [(name, dt) for name, spec in sorted(OPS.items())
+          for dt in spec.dtypes]
+
+
+@pytest.mark.parametrize("name,dtype", _CASES,
+                         ids=[f"{n}-{d}" for n, d in _CASES])
+def test_op_matches_oracle(name, dtype):
+    spec = OPS[name]
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    args, attrs = spec.sample(rng)
+    cast = _cast_args(args, spec, "float32" if dtype == "int32" else dtype)
+    fn = getattr(paddle, name)
+    got = fn(*[paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+               for a in cast], **attrs)
+    ref = spec.oracle(*[np.asarray(a, np.float64)
+                        if (isinstance(a, np.ndarray)
+                            and np.issubdtype(a.dtype, np.floating))
+                        else a for a in args], **attrs)
+    gots = got if isinstance(got, (tuple, list)) else (got,)
+    refs = ref if isinstance(ref, (tuple, list)) else (ref,)
+    tol = spec.tolerance(dtype)
+    for g, r in zip(gots, refs):
+        gv = np.asarray(g._value, np.float64) if hasattr(g, "_value") \
+            else np.asarray(g, np.float64)
+        np.testing.assert_allclose(gv, np.asarray(r, np.float64),
+                                   rtol=tol, atol=max(spec.atol, tol),
+                                   equal_nan=True)
+
+
+_GRAD_CASES = [name for name, spec in sorted(OPS.items()) if spec.grad]
+
+
+@pytest.mark.parametrize("name", _GRAD_CASES)
+def test_op_grad_finite_difference(name):
+    spec = OPS[name]
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    args, attrs = spec.sample(rng)
+    fn = getattr(paddle, name)
+    k = spec.grad_arg
+
+    tensors = [paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+               for a in args]
+    tensors[k].stop_gradient = False
+
+    def run(x):
+        t = list(tensors)
+        t[k] = x
+        out = fn(*t, **attrs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        s = None
+        for o in outs:
+            term = (o.astype("float32") * 1.0).sum()
+            s = term if s is None else s + term
+        return s
+
+    loss = run(tensors[k])
+    loss.backward()
+    analytic = np.asarray(tensors[k].grad._value, np.float64)
+
+    base = np.asarray(args[k], np.float64)
+    eps = 1e-3
+    flat = base.reshape(-1)
+    idxs = rng.choice(flat.size, size=min(6, flat.size), replace=False)
+    for i in idxs:
+        plus, minus = flat.copy(), flat.copy()
+        plus[i] += eps
+        minus[i] -= eps
+        fp = float(run(paddle.to_tensor(
+            plus.reshape(base.shape).astype(np.float32)))._value)
+        fm = float(run(paddle.to_tensor(
+            minus.reshape(base.shape).astype(np.float32)))._value)
+        fd = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic.reshape(-1)[i], fd,
+                                   rtol=5e-2, atol=5e-3,
+                                   err_msg=f"{name} grad at flat index {i}")

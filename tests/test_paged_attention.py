@@ -7,6 +7,8 @@ import pytest
 
 from paddle_tpu.ops import paged_attention as pa
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 def _dense_attention(q, k, v, seq_len):
     # q: (nh, d); k/v: (S, nkv, d) valid to seq_len

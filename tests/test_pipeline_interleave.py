@@ -2,6 +2,7 @@
 gradients (SURVEY.md §2.4 PP row / §7 hard part #1)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -11,6 +12,8 @@ from paddle_tpu.parallel import mesh as pmesh
 from paddle_tpu.parallel.pipeline import (
     interleave_chunk_order, pipeline_spmd_interleaved, pipeline_spmd,
 )
+
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
 S, V, H, M = 4, 2, 8, 8  # stages, chunks/stage, width, microbatches
 

@@ -12,6 +12,8 @@ from paddle_tpu.quantization import (
     weight_only_linear, weight_quantize,
 )
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 def test_int8_roundtrip_error():
     rng = np.random.RandomState(0)

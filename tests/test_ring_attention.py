@@ -15,6 +15,8 @@ from paddle_tpu.ops import flash_attention as fa
 from paddle_tpu.ops import ring_attention as ra
 from paddle_tpu.parallel import mesh as pmesh
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def reset_mesh():

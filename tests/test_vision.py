@@ -12,6 +12,8 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import transforms, datasets, models
 from paddle_tpu.vision.transforms import functional as F
 
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
 
 # --------------------------------------------------------------------- models
 def test_resnet18_forward_and_grad():

@@ -1,10 +1,14 @@
 """ViT on fused blocks: shapes, training, feature extraction."""
 
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import optimizer
 from paddle_tpu.vision.models import vit_tiny_test, VisionTransformer
+
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
 
 def test_forward_shapes():
