@@ -17,25 +17,64 @@ import jax
 _initialized = [False]
 
 
-def init_parallel_env(strategy=None) -> "ParallelEnv":
+def coordinator_address() -> str:
+    """Resolve the coordination-service address the way the reference
+    resolves its TCPStore master (SURVEY §3.1): explicit PADDLE_MASTER wins;
+    else the FIRST entry of PADDLE_TRAINER_ENDPOINTS (the launcher deploys
+    rank 0 there — reference launch env contract); else MASTER_ADDR/PORT."""
+    master = os.environ.get("PADDLE_MASTER")
+    if master:
+        return master
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if endpoints:
+        first = endpoints.split(",")[0].strip()
+        if first:
+            return first
+    return (os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" +
+            os.environ.get("MASTER_PORT", "8639"))
+
+
+def init_parallel_env(strategy=None, timeout_s: Optional[int] = None
+                      ) -> "ParallelEnv":
     """Parity with paddle.distributed.init_parallel_env.
 
     Single-host: no-op beyond device discovery. Multi-host (launcher sets
-    PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_MASTER): initialises the jax
-    coordination service.
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS or
+    PADDLE_MASTER): initialises the jax coordination service — the
+    TCPStore + NCCL-id rendezvous of the reference collapsed into one
+    barrier'd bring-up. ``jax.distributed.initialize`` blocks until all
+    ``nprocs`` processes connect, so returning means the mesh of every
+    host's devices is visible via jax.devices().
     """
     if _initialized[0]:
         return ParallelEnv()
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if nprocs > 1 and jax.process_count() == 1:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        master = os.environ.get("PADDLE_MASTER") or \
-            os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" + \
-            os.environ.get("MASTER_PORT", "8639")
-        jax.distributed.initialize(coordinator_address=master,
-                                   num_processes=nprocs, process_id=rank)
+        master = coordinator_address()
+        kwargs = {}
+        if timeout_s is not None:
+            kwargs["initialization_timeout"] = timeout_s
+        local = os.environ.get("PADDLE_LOCAL_DEVICE_IDS")
+        if local:
+            kwargs["local_device_ids"] = [int(x) for x in local.split(",")
+                                          if x]
+        try:
+            jax.distributed.initialize(coordinator_address=master,
+                                       num_processes=nprocs,
+                                       process_id=rank, **kwargs)
+        except Exception as e:
+            raise RuntimeError(
+                f"multi-host bring-up failed: rank {rank}/{nprocs} could "
+                f"not reach coordinator {master!r} "
+                f"(PADDLE_MASTER/PADDLE_TRAINER_ENDPOINTS). "
+                f"Original error: {type(e).__name__}: {e}") from e
     _initialized[0] = True
     return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
 
 
 def get_rank() -> int:

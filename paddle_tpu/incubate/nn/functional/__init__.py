@@ -39,3 +39,140 @@ def fused_rms_norm(x, weight, epsilon=1e-6):
     in ops/rms_norm.py)."""
     return apply(lambda xv, wv: rms_norm_array(xv, wv, epsilon), x, weight,
                  op_name="fused_rms_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding:§0 —
+    reference argument ORDER is (q, k, v, sin, cos, position_ids,
+    use_neox_rotary_style); returns a (q, k, v) tuple with None for absent
+    inputs. Only the neox (rotate-half) style is implemented; the GPT-J
+    interleaved style raises rather than rotating wrongly."""
+    if not use_neox_rotary_style:
+        raise NotImplementedError(
+            "use_neox_rotary_style=False (GPT-J interleaved rotation) is "
+            "not implemented; only the rotate-half (neox) style is")
+    if sin is None or cos is None:
+        raise ValueError("sin and cos caches are required")
+    from ....core.tensor import Tensor
+    from ....ops import rope as _rope
+
+    cos_v = cos._value if isinstance(cos, Tensor) else cos
+    sin_v = sin._value if isinstance(sin, Tensor) else sin
+    if cos_v.ndim == 4:  # paddle caches are (1, S, 1, D)
+        cos_v = cos_v[0, :, 0, :]
+        sin_v = sin_v[0, :, 0, :]
+    if position_ids is not None:
+        pid = position_ids._value if isinstance(position_ids, Tensor) \
+            else position_ids
+        cos_v = cos_v[pid]  # (B, S, D)
+        sin_v = sin_v[pid]
+
+    def rot(t):
+        if t is None:
+            return None
+        # rotate a single tensor by pairing it with itself and keeping q_out
+        return apply(lambda a: _rope.apply_rope_array(a, a, cos_v, sin_v)[0],
+                     t, op_name="fused_rope")
+
+    return rot(q), rot(k), rot(v)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """Reference CUDA fused epilogue (paddle/fluid/operators/fused/
+    fused_bias_dropout_residual_layer_norm_op.cu:§0); kwarg names and the
+    dropout_rate=0.5 default follow the reference API. On TPU this is one
+    jitted expression — XLA fuses the chain; the LN numerics are the shared
+    fp32-accumulated ``layer_norm_array`` (SURVEY §2.2 'other fused family'
+    row)."""
+    import jax
+    import jax.numpy as jnp
+    from .... import random as _random
+
+    drop = dropout_rate if training else 0.0
+    key = _random.next_key() if drop > 0.0 else None
+    tensors = [t for t in (x, residual, bias, ln_scale, ln_bias)
+               if t is not None]
+    has = [t is not None for t in (bias, ln_scale, ln_bias)]
+
+    def fn(xv, rv, *rest):
+        it = iter(rest)
+        b = next(it) if has[0] else None
+        g = next(it) if has[1] else None
+        be = next(it) if has[2] else None
+        y = xv if b is None else xv + b
+        if drop > 0.0:
+            keep = jax.random.bernoulli(key, 1.0 - drop, y.shape)
+            y = jnp.where(keep, y / (1.0 - drop), 0.0)
+        return ftb.layer_norm_array(y + rv, g, be, ln_epsilon)
+
+    return apply(fn, *tensors, op_name="fused_bias_dropout_residual_ln")
+
+
+def _swap_last2(a):
+    import jax.numpy as jnp
+    return jnp.swapaxes(a, -1, -2)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """paddle.incubate.nn.functional.fused_linear:§0 (cublasLt gemm epilogue
+    → one XLA dot+add, MXU-fused). transpose swaps the LAST TWO dims
+    (paddle semantics), so batched weights work."""
+    import jax.numpy as jnp
+
+    def fn(xv, wv, *rest):
+        w = _swap_last2(wv) if transpose_weight else wv
+        y = jnp.matmul(xv, w)
+        return y + rest[0] if rest else y
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(fn, *args, op_name="fused_linear")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """paddle.incubate.nn.functional.fused_matmul_bias:§0. Transposes swap
+    the last two dims only (paddle matmul semantics for batched inputs)."""
+    import jax.numpy as jnp
+
+    def fn(xv, yv, *rest):
+        a = _swap_last2(xv) if transpose_x else xv
+        b = _swap_last2(yv) if transpose_y else yv
+        out = jnp.matmul(a, b)
+        return out + rest[0] if rest else out
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return apply(fn, *args, op_name="fused_matmul_bias")
+
+
+def fused_softmax_mask(x, mask, scale=1.0):
+    """Reference fused_softmax_mask CUDA kernel:§0 — scale, add mask,
+    softmax in fp32, one fused XLA expression."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv, mv):
+        s = xv.astype(jnp.float32) * scale + mv.astype(jnp.float32)
+        return jax.nn.softmax(s, axis=-1).astype(xv.dtype)
+
+    return apply(fn, x, mask, op_name="fused_softmax_mask")
+
+
+def fused_softmax_mask_upper_triangle(x, scale=1.0):
+    """Reference fused_softmax_mask_upper_triangle:§0 — causal-masked
+    softmax without materialising the mask input."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv):
+        sq, sk = xv.shape[-2], xv.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(causal, xv.astype(jnp.float32) * scale, -1e30)
+        return jax.nn.softmax(s, axis=-1).astype(xv.dtype)
+
+    return apply(fn, x, op_name="fused_softmax_mask_upper_triangle")

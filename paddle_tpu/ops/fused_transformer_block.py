@@ -41,14 +41,18 @@ _ACTS = {
 }
 
 
-def layer_norm_array(x, scale, bias, eps=1e-5):
+def layer_norm_array(x, scale=None, bias=None, eps=1e-5):
     """fp32-accumulated LayerNorm (fused by XLA; parity with the reference's
-    in-kernel LN in fused_multi_transformer_op.cu.h:§0)."""
+    in-kernel LN in fused_multi_transformer_op.cu.h:§0). scale/bias optional
+    so fused epilogues (bias_dropout_residual_ln) share ONE LN numerics."""
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
     y = (xf - mu) * lax.rsqrt(var + eps)
-    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
     return y.astype(x.dtype)
 
 

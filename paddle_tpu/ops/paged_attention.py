@@ -199,9 +199,14 @@ def _paged_decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         k = k_ref[0].astype(jnp.float32)            # (page, nkv, d)
         v = v_ref[0].astype(jnp.float32)
         qg = q.reshape(nkv, rep, d)
-        # (nkv, rep, d) x (page, nkv, d) -> (nkv, rep, page)
+        # Mosaic's batched matmul requires the batch dim LEADING on both
+        # operands ("batch dims must be equal" otherwise — round-2 chip
+        # finding), so bring kv heads to the front first.
+        kt = k.swapaxes(0, 1)                       # (nkv, page, d)
+        vt = v.swapaxes(0, 1)                       # (nkv, page, d)
+        # (nkv, rep, d) x (nkv, page, d) -> (nkv, rep, page)
         s = jax.lax.dot_general(
-            qg, k, (((2,), (2,)), ((0,), (1,))),
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale
         pos = j * page + jax.lax.broadcasted_iota(
             jnp.int32, (nkv, rep, page), 2)
@@ -216,9 +221,9 @@ def _paged_decode_kernel(block_tables_ref, seq_lens_ref, q_ref, k_ref, v_ref,
         l_ref[...] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
         pg = p.reshape(nkv, rep, page)
-        # (nkv, rep, page) x (page, nkv, d) -> (nkv, rep, d)
+        # (nkv, rep, page) x (nkv, page, d) -> (nkv, rep, d)
         pv = jax.lax.dot_general(
-            pg, v, (((2,), (0,)), ((0,), (1,))),
+            pg, vt, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         acc_ref[...] = acc_ref[...] * alpha + pv.reshape(nh, d)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)

@@ -67,16 +67,23 @@ def _bwd_kernel(x_ref, w_ref, inv_ref, g_ref, dx_ref, dw_ref):
     dw_ref[...] += jnp.sum(g * x * inv, axis=0, keepdims=True)
 
 
-def _pick_block_rows(rows: int) -> int:
+def _pick_block_rows(rows: int, h: int = 128) -> int:
+    """Largest row block dividing ``rows`` whose bwd working set fits VMEM.
+
+    The bwd kernel holds ~6 (br, h) fp32 buffers (x, w·g, dx, g, intermediates)
+    in the ~16MB VMEM; budget 12MB with a 2x safety margin → br·h·32B cap.
+    (Round-2 fix: br=256 at h=4096 hit 'Ran out of memory in memory space
+    vmem ... 18.16M > 16.00M' on the real chip.)"""
+    budget = 12 * 1024 * 1024
     for br in (256, 128, 64, 32, 16, 8):
-        if rows % br == 0:
+        if rows % br == 0 and br * h * 32 <= budget:
             return br
     return 0
 
 
 def _pallas_fwd(x2, w, eps, interpret=False):
     rows, h = x2.shape
-    br = _pick_block_rows(rows)
+    br = _pick_block_rows(rows, h)
     grid = (rows // br,)
     y, inv = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -100,7 +107,7 @@ def _pallas_fwd(x2, w, eps, interpret=False):
 
 def _pallas_bwd(x2, w, inv, g2, interpret=False):
     rows, h = x2.shape
-    br = _pick_block_rows(rows)
+    br = _pick_block_rows(rows, h)
     nb = rows // br
     dx, dw_part = pl.pallas_call(
         _bwd_kernel,
@@ -138,7 +145,7 @@ def _rms_fwd(x, w, eps):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    if use_pallas() and h % 128 == 0 and _pick_block_rows(rows):
+    if use_pallas() and h % 128 == 0 and _pick_block_rows(rows, h):
         x2 = x.reshape(rows, h)
         y, inv = _pallas_fwd(x2, w, eps)
         return y.reshape(x.shape), (x, w, inv)
