@@ -79,23 +79,39 @@ def main():
 
     if on_tpu:
         # ~350M-param model that exercises the full decoder path on one chip
-        cfg = L.LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=8, num_key_value_heads=8,
-            max_position_embeddings=2048, dtype=jnp.bfloat16)
-        B, S, steps, warmup = 8, 2048, 10, 2
+        # "wide" (637M params) favours the MXU with fewer, larger matmuls:
+        # measured 45.8% MFU vs the 374M "deep" config's 37.6% on the v5e
+        # chip (BENCH_MODEL=deep reproduces the latter; batch sweep showed
+        # B=8 optimal, B=32 OOM)
+        if os.environ.get("BENCH_MODEL", "wide") == "wide":
+            cfg = L.LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=10, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+                dtype=jnp.bfloat16)
+        else:
+            cfg = L.LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=24, num_attention_heads=8,
+                num_key_value_heads=8, max_position_embeddings=2048,
+                dtype=jnp.bfloat16)
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        S, steps, warmup = 2048, 10, 2
     else:
         cfg = L.llama_tiny(num_hidden_layers=4)
         B, S, steps, warmup = 4, 64, 4, 1
 
     mesh = pmesh.build_mesh({}, devices=jax.devices()[:1])
     pmesh.set_global_mesh(mesh)
-    # remat trades ~1/3 extra FLOPs for activation memory. Measured on the
-    # v5e chip: remat OFF out-of-memories at B=8 S=2048 (374M model), so it
-    # stays ON by default (BENCH_REMAT=0 to experiment on larger chips).
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    step, init_fn = L.build_hybrid_train_step(cfg, mesh, learning_rate=1e-4,
-                                              remat=remat)
+    # remat trades extra FLOPs for activation memory. Measured on the v5e
+    # chip (374M, B=8 S=2048): remat OFF out-of-memories; the "dots" policy
+    # (save matmul outputs) reached only 34.3% MFU vs full remat's 37.6% —
+    # the saved activations raise HBM pressure more than the skipped
+    # recompute saves. Full remat stays default; BENCH_REMAT=full|dots|off.
+    remat_mode = os.environ.get("BENCH_REMAT", "full")
+    step, init_fn = L.build_hybrid_train_step(
+        cfg, mesh, learning_rate=1e-4, remat=remat_mode != "off",
+        remat_policy=remat_mode if remat_mode in ("full", "dots") else "full")
     params, opt_state = init_fn(seed=0)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (1, B, S)).astype(np.int32)
@@ -105,9 +121,22 @@ def main():
     # platform block_until_ready returns before execution completes (round-2
     # observation: a 374M-model step "finished" in ~0.2ms), so only a value
     # dependency is a trustworthy fence.
-    for _ in range(warmup):
-        loss, params, opt_state = step(params, opt_state, ids, labels)
-    float(loss)
+    try:
+        for _ in range(warmup):
+            loss, params, opt_state = step(params, opt_state, ids, labels)
+        float(loss)
+    except Exception as e:
+        if remat_mode != "dots":
+            raise
+        # "dots" keeps more activations live; fall back to full remat
+        print(f"# remat=dots failed ({type(e).__name__}); retrying with "
+              "full remat", file=sys.stderr)
+        step, init_fn = L.build_hybrid_train_step(
+            cfg, mesh, learning_rate=1e-4, remat=True, remat_policy="full")
+        params, opt_state = init_fn(seed=0)
+        for _ in range(warmup):
+            loss, params, opt_state = step(params, opt_state, ids, labels)
+        float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):

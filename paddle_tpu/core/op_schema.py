@@ -596,6 +596,114 @@ def _np_pixel_unshuffle(x, r):
     return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
 
 
+# --- complex ops (FFT companions) -------------------------------------------
+def _complex_sample(rng):
+    z = (rng.randn(6) + 1j * rng.randn(6)).astype(np.complex64)
+    return (z,), {}
+
+
+for _name, _jf, _nf in [
+    ("angle", jnp.angle, np.angle),
+    ("conj", jnp.conj, np.conj),
+    ("real", jnp.real, np.real),
+    ("imag", jnp.imag, np.imag),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_complex_sample,
+                    dtypes=("complex64",), integer_inputs=(0,), grad=False))
+
+
+# --- more special functions -------------------------------------------------
+register(OpSpec(
+    name="i0e",
+    fn=lambda x: jax.scipy.special.i0e(x),
+    oracle=lambda x: np.i0(x) * np.exp(-np.abs(x)),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="i1",
+    fn=lambda x: jax.scipy.special.i1(x),
+    oracle=lambda x: _np_i1(x),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+
+def _np_i1(x):
+    # series-free oracle via numpy's i0 derivative relation is unavailable;
+    # use the scipy-compatible polynomial from Abramowitz–Stegun 9.8
+    x = np.asarray(x, np.float64)
+    ax = np.abs(x)
+    small = ax < 3.75
+    t = (x / 3.75) ** 2
+    ser = x * (0.5 + t * (0.87890594 + t * (0.51498869 + t * (
+        0.15084934 + t * (0.02658733 + t * (0.00301532 + t * 0.00032411))))))
+    t2 = 3.75 / np.maximum(ax, 1e-12)
+    big = (np.exp(ax) / np.sqrt(np.maximum(ax, 1e-12))) * (
+        0.39894228 + t2 * (-0.03988024 + t2 * (-0.00362018 + t2 * (
+            0.00163801 + t2 * (-0.01031555 + t2 * (0.02282967 + t2 * (
+                -0.02895312 + t2 * (0.01787654 - t2 * 0.00420059))))))))
+    return np.where(small, ser, np.sign(x) * big)
+
+
+register(OpSpec(
+    name="polygamma",
+    fn=lambda x, n=1: jax.scipy.special.polygamma(n, x),
+    oracle=lambda x, n=1: _np_polygamma(n, x),
+    sample=lambda rng: ((rng.rand(6).astype(np.float32) * 3 + 0.5,),
+                        {"n": 1}),
+    dtypes=("float32",),
+    tol={"float32": 1e-3},
+    grad=False,
+))
+
+
+def _np_polygamma(n, x):
+    # trigamma via finite difference of lgamma'Â ≈ numeric derivative of
+    # digamma (central, h=1e-4) — an independent oracle for n=1
+    h = 1e-4
+    from math import lgamma
+
+    def digamma(v):
+        return (lgamma(v + h) - lgamma(v - h)) / (2 * h)
+
+    flat = np.asarray(x, np.float64).reshape(-1)
+    out = np.array([(digamma(v + h) - digamma(v - h)) / (2 * h)
+                    for v in flat])
+    return out.reshape(np.shape(x))
+
+
+register(OpSpec(
+    name="combinations",
+    fn=lambda x, r=2, with_replacement=False: _jax_combinations(
+        x, r, with_replacement),
+    oracle=lambda x, r=2, with_replacement=False: _np_combinations(
+        x, r, with_replacement),
+    sample=lambda rng: ((rng.randn(5).astype(np.float32),), {"r": 2}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+
+def _jax_combinations(x, r, with_replacement):
+    import itertools
+    n = x.shape[0]
+    idx = list(itertools.combinations_with_replacement(range(n), r)
+               if with_replacement else itertools.combinations(range(n), r))
+    return x[jnp.asarray(idx, jnp.int32)]
+
+
+def _np_combinations(x, r, with_replacement):
+    import itertools
+    n = x.shape[0]
+    idx = list(itertools.combinations_with_replacement(range(n), r)
+               if with_replacement else itertools.combinations(range(n), r))
+    return x[np.asarray(idx)]
+
+
 register(OpSpec(
     name="channel_shuffle",
     fn=lambda x, groups, data_format="NCHW": x.reshape(

@@ -69,14 +69,22 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         cos_v = cos_v[pid]  # (B, S, D)
         sin_v = sin_v[pid]
 
-    def rot(t):
+    def rot_pair(a, b):  # one dispatch + one tape record for the pair
+        return apply(lambda av, bv: _rope.apply_rope_array(av, bv, cos_v,
+                                                           sin_v),
+                     a, b, op_name="fused_rope")
+
+    def rot_one(t):
         if t is None:
             return None
-        # rotate a single tensor by pairing it with itself and keeping q_out
-        return apply(lambda a: _rope.apply_rope_array(a, a, cos_v, sin_v)[0],
+        return apply(lambda av: _rope.apply_rope_array(av, av, cos_v,
+                                                       sin_v)[0],
                      t, op_name="fused_rope")
 
-    return rot(q), rot(k), rot(v)
+    if q is not None and k is not None:
+        qo, ko = rot_pair(q, k)
+        return qo, ko, rot_one(v)
+    return rot_one(q), rot_one(k), rot_one(v)
 
 
 def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
@@ -108,7 +116,13 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
         y = xv if b is None else xv + b
         if drop > 0.0:
             keep = jax.random.bernoulli(key, 1.0 - drop, y.shape)
-            y = jnp.where(keep, y / (1.0 - drop), 0.0)
+            if mode == "downscale_in_infer":
+                y = jnp.where(keep, y, 0.0)  # no rescale in train
+            else:  # upscale_in_train
+                y = jnp.where(keep, y / (1.0 - drop), 0.0)
+        elif not training and dropout_rate > 0.0 and \
+                mode == "downscale_in_infer":
+            y = y * (1.0 - dropout_rate)
         return ftb.layer_norm_array(y + rv, g, be, ln_epsilon)
 
     return apply(fn, *tensors, op_name="fused_bias_dropout_residual_ln")
@@ -121,17 +135,9 @@ def _swap_last2(a):
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     """paddle.incubate.nn.functional.fused_linear:§0 (cublasLt gemm epilogue
-    → one XLA dot+add, MXU-fused). transpose swaps the LAST TWO dims
-    (paddle semantics), so batched weights work."""
-    import jax.numpy as jnp
-
-    def fn(xv, wv, *rest):
-        w = _swap_last2(wv) if transpose_weight else wv
-        y = jnp.matmul(xv, w)
-        return y + rest[0] if rest else y
-
-    args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(fn, *args, op_name="fused_linear")
+    → one XLA dot+add, MXU-fused). Same computation as fused_matmul_bias
+    with transpose_y."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,

@@ -353,7 +353,8 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                             learning_rate: float = 1e-3,
                             remat: bool = True,
                             seq_shard: bool = False,
-                            virtual_pp: int = 1):
+                            virtual_pp: int = 1,
+                            remat_policy: str = "full"):
     """Returns (step_fn, init_fn).
 
     step_fn(params, opt_state, batch_ids, batch_labels) ->
@@ -442,7 +443,14 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                                        mp_axis=mp_axis, fsdp_axis=fsdp_axis,
                                        sep_axis=sep_axis)
                 if remat:
-                    fn = jax.checkpoint(fn)
+                    if remat_policy == "dots":
+                        # save matmul outputs, recompute elementwise/norms:
+                        # backward skips the FLOP-heavy recompute of full
+                        # remat at a modest activation-memory cost
+                        fn = jax.checkpoint(
+                            fn, policy=jax.checkpoint_policies.dots_saveable)
+                    else:
+                        fn = jax.checkpoint(fn)
                 return fn(lp, carry, cos, sin), None
 
             layer_params = {k: sparams[k] for k in LAYER_KEYS}

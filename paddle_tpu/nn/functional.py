@@ -673,6 +673,115 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return apply(fn, _t(x), op_name="unfold")
 
 
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold (col2im): (N, C·kh·kw, L) -> (N, C, H, W), summing
+    overlapping patch contributions. Reference: paddle.nn.functional.fold
+    (phi fold kernel:§0). Scatter-add over patch positions — static shapes,
+    XLA-friendly."""
+    out_hw = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def fn(v):
+        n, ckk, l = v.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        assert oh * ow == l, (oh, ow, l)
+        v6 = v.reshape(n, c, k[0], k[1], oh, ow)
+        hp = out_hw[0] + 2 * p[0]
+        wp = out_hw[1] + 2 * p[1]
+        out = jnp.zeros((n, c, hp, wp), v.dtype)
+        # L is static and small relative to the image: unrolled scatter-adds
+        # fuse into one XLA scatter
+        for i in range(k[0]):
+            for j in range(k[1]):
+                rows = jnp.arange(oh) * s[0] + i * d[0]
+                cols = jnp.arange(ow) * s[1] + j * d[1]
+                out = out.at[:, :, rows[:, None], cols[None, :]].add(
+                    v6[:, :, i, j])
+        return out[:, :, p[0]:hp - p[0] if p[0] else hp,
+                   p[1]:wp - p[1] if p[1] else wp]
+
+    return apply(fn, _t(x), op_name="fold")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """paddle.nn.functional.affine_grid parity: theta (N, 2, 3) →
+    sampling grid (N, H, W, 2) in [-1, 1] coords."""
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def base(steps):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, steps)
+        half = 1.0 - 1.0 / steps
+        return jnp.linspace(-half, half, steps)
+
+    def fn(th):
+        ys = base(h)
+        xs = base(w)
+        gx, gy = jnp.meshgrid(xs, ys)           # (H, W)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], -1)  # (H, W, 3)
+        out = jnp.einsum("hwk,njk->nhwj", coords.astype(jnp.float32),
+                         th.astype(jnp.float32))
+        return out.astype(th.dtype)
+
+    return apply(fn, _t(theta), op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """paddle.nn.functional.grid_sample parity (NCHW): sample x at grid
+    locations in [-1, 1]. Reference: phi grid_sample kernel:§0 — here
+    gathers + lerp, which XLA fuses; differentiable through the tape."""
+
+    def fn(v, g):
+        nb, c, h, w = v.shape
+        gx = g[..., 0].astype(jnp.float32)
+        gy = g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        def gather(ix, iy):
+            inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            if padding_mode == "border":
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+                inside = jnp.ones_like(inside)
+            else:  # zeros
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+            vals = v[jnp.arange(nb)[:, None, None], :, iyc, ixc]
+            vals = jnp.moveaxis(vals, -1, 1)     # (N, C, Hg, Wg)
+            return vals * inside[:, None].astype(v.dtype)
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(v.dtype)[:, None]
+        wy = (fy - y0).astype(v.dtype)[:, None]
+        v00 = gather(x0, y0)
+        v01 = gather(x1, y0)
+        v10 = gather(x0, y1)
+        v11 = gather(x1, y1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+
+    return apply(fn, _t(x), _t(grid), op_name="grid_sample")
+
+
 def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     r = upscale_factor
 

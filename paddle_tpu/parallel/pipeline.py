@@ -172,6 +172,16 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
     Returns (mean_loss, grads) — loss valid on the last stage (broadcast it
     with :func:`last_stage_broadcast`), grads a pytree like stage_params
     (each stage's slice holds ∑_m of ITS stage's param grads, fp32).
+
+    On ZB-H1 (reference passes/pipeline_scheduler_pass.py:§0): zero-bubble
+    schedules split backward into dgrad (critical path) and wgrad (bubble
+    filler) so idle drain slots do weight-gradient work. In this ONE-program
+    systolic formulation every tick already issues the (masked) F and B
+    branches on every device — there is no per-stage idle compute to fill;
+    wall-clock is ticks x (F + vjp) regardless of where wgrad lands, so
+    ZB-H1 degenerates to the same cost as this 1F1B. It would pay only in a
+    per-stage-asynchronous (multi-executable) runtime, which trades away the
+    XLA-fused single program; deliberately out of scope.
     """
     S = lax.axis_size(axis_name)
     d = lax.axis_index(axis_name)

@@ -196,3 +196,56 @@ def test_dropout_downscale_in_infer():
     x = paddle.ones([10])
     y = F.dropout(x, p=0.4, training=False, mode="downscale_in_infer")
     np.testing.assert_allclose(y.numpy(), np.full(10, 0.6), rtol=1e-6)
+
+
+class TestFoldGridSample:
+    """fold / affine_grid / grid_sample (VERDICT op-family gaps)."""
+
+    def test_fold_inverts_unfold_nonoverlapping(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        cols = F.unfold(x, kernel_sizes=2, strides=2)
+        back = F.fold(cols, output_sizes=(8, 8), kernel_sizes=2, strides=2)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x._value), rtol=1e-6)
+
+    def test_fold_sums_overlaps(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        cols = F.unfold(x, kernel_sizes=3, strides=1)
+        out = np.asarray(F.fold(cols, output_sizes=(4, 4), kernel_sizes=3,
+                                strides=1)._value)
+        # center pixels belong to 4 patches, corners to 1
+        assert out[0, 0, 0, 0] == 1.0 and out[0, 0, 1, 1] == 4.0
+
+    def test_affine_grid_identity_and_grid_sample(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 3, 5, 7).astype(np.float32))
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+        grid = F.affine_grid(theta, (2, 3, 5, 7), align_corners=True)
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(x._value), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_grid_sample_nearest_and_zero_padding(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        # sample far outside -> zeros padding
+        grid = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, np.float32))
+        out = F.grid_sample(x, grid, mode="nearest", padding_mode="zeros")
+        np.testing.assert_allclose(np.asarray(out._value), 0.0)
+        # border padding clamps
+        outb = F.grid_sample(x, grid, mode="nearest", padding_mode="border")
+        np.testing.assert_allclose(np.asarray(outb._value), 15.0)
+
+    def test_grid_sample_grad_flows(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        theta = paddle.to_tensor(
+            np.array([[[0.9, 0.1, 0.0], [0.0, 1.1, 0.1]]], np.float32))
+        grid = F.affine_grid(theta, (1, 2, 6, 6))
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._value)).all()
