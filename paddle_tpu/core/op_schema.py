@@ -662,8 +662,9 @@ register(OpSpec(
 
 
 def _np_polygamma(n, x):
-    # trigamma via finite difference of lgamma'Â ≈ numeric derivative of
-    # digamma (central, h=1e-4) — an independent oracle for n=1
+    # trigamma via numeric second derivative of lgamma — an independent
+    # oracle implemented for n=1 ONLY
+    assert n == 1, "oracle implements trigamma (n=1) only"
     h = 1e-4
     from math import lgamma
 
@@ -697,11 +698,20 @@ def _jax_combinations(x, r, with_replacement):
 
 
 def _np_combinations(x, r, with_replacement):
-    import itertools
+    # independent oracle: recursive enumeration (NOT itertools, which the
+    # jax impl uses — a shared itertools misuse must not self-confirm)
     n = x.shape[0]
-    idx = list(itertools.combinations_with_replacement(range(n), r)
-               if with_replacement else itertools.combinations(range(n), r))
-    return x[np.asarray(idx)]
+    out = []
+
+    def rec(start, combo):
+        if len(combo) == r:
+            out.append([x[i] for i in combo])
+            return
+        for i in range(start, n):
+            rec(i if with_replacement else i + 1, combo + [i])
+
+    rec(0, [])
+    return np.asarray(out, x.dtype)
 
 
 register(OpSpec(

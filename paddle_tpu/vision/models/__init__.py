@@ -12,3 +12,6 @@ from .vit import (  # noqa: F401
     VisionTransformer, vit_base_patch16_224, vit_large_patch16_224,
     vit_tiny_test,
 )
+from .ppyoloe import (  # noqa: F401
+    PPYOLOE, ppyoloe_s, ppyoloe_m, ppyoloe_l,
+)

@@ -1,0 +1,336 @@
+"""PP-YOLOE — anchor-free detector (workload #5, BASELINE.md: "ViT-L +
+PP-YOLOE, conv/attn mix").
+
+Rebuild of the PaddleDetection PP-YOLOE family consumed through this
+framework (reference model zoo: ppdet/modeling/{backbones/cspresnet.py,
+necks/custom_pan.py, heads/ppyoloe_head.py}:§0 — external repo; the core
+framework supplies the conv/BN/pooling kernels, SURVEY.md §6 workload 5).
+
+TPU-first notes: everything is static-shape — the detector emits a FIXED
+set of per-level predictions (sum of H_i·W_i anchors); decode/NMS-style
+selection uses top-k over that static set, so the whole forward jits
+without dynamic shapes (the reference's CINN dynamic-shape story maps to
+shape-bucketing at the input instead).
+
+Components: CSPResNet backbone (ConvBN+SiLU, effective-SE), CSP-PAN neck,
+ET-head with distribution-focal (DFL) box regression, and a training loss
+(varifocal cls + DFL + IoU) under a static center-radius assigner.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...core.math_ops import concat
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, ch_in, ch_out, filter_size=3, stride=1, groups=1,
+                 padding=None, act="silu"):
+        super().__init__()
+        pad = (filter_size - 1) // 2 if padding is None else padding
+        self.conv = nn.Conv2D(ch_in, ch_out, filter_size, stride=stride,
+                              padding=pad, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(ch_out)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.silu(x) if self.act else x
+
+
+class EffectiveSELayer(nn.Layer):
+    """Effective squeeze-excite (CSPResNet's attention block)."""
+
+    def __init__(self, channels):
+        super().__init__()
+        self.fc = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x):
+        s = x.mean(axis=[2, 3], keepdim=True)
+        return x * F.hardsigmoid(self.fc(s))
+
+
+class CSPResBlock(nn.Layer):
+    def __init__(self, ch, shortcut=True):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch, 3)
+        self.conv2 = ConvBNLayer(ch, ch, 3)
+        self.shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(x))
+        return x + y if self.shortcut else y
+
+
+class CSPResStage(nn.Layer):
+    def __init__(self, ch_in, ch_out, n_blocks, stride=2, use_attn=True):
+        super().__init__()
+        self.down = ConvBNLayer(ch_in, ch_out, 3, stride=stride) \
+            if stride > 1 or ch_in != ch_out else None
+        mid = ch_out // 2
+        self.conv1 = ConvBNLayer(ch_out, mid, 1)
+        self.conv2 = ConvBNLayer(ch_out, mid, 1)
+        self.blocks = nn.Sequential(*[CSPResBlock(mid)
+                                      for _ in range(n_blocks)])
+        self.attn = EffectiveSELayer(ch_out) if use_attn else None
+        self.conv3 = ConvBNLayer(ch_out, ch_out, 1)
+
+    def forward(self, x):
+        if self.down is not None:
+            x = self.down(x)
+        y1 = self.conv1(x)
+        y2 = self.blocks(self.conv2(x))
+        y = concat([y1, y2], axis=1)
+        if self.attn is not None:
+            y = self.attn(y)
+        return self.conv3(y)
+
+
+class CSPResNet(nn.Layer):
+    """Backbone returning strides 8/16/32 feature maps."""
+
+    def __init__(self, width_mult=0.5, depth_mult=0.33):
+        super().__init__()
+        chs = [int(c * width_mult) for c in (64, 128, 256, 512, 1024)]
+        ns = [max(round(n * depth_mult), 1) for n in (3, 6, 6, 3)]
+        # stem stride 2; each stage halves again → stage outputs at strides
+        # 4, 8, 16, 32 (the last three feed the neck)
+        self.stem = nn.Sequential(
+            ConvBNLayer(3, chs[0] // 2, 3, stride=2),
+            ConvBNLayer(chs[0] // 2, chs[0], 3, stride=1))
+        self.stages = nn.LayerList([
+            CSPResStage(chs[0], chs[1], ns[0]),
+            CSPResStage(chs[1], chs[2], ns[1]),
+            CSPResStage(chs[2], chs[3], ns[2]),
+            CSPResStage(chs[3], chs[4], ns[3]),
+        ])
+        self.out_channels = [chs[2], chs[3], chs[4]]
+
+    def forward(self, x):
+        x = self.stem(x)
+        outs = []
+        for i, st in enumerate(self.stages):
+            x = st(x)
+            if i >= 1:
+                outs.append(x)
+        return outs  # strides 8, 16, 32
+
+
+class CSPPAN(nn.Layer):
+    """Compact CSP-PAN: top-down fusion then bottom-up aggregation."""
+
+    def __init__(self, in_channels: Sequence[int], out_ch=None):
+        super().__init__()
+        c3, c4, c5 = in_channels
+        o = out_ch or c3
+        self.reduce5 = ConvBNLayer(c5, o, 1)
+        self.reduce4 = ConvBNLayer(c4, o, 1)
+        self.reduce3 = ConvBNLayer(c3, o, 1)
+        self.td4 = CSPResStage(2 * o, o, 1, stride=1, use_attn=False)
+        self.td3 = CSPResStage(2 * o, o, 1, stride=1, use_attn=False)
+        self.down3 = ConvBNLayer(o, o, 3, stride=2)
+        self.bu4 = CSPResStage(2 * o, o, 1, stride=1, use_attn=False)
+        self.down4 = ConvBNLayer(o, o, 3, stride=2)
+        self.bu5 = CSPResStage(2 * o, o, 1, stride=1, use_attn=False)
+        self.out_channels = [o, o, o]
+
+    def forward(self, feats):
+        f3, f4, f5 = feats
+        p5 = self.reduce5(f5)
+        up5 = F.interpolate(p5, scale_factor=2, mode="nearest")
+        p4 = self.td4(concat([self.reduce4(f4), up5], axis=1))
+        up4 = F.interpolate(p4, scale_factor=2, mode="nearest")
+        p3 = self.td3(concat([self.reduce3(f3), up4], axis=1))
+        n4 = self.bu4(concat([self.down3(p3), p4], axis=1))
+        n5 = self.bu5(concat([self.down4(n4), p5], axis=1))
+        return [p3, n4, n5]
+
+
+class PPYOLOEHead(nn.Layer):
+    """ET-head: per-level cls + DFL box-distribution branches.
+
+    Emits (B, A, num_classes) scores and (B, A, 4) boxes (xyxy, input
+    pixels) over the STATIC anchor set A = Σ H_i·W_i.
+    """
+
+    def __init__(self, in_channels: Sequence[int], num_classes=80,
+                 reg_max=16, strides=(8, 16, 32)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.strides = list(strides)
+        self.stems_cls = nn.LayerList(
+            [ConvBNLayer(c, c, 3) for c in in_channels])
+        self.stems_reg = nn.LayerList(
+            [ConvBNLayer(c, c, 3) for c in in_channels])
+        self.pred_cls = nn.LayerList(
+            [nn.Conv2D(c, num_classes, 1) for c in in_channels])
+        self.pred_reg = nn.LayerList(
+            [nn.Conv2D(c, 4 * (reg_max + 1), 1) for c in in_channels])
+        proj = np.arange(reg_max + 1, dtype=np.float32)
+        self._proj = proj  # DFL expectation projection
+
+    def anchor_centers(self, shapes):
+        """Static per-level anchor centers in input pixels: (A, 2), plus
+        per-anchor stride (A,)."""
+        pts, sts = [], []
+        for (h, w), s in zip(shapes, self.strides):
+            ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+            c = np.stack([(xs + 0.5) * s, (ys + 0.5) * s], -1).reshape(-1, 2)
+            pts.append(c.astype(np.float32))
+            sts.append(np.full((h * w,), s, np.float32))
+        return np.concatenate(pts), np.concatenate(sts)
+
+    def forward(self, feats):
+        cls_list, reg_list, shapes = [], [], []
+        for i, f in enumerate(feats):
+            b, c, h, w = f.shape
+            shapes.append((h, w))
+            cl = self.pred_cls[i](self.stems_cls[i](f) + f)
+            rg = self.pred_reg[i](self.stems_reg[i](f))
+            cls_list.append(cl.reshape([b, self.num_classes, h * w]))
+            reg_list.append(rg.reshape([b, 4 * (self.reg_max + 1), h * w]))
+        cls = concat(cls_list, axis=-1).transpose([0, 2, 1])  # (B, A, C)
+        reg = concat(reg_list, axis=-1).transpose([0, 2, 1])  # (B, A, 4*(m+1))
+        return cls, reg, shapes
+
+    def decode(self, cls, reg, shapes):
+        """(scores (B,A,C) sigmoid, boxes (B,A,4) xyxy pixels)."""
+        centers, strides = self.anchor_centers(shapes)
+        m = self.reg_max
+        proj = self._proj
+
+        def fn(clv, rgv):
+            b, a, _ = rgv.shape
+            dist = jax.nn.softmax(
+                rgv.reshape(b, a, 4, m + 1).astype(jnp.float32), axis=-1)
+            d = jnp.einsum("bakm,m->bak", dist, jnp.asarray(proj))
+            d = d * strides[None, :, None]
+            cx, cy = centers[:, 0], centers[:, 1]
+            x1 = cx[None] - d[..., 0]
+            y1 = cy[None] - d[..., 1]
+            x2 = cx[None] + d[..., 2]
+            y2 = cy[None] + d[..., 3]
+            boxes = jnp.stack([x1, y1, x2, y2], -1)
+            return jax.nn.sigmoid(clv.astype(jnp.float32)), boxes
+
+        return apply(fn, cls, reg, op_name="ppyoloe_decode", n_outputs=2)
+
+
+class PPYOLOE(nn.Layer):
+    """Backbone + neck + head. ``forward(images)`` → (scores, boxes) on the
+    static anchor set; ``compute_loss`` trains with varifocal + DFL + IoU
+    under a center-radius assigner (static shapes throughout)."""
+
+    def __init__(self, num_classes=80, width_mult=0.5, depth_mult=0.33):
+        super().__init__()
+        self.backbone = CSPResNet(width_mult, depth_mult)
+        self.neck = CSPPAN(self.backbone.out_channels)
+        self.head = PPYOLOEHead(self.neck.out_channels, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        cls, reg, shapes = self.head(self.neck(self.backbone(images)))
+        return self.head.decode(cls, reg, shapes)
+
+    def predict(self, images, score_threshold=0.25, top_k=100):
+        """Static-shape selection: top_k anchors by best class score."""
+        scores, boxes = self(images)
+
+        def fn(sv, bv):
+            best = jnp.max(sv, axis=-1)                     # (B, A)
+            label = jnp.argmax(sv, axis=-1)
+            val, idx = jax.lax.top_k(best, top_k)
+            sel = jnp.take_along_axis(bv, idx[..., None], axis=1)
+            lab = jnp.take_along_axis(label, idx, axis=1)
+            keep = val >= score_threshold
+            return val, sel, lab.astype(jnp.int32), keep
+
+        return apply(fn, scores, boxes, op_name="ppyoloe_predict",
+                     n_outputs=4)
+
+    def compute_loss(self, images, gt_boxes, gt_labels, radius=2.5):
+        """gt_boxes (B, G, 4) xyxy pixels (pad: zeros), gt_labels (B, G)
+        int (-1 = pad). Center-radius assignment: an anchor is positive for
+        the first gt whose center is within radius·stride."""
+        cls, reg, shapes = self.head(self.neck(self.backbone(images)))
+        centers, strides = self.head.anchor_centers(shapes)
+        m = self.head.reg_max
+        C = self.num_classes
+        proj = self.head._proj
+
+        def fn(clv, rgv, gb, gl):
+            b, a, _ = clv.shape
+            g = gb.shape[1]
+            cx = (gb[..., 0] + gb[..., 2]) / 2                 # (B, G)
+            cy = (gb[..., 1] + gb[..., 3]) / 2
+            valid_gt = gl >= 0
+            dx = jnp.abs(centers[None, :, 0, None] - cx[:, None, :])
+            dy = jnp.abs(centers[None, :, 1, None] - cy[:, None, :])
+            rad = radius * strides[None, :, None]
+            near = (dx < rad) & (dy < rad) & valid_gt[:, None, :]  # (B,A,G)
+            assigned = jnp.argmax(near, axis=-1)               # first match
+            pos = jnp.any(near, axis=-1)                       # (B, A)
+            tgt_box = jnp.take_along_axis(gb, assigned[..., None], axis=1)
+            tgt_lab = jnp.take_along_axis(gl, assigned, axis=1)
+
+            # --- cls: varifocal-style BCE with IoU-free quality target ---
+            onehot = jax.nn.one_hot(jnp.where(pos, tgt_lab, 0), C)
+            tgt = onehot * pos[..., None]
+            logits = clv.astype(jnp.float32)
+            p = jax.nn.sigmoid(logits)
+            weight = jnp.where(tgt > 0, tgt, 0.75 * p ** 2)
+            bce = -(tgt * jnp.log(jnp.clip(p, 1e-7, 1.0)) +
+                    (1 - tgt) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0)))
+            n_pos = jnp.maximum(jnp.sum(pos), 1.0)
+            loss_cls = jnp.sum(weight * bce) / n_pos
+
+            # --- box: DFL + L1 on positive anchors -----------------------
+            lt = jnp.stack([centers[None, :, 0] - tgt_box[..., 0],
+                            centers[None, :, 1] - tgt_box[..., 1]], -1)
+            rb = jnp.stack([tgt_box[..., 2] - centers[None, :, 0],
+                            tgt_box[..., 3] - centers[None, :, 1]], -1)
+            dist_t = jnp.concatenate([lt, rb], -1) / strides[None, :, None]
+            dist_t = jnp.clip(dist_t, 0, m - 0.01)             # (B, A, 4)
+            dl = jnp.floor(dist_t)
+            wr = dist_t - dl
+            dl = dl.astype(jnp.int32)
+            logd = jax.nn.log_softmax(
+                rgv.reshape(b, a, 4, m + 1).astype(jnp.float32), axis=-1)
+            pick = lambda idx: jnp.take_along_axis(  # noqa: E731
+                logd, idx[..., None], axis=-1)[..., 0]
+            dfl = -(pick(dl) * (1 - wr) + pick(dl + 1) * wr)
+            dist_p = jnp.einsum("bakm,m->bak",
+                                jnp.exp(logd), jnp.asarray(proj))
+            l1 = jnp.abs(dist_p - dist_t)
+            loss_box = jnp.sum((dfl + l1).mean(-1) * pos) / n_pos
+            return loss_cls + 0.5 * loss_box
+
+        return apply(fn, cls, reg,
+                     gt_boxes if isinstance(gt_boxes, Tensor)
+                     else Tensor(jnp.asarray(gt_boxes)),
+                     gt_labels if isinstance(gt_labels, Tensor)
+                     else Tensor(jnp.asarray(gt_labels)),
+                     op_name="ppyoloe_loss")
+
+
+def ppyoloe_s(num_classes=80, **kw):
+    return PPYOLOE(num_classes, width_mult=0.5, depth_mult=0.33, **kw)
+
+
+def ppyoloe_m(num_classes=80, **kw):
+    return PPYOLOE(num_classes, width_mult=0.75, depth_mult=0.67, **kw)
+
+
+def ppyoloe_l(num_classes=80, **kw):
+    return PPYOLOE(num_classes, width_mult=1.0, depth_mult=1.0, **kw)

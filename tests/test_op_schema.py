@@ -47,9 +47,13 @@ def test_op_matches_oracle(name, dtype):
     refs = ref if isinstance(ref, (tuple, list)) else (ref,)
     tol = spec.tolerance(dtype)
     for g, r in zip(gots, refs):
-        gv = np.asarray(g._value, np.float64) if hasattr(g, "_value") \
-            else np.asarray(g, np.float64)
-        np.testing.assert_allclose(gv, np.asarray(r, np.float64),
+        gv = np.asarray(g._value) if hasattr(g, "_value") else np.asarray(g)
+        rv = np.asarray(r)
+        # complex results compare as complex (a float64 cast would discard
+        # the imaginary part and let a wrong conj pass)
+        cast = np.complex128 if (np.iscomplexobj(gv) or np.iscomplexobj(rv)) \
+            else np.float64
+        np.testing.assert_allclose(gv.astype(cast), rv.astype(cast),
                                    rtol=tol, atol=max(spec.atol, tol),
                                    equal_nan=True)
 

@@ -1,0 +1,64 @@
+"""PP-YOLOE detector (workload #5): static-shape forward/decode/predict and
+a training step that reduces the detection loss."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.vision.models.ppyoloe import PPYOLOE, ppyoloe_s
+
+pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
+
+
+def _model():
+    paddle.seed(0)
+    return PPYOLOE(num_classes=4, width_mult=0.25, depth_mult=0.33)
+
+
+def test_forward_static_anchor_set():
+    net = _model()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 64, 64)
+                         .astype(np.float32))
+    scores, boxes = net(x)
+    # strides 8/16/32 on 64x64 -> 64 + 16 + 4 = 84 anchors
+    assert tuple(scores.shape) == (2, 84, 4)
+    assert tuple(boxes.shape) == (2, 84, 4)
+    s = np.asarray(scores._value)
+    b = np.asarray(boxes._value)
+    assert (s >= 0).all() and (s <= 1).all()
+    assert np.isfinite(b).all()
+    # decoded boxes are ordered (x2 >= x1, y2 >= y1): distances are
+    # softmax-expected, hence non-negative
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+
+
+def test_predict_topk_static():
+    net = _model()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 64, 64)
+                         .astype(np.float32))
+    val, boxes, labels, keep = net.predict(x, score_threshold=0.0, top_k=10)
+    assert tuple(val.shape) == (1, 10)
+    assert tuple(boxes.shape) == (1, 10, 4)
+    assert tuple(labels.shape) == (1, 10)
+    v = np.asarray(val._value)[0]
+    assert (np.diff(v) <= 1e-6).all()  # sorted descending
+
+
+def test_train_step_reduces_loss():
+    net = _model()
+    opt = optimizer.AdamW(learning_rate=2e-3, parameters=net.parameters())
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(2, 3, 64, 64).astype(np.float32))
+    gt_boxes = paddle.to_tensor(np.asarray(
+        [[[8, 8, 40, 40], [24, 24, 60, 60]],
+         [[4, 4, 32, 32], [0, 0, 0, 0]]], np.float32))
+    gt_labels = paddle.to_tensor(np.asarray([[1, 3], [2, -1]], np.int32))
+
+    def loss_fn(model, img, gb, gl):
+        return model.compute_loss(img, gb, gl)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    losses = [float(step(x, gt_boxes, gt_labels)) for _ in range(8)]
+    assert all(np.isfinite(v) for v in losses), losses
+    assert losses[-1] < losses[0], losses
