@@ -23,6 +23,11 @@ from .core.dtype import (  # noqa: F401
 )
 from .core.math_ops import *  # noqa: F401,F403
 from .core.math_ops import sum, max, min, abs, all, any, pow, round  # noqa: F401
+from .core.extra_ops import (  # noqa: F401
+    is_complex, is_floating_point, is_empty, rank, tolist, broadcast_shape,
+    clone, view, broadcast_tensors, unstack, hsplit, vsplit, dsplit, slice,
+    shard_index, unique_consecutive, inverse, poisson, hstack,
+)
 from .core import op_schema as _op_schema  # noqa: E402
 _op_schema.install(globals())  # schema-generated ops (only missing names)
 from .creation import (  # noqa: F401

@@ -1,0 +1,187 @@
+"""Tensor-API surface that does not fit the single-source op schema:
+list/tuple outputs, host-side results, predicates, and random ops.
+
+Reference: assorted paddle/phi kernels + python/paddle/tensor/* wrappers
+(SURVEY.md §2.1 kernel corpus). Installed into the top-level namespace by
+paddle_tpu/__init__.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply
+from .tensor import Tensor
+from .. import random as _random
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- predicates / introspection ---------------------------------------------
+def is_complex(x) -> bool:
+    return jnp.iscomplexobj(_v(x))
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_v(x).dtype, jnp.floating)
+
+
+def is_empty(x) -> Tensor:
+    return Tensor(jnp.asarray(_v(x).size == 0))
+
+
+def rank(x) -> Tensor:
+    return Tensor(jnp.asarray(_v(x).ndim, jnp.int32))
+
+
+def tolist(x) -> list:
+    return _t(x).tolist()
+
+
+def broadcast_shape(x_shape, y_shape) -> List[int]:
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# -- copies / views ----------------------------------------------------------
+def clone(x) -> Tensor:
+    """Differentiable copy (paddle.clone — delegates to Tensor.clone)."""
+    return _t(x).clone()
+
+
+def hstack(x, name=None) -> Tensor:
+    """paddle.hstack: takes a LIST/tuple of tensors (concat along dim 1,
+    or dim 0 for 1-D inputs — numpy hstack semantics)."""
+    ts = [_t(t) for t in x]
+    return apply(lambda *vs: jnp.hstack(vs), *ts, op_name="hstack")
+
+
+def view(x, shape_or_dtype):
+    """paddle.view: reshape view (or dtype reinterpret for a dtype arg)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return apply(lambda v: v.reshape(tuple(
+            int(s) for s in shape_or_dtype)), _t(x), op_name="view")
+    from .dtype import convert_dtype
+    return apply(lambda v: v.view(convert_dtype(shape_or_dtype)), _t(x),
+                 op_name="view_dtype")
+
+
+def broadcast_tensors(inputs: Sequence) -> List[Tensor]:
+    vals = [_v(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+    return [apply(lambda v, s=shape: jnp.broadcast_to(v, s), _t(i),
+                  op_name="broadcast_tensors") for i in inputs]
+
+
+# -- splits / stacks ---------------------------------------------------------
+def unstack(x, axis=0, num=None) -> List[Tensor]:
+    v = _v(x)
+    n = num or v.shape[axis]
+    return [apply(lambda a, i=i: jnp.take(a, i, axis=axis), _t(x),
+                  op_name="unstack") for i in range(n)]
+
+
+def _nsplit(x, num_or_sections, axis):
+    from .math_ops import split
+    return split(_t(x), num_or_sections, axis=axis)
+
+
+def hsplit(x, num_or_sections):
+    v = _v(x)
+    return _nsplit(x, num_or_sections, 0 if v.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_sections):
+    return _nsplit(x, num_or_sections, 0)
+
+
+def dsplit(x, num_or_sections):
+    return _nsplit(x, num_or_sections, 2)
+
+
+# -- indexing ---------------------------------------------------------------
+def slice(x, axes, starts, ends) -> Tensor:  # noqa: A001 — paddle name
+    """paddle.slice: static slice along the given axes."""
+    import builtins
+
+    def fn(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            dim = v.shape[ax]
+            s = int(s) if s >= 0 else int(s) + dim
+            e = int(e) if e >= 0 else int(e) + dim
+            idx[ax] = builtins.slice(max(s, 0), min(e, dim))
+        return v[tuple(idx)]
+
+    return apply(fn, _t(x), op_name="slice")
+
+
+def shard_index(input, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1) -> Tensor:
+    """paddle.shard_index: map a global index to its shard-local value,
+    ignore_value for indices owned by other shards (PS-era embedding
+    sharding helper; kept for API parity)."""
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        owner = v // size
+        local = v % size
+        return jnp.where(owner == shard_id, local,
+                         jnp.full_like(v, ignore_value))
+
+    return apply(fn, _t(input), op_name="shard_index")
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Host-side op (output length is data-dependent — not jittable; the
+    reference's GPU kernel also compacts dynamically)."""
+    v = np.asarray(_v(x))
+    moved = False
+    if axis is None:
+        v = v.reshape(-1)
+    elif axis % v.ndim != 0:
+        v = np.moveaxis(v, axis, 0)  # dedupe runs along the given axis
+        moved = True
+    keep = np.ones(v.shape[0], bool)
+    if v.shape[0] > 1:
+        if v.ndim == 1:
+            keep[1:] = v[1:] != v[:-1]
+        else:
+            keep[1:] = np.any(v[1:] != v[:-1],
+                              axis=tuple(range(1, v.ndim)))
+    kept = v[keep]
+    if moved:
+        kept = np.moveaxis(kept, 0, axis)
+    out = Tensor(jnp.asarray(kept))
+    res = [out]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        pos = np.flatnonzero(keep)
+        counts = np.diff(np.append(pos, v.shape[0]))
+        res.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+# -- linalg adjacent ---------------------------------------------------------
+def inverse(x) -> Tensor:
+    return apply(lambda v: jnp.linalg.inv(v), _t(x), op_name="inverse")
+
+
+# -- random ------------------------------------------------------------------
+def poisson(x) -> Tensor:
+    """Element-wise Poisson sample with rate x (paddle.poisson)."""
+    key = _random.next_key()
+    return apply(lambda v: jax.random.poisson(key, v, v.shape).astype(
+        v.dtype), _t(x), op_name="poisson")

@@ -572,6 +572,226 @@ register(OpSpec(
 ))
 
 
+# --- batch 4: tensor-API audit gaps (round 2) -------------------------------
+register(OpSpec(
+    name="as_complex",
+    fn=lambda x: jax.lax.complex(x[..., 0], x[..., 1]),
+    oracle=lambda x: x[..., 0] + 1j * x[..., 1],
+    sample=lambda rng: ((rng.randn(4, 3, 2).astype(np.float32),), {}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="as_real",
+    fn=lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1),
+    oracle=lambda x: np.stack([np.real(x), np.imag(x)], axis=-1),
+    sample=lambda rng: (((rng.randn(4, 3) + 1j * rng.randn(4, 3))
+                         .astype(np.complex64),), {}),
+    dtypes=("complex64",), integer_inputs=(0,), grad=False,
+))
+
+register(OpSpec(
+    name="diagflat",
+    fn=lambda x, offset=0: jnp.diagflat(x, k=offset),
+    oracle=lambda x, offset=0: np.diagflat(x, k=offset),
+    sample=lambda rng: ((rng.randn(4).astype(np.float32),), {"offset": 1}),
+))
+
+register(OpSpec(
+    name="dist",
+    fn=lambda x, y, p=2.0: _jax_dist(x, y, p),
+    oracle=lambda x, y, p=2.0: _np_dist(x, y, p),
+    sample=lambda rng: ((rng.randn(4, 3).astype(np.float32),
+                         rng.randn(4, 3).astype(np.float32)), {"p": 2.0}),
+))
+
+
+def _jax_dist(x, y, p):
+    d = jnp.abs(x - y).astype(jnp.float32)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(jnp.float32))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+def _np_dist(x, y, p):
+    d = np.abs(np.asarray(x, np.float64) - np.asarray(y, np.float64))
+    if p == float("inf"):
+        return d.max()
+    if p == 0:
+        return float((d != 0).sum())
+    return (d ** p).sum() ** (1.0 / p)
+
+
+register(OpSpec(
+    name="inner",
+    fn=jnp.inner,
+    oracle=np.inner,
+    sample=lambda rng: ((rng.randn(3, 4).astype(np.float32),
+                         rng.randn(5, 4).astype(np.float32)), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="mv",
+    fn=lambda x, vec: jnp.matmul(x, vec),
+    oracle=lambda x, vec: np.matmul(x, vec),
+    sample=lambda rng: ((rng.randn(4, 6).astype(np.float32),
+                         rng.randn(6).astype(np.float32)), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="nan_to_num",
+    fn=lambda x, nan=0.0, posinf=None, neginf=None: jnp.nan_to_num(
+        x, nan=nan, posinf=posinf, neginf=neginf),
+    # inf maps to the dtype's max — pin the oracle to fp32 (the harness
+    # passes float64 args, whose max differs)
+    oracle=lambda x, nan=0.0, posinf=None, neginf=None: np.nan_to_num(
+        np.asarray(x, np.float32), nan=nan, posinf=posinf, neginf=neginf),
+    sample=lambda rng: ((np.asarray([1.0, np.nan, np.inf, -np.inf, 2.0],
+                                    np.float32),), {"nan": 9.0}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="nanquantile",
+    fn=lambda x, q, axis=None, keepdim=False: jnp.nanquantile(
+        x, q, axis=axis, keepdims=keepdim),
+    oracle=lambda x, q, axis=None, keepdim=False: np.nanquantile(
+        x, q, axis=axis, keepdims=keepdim),
+    sample=lambda rng: ((np.where(rng.rand(5, 8) < 0.2, np.nan,
+                                  rng.randn(5, 8)).astype(np.float32),),
+                        {"q": 0.75, "axis": 1}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="polar",
+    fn=lambda abs, angle: jax.lax.complex(abs * jnp.cos(angle),
+                                          abs * jnp.sin(angle)),
+    oracle=lambda abs, angle: abs * np.exp(1j * angle.astype(np.float64)),
+    sample=lambda rng: ((rng.rand(6).astype(np.float32) + 0.1,
+                         rng.randn(6).astype(np.float32)), {}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="sgn",
+    fn=lambda x: jnp.where(jnp.abs(x) == 0, 0.0 * x, x / jnp.abs(x))
+    if jnp.iscomplexobj(x) else jnp.sign(x),
+    oracle=lambda x: np.where(np.abs(x) == 0, 0 * x, x / np.abs(x))
+    if np.iscomplexobj(x) else np.sign(x),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+    grad=False,
+))
+
+register(OpSpec(
+    name="stanh",
+    fn=lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(
+        scale_a * x),
+    oracle=lambda x, scale_a=0.67, scale_b=1.7159: scale_b * np.tanh(
+        scale_a * x),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+))
+
+register(OpSpec(
+    name="tensordot",
+    fn=lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes),
+    oracle=lambda x, y, axes=2: np.tensordot(x, y, axes=axes),
+    sample=lambda rng: ((rng.randn(3, 4, 5).astype(np.float32),
+                         rng.randn(4, 5, 6).astype(np.float32)), {}),
+    tol={"bfloat16": 5e-2},
+))
+
+register(OpSpec(
+    name="unflatten",
+    fn=lambda x, axis, shape: x.reshape(
+        x.shape[:axis % x.ndim] + tuple(shape)
+        + x.shape[axis % x.ndim + 1:]),
+    oracle=lambda x, axis, shape: x.reshape(
+        x.shape[:axis % x.ndim] + tuple(shape)
+        + x.shape[axis % x.ndim + 1:]),
+    sample=lambda rng: ((rng.randn(2, 12).astype(np.float32),),
+                        {"axis": 1, "shape": (3, 4)}),
+))
+
+
+def _cummax_impl(op):
+    def fn(x, axis=-1):
+        ax = axis % x.ndim
+
+        def comb(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av if op == "max" else bv < av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, ax)
+        vals, idx = jax.lax.associative_scan(comb, (x, iota), axis=ax)
+        return vals, idx
+    return fn
+
+
+def _np_cummax(op):
+    def oracle(x, axis=-1):
+        x = np.asarray(x)
+        ax = axis % x.ndim
+        xm = np.moveaxis(x, ax, -1)
+        # C-contiguous buffers: reshape on a non-contiguous view would
+        # COPY, discarding the writes (moveaxis makes xm non-contiguous
+        # for middle axes)
+        flat = np.ascontiguousarray(xm).reshape(-1, xm.shape[-1])
+        vals = np.empty(xm.shape, xm.dtype)
+        idx = np.empty(xm.shape, np.int64)
+        fv = vals.reshape(-1, xm.shape[-1])
+        fi = idx.reshape(-1, xm.shape[-1])
+        for r in range(flat.shape[0]):
+            best, bi = flat[r, 0], 0
+            for c in range(flat.shape[1]):
+                better = flat[r, c] > best if op == "max" else flat[r, c] < best
+                if better:
+                    best, bi = flat[r, c], c
+                fv[r, c], fi[r, c] = best, bi
+        return np.moveaxis(vals, -1, ax), np.moveaxis(idx, -1, ax)
+    return oracle
+
+
+for _op in ("max", "min"):
+    register(OpSpec(
+        name=f"cum{_op}",
+        fn=_cummax_impl(_op),
+        oracle=_np_cummax(_op),
+        sample=lambda rng: ((rng.randn(3, 7).astype(np.float32),),
+                            {"axis": 1}),
+        n_outputs=2,
+        grad=False,
+    ))
+
+
+register(OpSpec(
+    name="scatter_nd",
+    fn=lambda index, updates, shape: jnp.zeros(
+        tuple(shape), updates.dtype).at[tuple(index[..., i]
+                                              for i in range(index.shape[-1]))
+                                        ].add(updates),
+    oracle=lambda index, updates, shape: _np_scatter_nd(index, updates, shape),
+    sample=lambda rng: ((rng.randint(0, 5, (6, 1)).astype(np.int32),
+                         rng.randn(6).astype(np.float32)),
+                        {"shape": (5,)}),
+    integer_inputs=(0,),
+    grad_arg=1,
+))
+
+
+def _np_scatter_nd(index, updates, shape):
+    out = np.zeros(tuple(shape), np.float64)
+    for i in range(index.shape[0]):
+        out[tuple(index[i])] += updates[i]
+    return out
+
+
 # --- vision rearrangement ---------------------------------------------------
 register(OpSpec(
     name="pixel_unshuffle",

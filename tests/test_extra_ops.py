@@ -1,0 +1,87 @@
+"""Direct (non-schema) tensor-API ops: splits, views, predicates, host-side
+unique_consecutive, shard_index, poisson (round-2 API-audit batch)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_predicates_and_rank():
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert paddle.is_floating_point(t) and not paddle.is_complex(t)
+    assert int(paddle.rank(t)._value) == 2
+    assert not bool(paddle.is_empty(t)._value)
+    assert paddle.tolist(t) == [[1.0, 1.0, 1.0]] * 2
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_clone_differentiable():
+    t = paddle.to_tensor(np.ones((3,), np.float32))
+    t.stop_gradient = False
+    c = paddle.clone(t)
+    (c * 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(t.grad._value), [2, 2, 2])
+
+
+def test_view_and_unflatten_splits():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    v = paddle.view(t, [2, 6])
+    assert tuple(v.shape) == (2, 6)
+    u = paddle.unflatten(t, axis=1, shape=(2, 2))
+    assert tuple(u.shape) == (3, 2, 2)
+    parts = paddle.vsplit(t, 3)
+    assert len(parts) == 3 and tuple(parts[0].shape) == (1, 4)
+    hs = paddle.hsplit(t, 2)
+    assert len(hs) == 2 and tuple(hs[0].shape) == (3, 2)
+    us = paddle.unstack(t, axis=0)
+    assert len(us) == 3 and tuple(us[0].shape) == (4,)
+
+
+def test_broadcast_tensors_and_slice():
+    a = paddle.to_tensor(np.ones((1, 3), np.float32))
+    b = paddle.to_tensor(np.ones((2, 1), np.float32))
+    oa, ob = paddle.broadcast_tensors([a, b])
+    assert tuple(oa.shape) == (2, 3) == tuple(ob.shape)
+    t = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+    s = paddle.slice(t, axes=[0, 1], starts=[1, 2], ends=[3, 5])
+    np.testing.assert_allclose(np.asarray(s._value),
+                               np.arange(24).reshape(4, 6)[1:3, 2:5])
+
+
+def test_unique_consecutive():
+    t = paddle.to_tensor(np.asarray([1, 1, 2, 2, 2, 3, 1], np.int32))
+    out, inv, cnt = paddle.unique_consecutive(t, return_inverse=True,
+                                              return_counts=True)
+    np.testing.assert_allclose(np.asarray(out._value), [1, 2, 3, 1])
+    np.testing.assert_allclose(np.asarray(inv._value),
+                               [0, 0, 1, 1, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(cnt._value), [2, 3, 1, 1])
+
+
+def test_shard_index():
+    idx = paddle.to_tensor(np.asarray([0, 5, 9, 12, 19], np.int32))
+    out = paddle.shard_index(idx, index_num=20, nshards=2, shard_id=0)
+    np.testing.assert_allclose(np.asarray(out._value), [0, 5, 9, -1, -1])
+    out1 = paddle.shard_index(idx, index_num=20, nshards=2, shard_id=1)
+    np.testing.assert_allclose(np.asarray(out1._value), [-1, -1, -1, 2, 9])
+
+
+def test_inverse_and_poisson():
+    a = np.asarray([[2.0, 0.0], [1.0, 3.0]], np.float32)
+    inv = np.asarray(paddle.inverse(paddle.to_tensor(a))._value)
+    np.testing.assert_allclose(inv @ a, np.eye(2), atol=1e-5)
+    paddle.seed(0)
+    lam = paddle.to_tensor(np.full((2000,), 4.0, np.float32))
+    s = np.asarray(paddle.poisson(lam)._value)
+    assert abs(s.mean() - 4.0) < 0.2 and s.min() >= 0
+
+
+def test_hstack_list_form_and_unique_consecutive_axis():
+    a = paddle.to_tensor(np.ones((3, 2), np.float32))
+    b = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    out = paddle.hstack([a, b])  # paddle passes a LIST
+    assert tuple(out.shape) == (3, 6)
+    # axis=1 dedupes columns
+    t = paddle.to_tensor(np.asarray([[1, 1, 2], [3, 3, 4]], np.int32))
+    out = paddle.unique_consecutive(t, axis=1)
+    np.testing.assert_allclose(np.asarray(out._value), [[1, 2], [3, 4]])
