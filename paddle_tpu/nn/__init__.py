@@ -18,3 +18,17 @@ from .common_layers import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, Pad2D, PixelShuffle,
 )
+from .rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, RNN, SimpleRNNCell, LSTMCell, GRUCell,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layers_extra import (  # noqa: F401
+    MaxPool1D, AvgPool1D, AdaptiveAvgPool1D, Pad1D, Pad3D, ZeroPad2D,
+    UpsamplingBilinear2D, GLU, AlphaDropout, LocalResponseNorm,
+    InstanceNorm1D, Bilinear, CosineSimilarity, PairwiseDistance,
+    Unfold, Fold, HuberLoss, MarginRankingLoss, TripletMarginLoss,
+    SpectralNorm,
+)

@@ -337,53 +337,69 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return apply(fn, *args, op_name="conv3d")
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
-    nd = 2
-    stride = _pair(stride, nd)
-    dilation = _pair(dilation, nd)
-    pad_amt = _conv_padding(padding, nd)
-    if isinstance(pad_amt, str):
+def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                         dilation, groups, nd, op_name,
+                         _channel_last=False):
+    """Transpose conv as a fractionally-strided conv_general_dilated
+    (lhs_dilation = stride) — the only jax formulation that supports
+    groups. Paddle weight layout [in_c, out_c/groups, *k]; the kernel is
+    re-arranged to [out_c, in_c/groups, *k] and spatially FLIPPED (a
+    transpose conv correlates with the flipped kernel — round-2 fix: the
+    old transpose_kernel=True path silently transposed the channel-mixing
+    matrix and rejected in_c != out_c).
+    Output size per dim: (H-1)*s - p_lo - p_hi + d*(k-1) + 1 + out_pad.
+    """
+    s = _pair(stride, nd)
+    d = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+    if isinstance(pad, str):
         raise NotImplementedError("string padding for conv_transpose")
+    op = _pair(output_padding, nd)
+    channel_last = _channel_last
+    lhs_spec = {1: "NCH", 2: "NCHW", 3: "NCDHW"}[nd] if not channel_last \
+        else {1: "NHC", 2: "NHWC", 3: "NDHWC"}[nd]
+    spec = (lhs_spec, {1: "OIH", 2: "OIHW", 3: "OIDHW"}[nd], lhs_spec)
 
     def fn(v, w, *rest):
-        # weight layout [in_c, out_c/groups, kh, kw] in paddle
-        out = jax.lax.conv_transpose(
-            v, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-            strides=stride,
-            padding=pad_amt,
-            rhs_dilation=dilation,
-            dimension_numbers=(data_format, "OIHW", data_format),
-            transpose_kernel=True,
-        ).astype(v.dtype)
+        in_c = w.shape[0]
+        out_g = w.shape[1]
+        ksp = w.shape[2:]
+        in_g = in_c // groups
+        k = w.reshape((groups, in_g, out_g) + ksp)
+        k = jnp.swapaxes(k, 1, 2).reshape((groups * out_g, in_g) + ksp)
+        k = k[(slice(None), slice(None))
+              + tuple(slice(None, None, -1) for _ in range(nd))]
+        pads = [(d[i] * (ksp[i] - 1) - pad[i][0],
+                 d[i] * (ksp[i] - 1) - pad[i][1] + op[i])
+                for i in range(nd)]
+        out = jax.lax.conv_general_dilated(
+            v, k, window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=s, rhs_dilation=d, dimension_numbers=spec,
+            feature_group_count=groups).astype(v.dtype)
         if rest:
-            out = out + rest[0].reshape((1, -1, 1, 1))
+            bshape = ((1,) + (1,) * nd + (-1,)) if channel_last \
+                else ((1, -1) + (1,) * nd)
+            out = out + rest[0].reshape(bshape)
         return out
 
     args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
-    return apply(fn, *args, op_name="conv2d_transpose")
+    return apply(fn, *args, op_name=op_name)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups, 2,
+                                "conv2d_transpose",
+                                _channel_last=data_format == "NHWC")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    k = _pair(kernel_size)
-    s = _pair(stride) if stride is not None else k
-    pad = _conv_padding(padding, 2)
-    if data_format == "NCHW":
-        window = (1, 1) + k
-        strides = (1, 1) + s
-        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
-    else:
-        window = (1,) + k + (1,)
-        strides = (1,) + s + (1,)
-        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
-
-    def fn(v):
-        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
-        return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
-                                     pads if not isinstance(pad, str) else pad)
-
-    return apply(fn, _t(x), op_name="max_pool2d")
+    if data_format != "NCHW":
+        raise NotImplementedError("max_pool2d: only NCHW is supported")
+    return _pool_nd(x, 2, kernel_size, stride, padding, "max", "max_pool2d",
+                    ceil_mode=ceil_mode, return_mask=return_mask)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -1002,3 +1018,515 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         rest = v5[:, :, 2 * fold:]
         return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
     return apply(fn, _t(x), op_name="temporal_shift")
+
+
+# ---------------------------------------------------------------------------
+# round-2 nn-audit batch: N-D pooling, conv transposes, activations, losses
+# (reference: paddle/phi/kernels pool/conv/activation/loss families —
+# SURVEY.md §2.1 kernel corpus)
+# ---------------------------------------------------------------------------
+def _ceil_extra(sp, k, s, pad):
+    """Per-dim extra high padding so the last partial window is included
+    (paddle ceil_mode)."""
+    extra = []
+    for i in range(len(k)):
+        span = sp[i] + pad[i][0] + pad[i][1] - k[i]
+        extra.append((s[i] - span % s[i]) % s[i] if span % s[i] else 0)
+    return extra
+
+
+def _pool_nd(x, nd, kernel_size, stride, padding, reduce_op, op_name,
+             exclusive=True, ceil_mode=False, return_mask=False):
+    k = _pair(kernel_size, nd)
+    s = _pair(stride, nd) if stride is not None else k
+    pad = _conv_padding(padding, nd)
+    if isinstance(pad, str):
+        raise NotImplementedError("string padding for pooling")
+    pad = list(pad)
+
+    def fn(v):
+        sp = v.shape[2:]
+        extra = _ceil_extra(sp, k, s, pad) if ceil_mode else [0] * nd
+        pads = [(0, 0), (0, 0)] + [(pad[i][0], pad[i][1] + extra[i])
+                                   for i in range(nd)]
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        if reduce_op == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+                else jnp.iinfo(v.dtype).min
+            out = jax.lax.reduce_window(v, init, jax.lax.max, window,
+                                        strides, pads)
+            if not return_mask:
+                return out
+            # mask = flat spatial index of each window's max: pre-pad with
+            # -inf (a pad can never win), extract patches, argmax, then map
+            # the in-window offset back to input coordinates
+            vp = jnp.pad(v, pads[:2] + [(pad[i][0], pad[i][1] + extra[i])
+                                        for i in range(nd)],
+                         constant_values=init)
+            patches = jax.lax.conv_general_dilated_patches(
+                vp.reshape((v.shape[0] * v.shape[1], 1) + vp.shape[2:]),
+                filter_shape=k, window_strides=s,
+                padding=[(0, 0)] * nd)
+            P = int(np.prod(k))
+            osp = patches.shape[2:]
+            patches = patches.reshape(v.shape[:2] + (P,) + osp)
+            am = jnp.argmax(patches, axis=2)              # (N, C, *osp)
+            idx = jnp.zeros_like(am)
+            rem = am
+            coords = []
+            for i in range(nd):
+                stride_prod = int(np.prod(k[i + 1:]))
+                off = rem // stride_prod
+                rem = rem % stride_prod
+                starts = (jnp.arange(osp[i]) * s[i] - pad[i][0]).reshape(
+                    (1, 1) + tuple(osp[i] if j == i else 1
+                                   for j in range(nd)))
+                coords.append(off + starts)
+            flat = coords[0]
+            for i in range(1, nd):
+                flat = flat * sp[i] + coords[i]
+            return out, flat.astype(jnp.int32)
+        summed = jax.lax.reduce_window(v.astype(jnp.float32), 0.0,
+                                       jax.lax.add, window, strides, pads)
+        if exclusive:
+            counts = jax.lax.reduce_window(jnp.ones_like(v, jnp.float32),
+                                           0.0, jax.lax.add, window,
+                                           strides, pads)
+            return (summed / counts).astype(v.dtype)
+        return (summed / float(np.prod(k))).astype(v.dtype)
+
+    n_outputs = 2 if (reduce_op == "max" and return_mask) else 1
+    return apply(fn, _t(x), op_name=op_name, n_outputs=n_outputs)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, 1, kernel_size, stride, padding, "max", "max_pool1d",
+                    ceil_mode=ceil_mode, return_mask=return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, 3, kernel_size, stride, padding, "max", "max_pool3d",
+                    ceil_mode=ceil_mode, return_mask=return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, 1, kernel_size, stride, padding, "avg", "avg_pool1d",
+                    exclusive, ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, 3, kernel_size, stride, padding, "avg", "avg_pool3d",
+                    exclusive, ceil_mode=ceil_mode)
+
+
+def _adaptive_pool_nd(x, nd, output_size, reduce_op, op_name):
+    outs = _pair(output_size, nd)
+
+    def fn(v):
+        spatial = v.shape[2:]
+        assert all(s % o == 0 for s, o in zip(spatial, outs)), \
+            "adaptive pool requires divisible sizes"
+        shape = v.shape[:2]
+        for s, o in zip(spatial, outs):
+            shape = shape + (o, s // o)
+        v2 = v.reshape(shape)
+        axes = tuple(3 + 2 * i for i in range(nd))
+        return v2.max(axis=axes) if reduce_op == "max" else v2.mean(axis=axes)
+
+    return apply(fn, _t(x), op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(x, 1, output_size, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, 3, output_size, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, 1, output_size, "max", "adaptive_max_pool1d")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups, 1,
+                                "conv1d_transpose",
+                                _channel_last=data_format == "NLC")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups, 3,
+                                "conv3d_transpose",
+                                _channel_last=data_format == "NDHWC")
+
+
+# -- activations -------------------------------------------------------------
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, _t(x), op_name="log_sigmoid")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), _t(x), op_name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return v.reshape(shape).max(axis=ax + 1)
+    return apply(fn, _t(x), op_name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, 0.0), _t(x),
+                 op_name="thresholded_relu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    """Randomized leaky ReLU: random slope in [lower, upper] when training,
+    the mean slope at inference (paddle.nn.functional.rrelu)."""
+    if not training:
+        slope = (lower + upper) / 2.0
+        return apply(lambda v: jnp.where(v >= 0, v, slope * v), _t(x),
+                     op_name="rrelu")
+    from .. import random as _random
+    key = _random.next_key()
+
+    def fn(v):
+        a = jax.random.uniform(key, v.shape, jnp.float32, lower, upper)
+        return jnp.where(v >= 0, v, (a * v.astype(jnp.float32)).astype(v.dtype))
+
+    return apply(fn, _t(x), op_name="rrelu")
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Channel-wise dropout for 5-D inputs."""
+    if not training or p == 0.0:
+        return _t(x)
+    from .. import random as _random
+    key = _random.next_key()
+
+    def fn(v):
+        if data_format == "NDHWC":
+            mask_shape = (v.shape[0], 1, 1, 1, v.shape[-1])
+        else:  # NCDHW
+            mask_shape = v.shape[:2] + (1, 1, 1)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0)
+
+    return apply(fn, _t(x), op_name="dropout3d")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """AlexNet-style LRN across channels (reference phi lrn kernel)."""
+    def fn(v):
+        sq = (v * v).astype(jnp.float32)
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2)
+        acc = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, size) + (1,) * (v.ndim - 2),
+            (1,) * v.ndim, pads)
+        div = (k + alpha * acc / size) ** beta
+        return (v / div.astype(v.dtype))
+
+    return apply(fn, _t(x), op_name="local_response_norm")
+
+
+# -- distances / similarities -----------------------------------------------
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b).astype(jnp.float32) + epsilon
+        out = jnp.sum(d ** p, axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+    return apply(fn, _t(x), _t(y), op_name="pairwise_distance")
+
+
+# -- losses ------------------------------------------------------------------
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        p = p.astype(jnp.float32)
+        return -(y * jnp.log(p + epsilon)
+                 + (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(fn, _t(input), _t(label), op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """input (N, ..., C) probabilities, label (N, ..., 1) int."""
+    def fn(p, y):
+        n = p.shape[0]
+        c = p.shape[-1]
+        pf = p.reshape(n, -1, c).astype(jnp.float32)
+        oh = jax.nn.one_hot(y.reshape(n, -1).astype(jnp.int32), c)
+        inter = jnp.sum(pf * oh, axis=(1, 2))
+        union = jnp.sum(pf, axis=(1, 2)) + jnp.sum(oh, axis=(1, 2))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(fn, _t(input), _t(label), op_name="dice_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        out = jnp.log1p(jnp.exp(-y * x.astype(jnp.float32)))
+        return _reduce_loss(out, reduction)
+    return apply(fn, _t(input), _t(label), op_name="soft_margin_loss")
+
+
+def _reduce_loss(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(x, y):
+        xf = x.astype(jnp.float32)
+        out = jnp.where(y == 1.0, xf, jnp.maximum(0.0, margin - xf))
+        return _reduce_loss(out, reduction)
+    return apply(fn, _t(input), _t(label), op_name="hinge_embedding_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        xf = x.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        if log_input:
+            out = jnp.exp(xf) - yf * xf
+        else:
+            out = xf - yf * jnp.log(xf + epsilon)
+        if full:
+            stirling = yf * jnp.log(yf + epsilon) - yf \
+                + 0.5 * jnp.log(2 * jnp.pi * (yf + epsilon))
+            out = out + jnp.where(yf > 1, stirling, 0.0)
+        return _reduce_loss(out, reduction)
+    return apply(fn, _t(input), _t(label), op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.clip(var.astype(jnp.float32), epsilon)
+        out = 0.5 * (jnp.log(var)
+                     + (y.astype(jnp.float32) - mu.astype(jnp.float32)) ** 2
+                     / var)
+        if full:
+            out = out + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        return _reduce_loss(out, reduction)
+    return apply(fn, _t(input), _t(label), _t(variance),
+                 op_name="gaussian_nll_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(x, y, *rest):
+        xf = x.astype(jnp.float32)
+        p = jax.nn.sigmoid(xf)
+        ce = jnp.maximum(xf, 0) - xf * y + jnp.log1p(jnp.exp(-jnp.abs(xf)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            out = out / rest[0]
+        return _reduce_loss(out, reduction)
+
+    args = [_t(logit), _t(label)] + \
+        ([_t(normalizer)] if normalizer is not None else [])
+    return apply(fn, *args, op_name="sigmoid_focal_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(x, y, *rest):
+        xf = x.astype(jnp.float32)
+        out = -(y * jax.nn.log_sigmoid(xf)
+                + (1 - y) * jax.nn.log_sigmoid(-xf))
+        if rest:
+            out = out * rest[0]
+        return _reduce_loss(out.mean(axis=-1), reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, op_name="multi_label_soft_margin_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        af = a.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
+        cos = jnp.sum(af * bf, -1) / (
+            jnp.linalg.norm(af, axis=-1) * jnp.linalg.norm(bf, axis=-1)
+            + 1e-12)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(out, reduction)
+    return apply(fn, _t(input1), _t(input2), _t(label),
+                 op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            d = jnp.abs(u - v).astype(jnp.float32) + epsilon
+            return jnp.sum(d ** p, axis=-1) ** (1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply(fn, _t(input), _t(positive), _t(negative),
+                 op_name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        dn = apply(lambda a, b: jnp.minimum(a, b), dn, dpn,
+                   op_name="triplet_swap")
+    return apply(lambda a, b: _reduce_loss(
+        jnp.maximum(0.0, a.astype(jnp.float32) - b.astype(jnp.float32)
+                    + margin), reduction), dp, dn,
+        op_name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    def fn(a, pos, y):
+        af = a.astype(jnp.float32)
+        pf = pos.astype(jnp.float32)
+        sim = af @ pf.T                                 # (B, B)
+        same = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(af * af, -1))
+                        + jnp.mean(jnp.sum(pf * pf, -1))) * 0.25
+        return xent + reg
+    return apply(fn, _t(anchor), _t(positive), _t(labels),
+                 op_name="npair_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax (reference:
+    paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu:§0; the reference
+    also model-parallel-shards the class dim — here the mp sharding comes
+    from GSPMD when logits carry a sharded spec)."""
+    def fn(lg, y):
+        lf = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)  # cosine logits
+        theta = jnp.arccos(lf)
+        yi = y.astype(jnp.int32)
+        oh = jax.nn.one_hot(yi, lg.shape[-1])
+        adj = jnp.cos(margin1 * theta + margin2) - margin3
+        lf = jnp.where(oh > 0, adj, lf) * scale
+        logp = jax.nn.log_softmax(lf, axis=-1)
+        loss = -jnp.take_along_axis(logp, yi[:, None], axis=-1)[:, 0]
+        loss = _reduce_loss(loss, reduction)
+        return (loss, jnp.exp(logp)) if return_softmax else loss
+
+    return apply(fn, _t(logits), _t(label), op_name="margin_cross_entropy",
+                 n_outputs=2 if return_softmax else 1)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist temporal classification loss (reference:
+    paddle/phi/kernels/gpu/warpctc_kernel.cu:§0 via warp-ctc). TPU-native:
+    the standard alpha-recursion in log space as a lax.scan over time —
+    static shapes, differentiable, jittable.
+
+    log_probs: (T, B, C) log-softmaxed; labels: (B, L) int (padded);
+    input_lengths/label_lengths: (B,).
+    """
+    def fn(lp, lab, ilen, llen):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        # transitions: alpha[s] += alpha[s-1]; += alpha[s-2] when
+        # ext[s] != blank and ext[s] != ext[s-2]
+        ext_prev2 = jnp.concatenate(
+            [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+        neg_inf = jnp.float32(-1e30)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=-1)   # (B, S)
+        alpha0 = jnp.where(
+            jnp.arange(S)[None, :] < 2, emit0, neg_inf)
+        # positions beyond 2*llen+1 invalid
+        valid_s = jnp.arange(S)[None, :] < (2 * llen[:, None] + 1)
+        alpha0 = jnp.where(valid_s, alpha0, neg_inf)
+
+        def step(alpha, lp_t):
+            a1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(can_skip, a2, neg_inf)
+            m = jnp.maximum(alpha, jnp.maximum(a1, a2))
+            tot = m + jnp.log(
+                jnp.exp(alpha - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m)
+                + 1e-35)
+            emit = jnp.take_along_axis(lp_t, ext, axis=-1)
+            new = jnp.where(valid_s, tot + emit, neg_inf)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+        # per-sequence final alpha at t = ilen-1, s in {2*llen, 2*llen-1}
+        t_idx = jnp.clip(ilen - 1, 0, T - 1)
+        final = jnp.take_along_axis(
+            alphas, t_idx[None, :, None].astype(jnp.int32), axis=0)[0]
+        sl = 2 * llen
+        a_last = jnp.take_along_axis(final, sl[:, None].astype(jnp.int32),
+                                     axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            final, jnp.maximum(sl - 1, 0)[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-35)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # paddle averages per-sequence losses normalised by label length
+            return jnp.mean(loss / jnp.maximum(llen.astype(jnp.float32),
+                                               1.0))
+        return _reduce_loss(loss, reduction)
+
+    return apply(fn, _t(log_probs), _t(labels), _t(input_lengths),
+                 _t(label_lengths), op_name="ctc_loss")
+
+
+# paddle exposes these in nn.functional too; reuse the schema-registered ops
+from ..core import op_schema as _op_schema  # noqa: E402
+
+pixel_unshuffle = _op_schema.make_public(_op_schema.OPS["pixel_unshuffle"])
+channel_shuffle = _op_schema.make_public(_op_schema.OPS["channel_shuffle"])

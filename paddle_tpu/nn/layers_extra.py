@@ -1,0 +1,314 @@
+"""Round-2 nn-audit layer batch: thin wrappers over the functional surface
+plus Bilinear / SpectralNorm (reference: python/paddle/nn/layer/*)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+# -- pooling / padding / upsampling ------------------------------------------
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.return_mask, self.ceil_mode = return_mask, ceil_mode
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.k, self.s, self.p,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.exclusive, self.ceil_mode = exclusive, ceil_mode
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.k, self.s, self.p, self.exclusive,
+                            ceil_mode=self.ceil_mode)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 2
+        self._mode, self._value = mode, value
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode=self._mode, value=self._value,
+                     data_format=self._data_format)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 6
+        self._mode, self._value = mode, value
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode=self._mode, value=self._value,
+                     data_format=self._data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode="constant", value=0.0,
+                     data_format=self._data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="bilinear", align_corners=True)
+
+
+# -- activations / misc -------------------------------------------------------
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=self.axis)
+
+
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (paddle.nn.AlphaDropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from .. import random as _random
+        key = _random.next_key()
+        p = self.p
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        neg_sat = -alpha * scale
+
+        def fn(v):
+            keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+            a = (1.0 / np.sqrt((1 - p) * (1 + p * neg_sat ** 2)))
+            b = -a * p * neg_sat
+            return a * jnp.where(keep, v, neg_sat) + b
+
+        return apply(fn, x, op_name="alpha_dropout")
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.eps = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(0.0),
+            is_bias=True)
+
+    def forward(self, x):
+        def fn(v, w, b):
+            vf = v.astype(jnp.float32)
+            mu = vf.mean(axis=-1, keepdims=True)
+            var = vf.var(axis=-1, keepdims=True)
+            out = (vf - mu) * jax.lax.rsqrt(var + self.eps)
+            return (out * w[None, :, None] + b[None, :, None]).astype(v.dtype)
+        return apply(fn, x, self.weight, self.bias, op_name="instance_norm1d")
+
+
+class Bilinear(Layer):
+    """out[b, o] = x1[b] @ W[o] @ x2[b] + bias (paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            (out_features,), default_initializer=I.Constant(0.0),
+            is_bias=True)
+
+    def forward(self, x1, x2):
+        def fn(a, b, w, bias):
+            return jnp.einsum("bi,oij,bj->bo", a, w, b) + bias
+        return apply(fn, x1, x2, self.weight, self.bias, op_name="bilinear")
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.eps, self.keepdim)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.k, self.s, self.p, self.d = kernel_sizes, strides, paddings, \
+            dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.k, self.s, self.p, self.d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.o, self.k, self.s, self.p, self.d = output_sizes, \
+            kernel_sizes, strides, paddings, dilations
+
+    def forward(self, x):
+        return F.fold(x, self.o, self.k, self.s, self.p, self.d)
+
+
+# -- losses -------------------------------------------------------------------
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        def fn(x, y):
+            d = (x - y).astype(jnp.float32)
+            ad = jnp.abs(d)
+            out = jnp.where(ad <= self.delta, 0.5 * d * d,
+                            self.delta * (ad - 0.5 * self.delta))
+            return F._reduce_loss(out, self.reduction)
+        return apply(fn, input, label, op_name="huber_loss")
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label,
+                                     margin=self.margin,
+                                     reduction=self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.p, self.eps = margin, p, epsilon
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative,
+                                     margin=self.margin, p=self.p,
+                                     epsilon=self.eps, swap=self.swap,
+                                     reduction=self.reduction)
+
+
+# -- reparameterizations ------------------------------------------------------
+class SpectralNorm(Layer):
+    """paddle.nn.SpectralNorm: normalise an input WEIGHT tensor by its
+    largest singular value, estimated with power iteration (buffers u, v
+    persist across calls; reference phi spectral_norm kernel)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = epsilon
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        self.register_buffer("weight_u", Tensor(jnp.asarray(
+            rng.randn(h).astype(np.float32))))
+        self.register_buffer("weight_v", Tensor(jnp.asarray(
+            rng.randn(w).astype(np.float32))))
+
+    def forward(self, weight):
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(wt, u, v):
+            wmat = jnp.moveaxis(wt, dim, 0)
+            shape = wmat.shape
+            wmat = wmat.reshape(shape[0], -1)
+
+            def norm(x):
+                return x / (jnp.linalg.norm(x) + eps)
+
+            for _ in range(iters):
+                v = norm(wmat.T @ u)
+                u = norm(wmat @ v)
+            sigma = u @ wmat @ v
+            out = wmat / sigma
+            return jnp.moveaxis(out.reshape(shape), 0, dim), u, v
+
+        out, u, v = apply(fn, weight, self.weight_u, self.weight_v,
+                          op_name="spectral_norm", n_outputs=3)
+        self.weight_u._value = u._value if isinstance(u, Tensor) else u
+        self.weight_v._value = v._value if isinstance(v, Tensor) else v
+        return out

@@ -1,0 +1,330 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+Rebuild of python/paddle/nn/layer/rnn.py over the phi rnn kernels
+(paddle/phi/kernels/gpu/rnn_kernel.cu — cuDNN-backed in the reference;
+SURVEY.md §2.1 kernel corpus). TPU-native: the time loop is a ``lax.scan``
+per layer/direction — one compiled program, weights as scan-invariant
+captures, MXU-friendly stacked gate matmuls.
+
+Conventions match paddle: batch-major inputs (batch, time, size) by
+default (``time_major=True`` flips), gate order i,f,c,o for LSTM and
+r,z,c for GRU, and outputs (outputs, final_states).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer, LayerList
+from . import initializer as I
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _uniform_init(fan):
+    bound = 1.0 / math.sqrt(fan) if fan > 0 else 0.0
+    return I.Uniform(-bound, bound)
+
+
+class _RNNCellBase(Layer):
+    n_gates = 1
+    activation = staticmethod(jnp.tanh)
+
+    def __init__(self, input_size: int, hidden_size: int, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = self.n_gates
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            (g * hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (g * hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (g * hidden_size,), default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (g * hidden_size,), default_initializer=init, is_bias=True)
+
+    def get_initial_states(self, batch):
+        z = Tensor(jnp.zeros((batch, self.hidden_size), jnp.float32))
+        return z
+
+
+def _apply_gates(gates, state, n_gates, kind):
+    h = gates.shape[-1] // n_gates
+    if kind == "simple":
+        new_h = jnp.tanh(gates)
+        return new_h, new_h
+    if kind == "lstm":
+        h_prev, c_prev = state
+        i, f, g, o = (gates[..., k * h:(k + 1) * h] for k in range(4))
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c = f * c_prev + i * jnp.tanh(g)
+        new_h = o * jnp.tanh(c)
+        return new_h, (new_h, c)
+    raise AssertionError("gru is handled by _gru_step")
+
+
+def _gru_step(params, x_t, h_prev):
+    wih, whh, bih, bhh = params
+    hs = whh.shape[1]
+    xg = x_t @ wih.T + bih                      # (B, 3H)
+    hg = h_prev @ whh.T + bhh
+    xr, xz, xc = (xg[..., k * hs:(k + 1) * hs] for k in range(3))
+    hr, hz, hc = (hg[..., k * hs:(k + 1) * hs] for k in range(3))
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    new_h = (1 - z) * c + z * h_prev
+    return new_h, new_h
+
+
+def _cell_step(kind, params, x_t, state):
+    if kind == "gru":
+        return _gru_step(params, x_t, state)
+    if kind == "lstm":
+        wih, whh, bih, bhh = params
+        gates = x_t @ wih.T + bih + state[0] @ whh.T + bhh
+        return _apply_gates(gates, state, 4, "lstm")
+    wih, whh, bih, bhh = params
+    gates = x_t @ wih.T + bih + state @ whh.T + bhh
+    return _apply_gates(gates, state, 1, "simple")
+
+
+class SimpleRNNCell(_RNNCellBase):
+    n_gates = 1
+    kind = "simple"
+
+    def forward(self, inputs, states=None):
+        st = states if states is not None else self.get_initial_states(
+            inputs.shape[0])
+        out = apply(lambda x, h, a, b, c, d: _cell_step(
+            "simple", (a, b, c, d), x, h)[0], inputs, st,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            op_name="simple_rnn_cell")
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    n_gates = 4
+    kind = "lstm"
+
+    def get_initial_states(self, batch):
+        z = Tensor(jnp.zeros((batch, self.hidden_size), jnp.float32))
+        return (z, z)
+
+    def forward(self, inputs, states=None):
+        st = states if states is not None else self.get_initial_states(
+            inputs.shape[0])
+        h, c = st
+
+        def fn(x, hv, cv, a, b, bi, bh):
+            nh, (nh2, nc) = _cell_step("lstm", (a, b, bi, bh), x, (hv, cv))
+            return nh, nc
+
+        nh, nc = apply(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, op_name="lstm_cell",
+                       n_outputs=2)
+        return nh, (nh, nc)
+
+
+class GRUCell(_RNNCellBase):
+    n_gates = 3
+    kind = "gru"
+
+    def forward(self, inputs, states=None):
+        st = states if states is not None else self.get_initial_states(
+            inputs.shape[0])
+        out = apply(lambda x, h, a, b, c, d: _gru_step(
+            (a, b, c, d), x, h)[0], inputs, st,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            op_name="gru_cell")
+        return out, out
+
+
+class _RNNBase(Layer):
+    kind = "simple"
+    n_gates = 1
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, name=None, **kwargs):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.time_major = time_major
+        ndir = 2 if self.bidirectional else 1
+        self.num_directions = ndir
+        g = self.n_gates
+        init = _uniform_init(hidden_size)
+        self._weights = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                isz = input_size if layer == 0 else hidden_size * ndir
+                wih = self.create_parameter((g * hidden_size, isz),
+                                            default_initializer=init)
+                whh = self.create_parameter((g * hidden_size, hidden_size),
+                                            default_initializer=init)
+                bih = self.create_parameter((g * hidden_size,),
+                                            default_initializer=init,
+                                            is_bias=True)
+                bhh = self.create_parameter((g * hidden_size,),
+                                            default_initializer=init,
+                                            is_bias=True)
+                names = [f"weight_ih_l{layer}", f"weight_hh_l{layer}",
+                         f"bias_ih_l{layer}", f"bias_hh_l{layer}"]
+                if d == 1:
+                    names = [n + "_reverse" for n in names]
+                for n, p in zip(names, (wih, whh, bih, bhh)):
+                    setattr(self, n, p)
+                self._weights.append((wih, whh, bih, bhh))
+
+    def _initial_state(self, batch):
+        n = self.num_layers * self.num_directions
+        z = jnp.zeros((n, batch, self.hidden_size), jnp.float32)
+        return z
+
+    def forward(self, inputs, initial_states=None):
+        kind = self.kind
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        params = [p for tup in self._weights for p in tup]
+        has_init = initial_states is not None
+        init_args = []
+        if has_init:
+            if kind == "lstm":
+                init_args = [initial_states[0], initial_states[1]]
+            else:
+                init_args = [initial_states]
+
+        def fn(x, *flat):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)                 # (T, B, I)
+            B = x.shape[1]
+            n_w = nl * nd * 4
+            ws = [tuple(flat[i * 4:(i + 1) * 4]) for i in range(nl * nd)]
+            init_h = flat[n_w] if has_init else None
+            init_c = flat[n_w + 1] if (has_init and kind == "lstm") else None
+            finals_h, finals_c = [], []
+            for layer in range(nl):
+                outs = []
+                for d in range(nd):
+                    p = ws[layer * nd + d]
+                    xs = x[::-1] if d == 1 else x
+                    slot = layer * nd + d
+                    h0 = init_h[slot] if has_init else jnp.zeros((B, hs),
+                                                                 x.dtype)
+                    if kind == "lstm":
+                        c0 = init_c[slot] if has_init else jnp.zeros(
+                            (B, hs), x.dtype)
+                        state0 = (h0, c0)
+                    else:
+                        state0 = h0
+
+                    def step(st, xt, p=p):
+                        _, new = _cell_step(kind, p, xt, st)
+                        out = new[0] if kind == "lstm" else new
+                        return new, out
+
+                    final, seq = jax.lax.scan(step, state0, xs)
+                    if d == 1:
+                        seq = seq[::-1]
+                    outs.append(seq)
+                    if kind == "lstm":
+                        finals_h.append(final[0])
+                        finals_c.append(final[1])
+                    else:
+                        finals_h.append(final)
+                x = jnp.concatenate(outs, axis=-1) if nd == 2 else outs[0]
+            out = x if time_major else jnp.swapaxes(x, 0, 1)
+            fh = jnp.stack(finals_h)
+            if kind == "lstm":
+                return out, fh, jnp.stack(finals_c)
+            return out, fh
+
+        n_outputs = 3 if kind == "lstm" else 2
+        res = apply(fn, inputs, *params, *init_args,
+                    op_name=f"{kind}_rnn", n_outputs=n_outputs)
+        if kind == "lstm":
+            out, fh, fc = res
+            return out, (fh, fc)
+        out, fh = res
+        return out, fh
+
+
+class SimpleRNN(_RNNBase):
+    kind = "simple"
+    n_gates = 1
+
+
+class LSTM(_RNNBase):
+    kind = "lstm"
+    n_gates = 4
+
+
+class GRU(_RNNBase):
+    kind = "gru"
+    n_gates = 3
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence runner (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        kind = self.cell.kind
+        time_major = self.time_major
+        rev = self.is_reverse
+        hs = self.cell.hidden_size
+        params = (self.cell.weight_ih, self.cell.weight_hh,
+                  self.cell.bias_ih, self.cell.bias_hh)
+        has_init = initial_states is not None
+        init_args = []
+        if has_init:
+            init_args = list(initial_states) if kind == "lstm"                 else [initial_states]
+
+        def fn(x, *p):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            if rev:
+                x = x[::-1]
+            B = x.shape[1]
+            if has_init:
+                state0 = (p[4], p[5]) if kind == "lstm" else p[4]
+            else:
+                h0 = jnp.zeros((B, hs), x.dtype)
+                state0 = (h0, h0) if kind == "lstm" else h0
+
+            def step(st, xt):
+                _, new = _cell_step(kind, p, xt, st)
+                return new, (new[0] if kind == "lstm" else new)
+
+            final, seq = jax.lax.scan(step, state0, x)
+            if rev:
+                seq = seq[::-1]
+            out = seq if time_major else jnp.swapaxes(seq, 0, 1)
+            if kind == "lstm":
+                return out, final[0], final[1]
+            return out, final
+
+        n_outputs = 3 if kind == "lstm" else 2
+        res = apply(fn, inputs, *params, *init_args, op_name="rnn",
+                    n_outputs=n_outputs)
+        if kind == "lstm":
+            return res[0], (res[1], res[2])
+        return res[0], res[1]

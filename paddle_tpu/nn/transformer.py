@@ -1,0 +1,282 @@
+"""Transformer layer family: MultiHeadAttention, encoder/decoder layers and
+stacks, and the seq2seq Transformer container.
+
+Rebuild of python/paddle/nn/layer/transformer.py (SURVEY.md §2.5 incubate
+row covers the FUSED variants; this is the standard paddle.nn surface).
+Attention routes through F.scaled_dot_product_attention, which dispatches
+to the Pallas flash kernel on TPU when shapes allow.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Layer, LayerList
+from .common_layers import Linear, LayerNorm, Dropout
+from ..core.tensor import Tensor
+from ..core.math_ops import concat
+
+
+class MultiHeadAttention(Layer):
+    """paddle.nn.MultiHeadAttention: (B, S, E) in/out, optional cross
+    attention (kdim/vdim), additive attn_mask broadcastable to
+    (B, H, Sq, Sk)."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        if self.head_dim * num_heads != embed_dim:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim,
+                             bias_attr=bias_attr)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim,
+                             bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr)
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def gen_cache(self, key, value=None, type=None):
+        """paddle parity: StaticCache holds precomputed cross-attention
+        K/V; Cache accumulates self-attention K/V across decode steps."""
+        if type is MultiHeadAttention.StaticCache or value is not None:
+            value = key if value is None else value
+            b = key.shape[0]
+            h, d = self.num_heads, self.head_dim
+            k = self.k_proj(key).reshape([b, key.shape[1], h, d])
+            v = self.v_proj(value).reshape([b, value.shape[1], h, d])
+            return MultiHeadAttention.StaticCache(k, v)
+        b = key.shape[0]
+        h, d = self.num_heads, self.head_dim
+        import numpy as _np
+        import jax.numpy as _jnp
+        from ..core.tensor import Tensor as _T
+        z = _T(_jnp.zeros((b, 0, h, d), _jnp.float32))
+        return MultiHeadAttention.Cache(z, z)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        b, sq, _ = query.shape
+        h, d = self.num_heads, self.head_dim
+        q = self.q_proj(query).reshape([b, sq, h, d])
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = self.k_proj(key).reshape([b, key.shape[1], h, d])
+            v = self.v_proj(value).reshape([b, value.shape[1], h, d])
+            if isinstance(cache, MultiHeadAttention.Cache):
+                k = concat([cache.k, k], axis=1)
+                v = concat([cache.v, v], axis=1)
+                cache = MultiHeadAttention.Cache(k, v)
+        if self.need_weights:
+            # the masked XLA path materialises the probabilities
+            import jax
+            import math as _math
+
+            def fn(qv, kv, vv, *rest):
+                scale = 1.0 / _math.sqrt(d)
+                s = jnp.einsum("bqhd,bkhd->bhqk", qv.astype(jnp.float32),
+                               kv.astype(jnp.float32)) * scale
+                if rest:
+                    s = s + rest[0].astype(jnp.float32)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                               vv.astype(jnp.float32)).astype(qv.dtype)
+                return o, p
+
+            from ..core.dispatch import apply as _apply
+            args = (q, k, v) + ((attn_mask,) if attn_mask is not None
+                                else ())
+            o, weights = _apply(fn, *args, op_name="mha_weights",
+                                n_outputs=2)
+            out = self.out_proj(o.reshape([b, sq, h * d]))
+            outs = (out, weights)
+        else:
+            o = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+                training=self.training, is_causal=False)
+            outs = self.out_proj(o.reshape([b, sq, h * d]))
+        if isinstance(cache, (MultiHeadAttention.Cache,
+                              MultiHeadAttention.StaticCache)):
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            return outs + (cache,)
+        return outs
+
+
+def _act(name):
+    return {"relu": F.relu, "gelu": F.gelu}[name]
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout
+            if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout = Dropout(dropout)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(act_dropout
+                                if act_dropout is not None else dropout)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = residual + self.dropout1(self.self_attn(x, attn_mask=src_mask))
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.dropout2(_act(self.activation)(
+            self.linear1(y))))
+        x = residual + self.dropout(y)
+        if not self.normalize_before:
+            x = self.norm2(x)
+        return x
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList(
+            [encoder_layer] + [copy.deepcopy(encoder_layer)
+                               for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(act_dropout
+                                if act_dropout is not None else dropout)
+        self.dropout_out = Dropout(dropout)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = residual + self.dropout1(self.self_attn(x, attn_mask=tgt_mask))
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        x = residual + self.dropout2(y)
+        if not self.normalize_before:
+            x = self.norm2(x)
+        residual = x
+        y = self.norm3(x) if self.normalize_before else x
+        y = self.linear2(self.dropout3(_act(self.activation)(
+            self.linear1(y))))
+        x = residual + self.dropout_out(y)
+        if not self.normalize_before:
+            x = self.norm3(x)
+        return x
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList(
+            [decoder_layer] + [copy.deepcopy(decoder_layer)
+                               for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask=tgt_mask,
+                        memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    """paddle.nn.Transformer: encoder-decoder seq2seq container."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length) -> Tensor:
+        m = np.triu(np.full((length, length), -np.inf, np.float32), k=1)
+        return Tensor(jnp.asarray(m))
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)

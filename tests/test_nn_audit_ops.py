@@ -1,0 +1,234 @@
+"""Round-2 nn.functional audit batch: N-D pooling, conv transposes,
+activations, loss zoo, CTC (vs brute-force path enumeration)."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+R = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_pool_1d_3d():
+    x1 = _t(R.randn(2, 3, 8).astype(np.float32))
+    assert tuple(F.max_pool1d(x1, 2).shape) == (2, 3, 4)
+    assert tuple(F.avg_pool1d(x1, 2).shape) == (2, 3, 4)
+    assert tuple(F.adaptive_avg_pool1d(x1, 2).shape) == (2, 3, 2)
+    assert tuple(F.adaptive_max_pool1d(x1, 4).shape) == (2, 3, 4)
+    x3 = _t(R.randn(1, 2, 4, 4, 4).astype(np.float32))
+    assert tuple(F.max_pool3d(x3, 2).shape) == (1, 2, 2, 2, 2)
+    assert tuple(F.avg_pool3d(x3, 2).shape) == (1, 2, 2, 2, 2)
+    assert tuple(F.adaptive_avg_pool3d(x3, 2).shape) == (1, 2, 2, 2, 2)
+    # avg matches numpy on a simple case
+    got = np.asarray(F.avg_pool1d(x1, 2)._value)
+    ref = np.asarray(x1._value).reshape(2, 3, 4, 2).mean(-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_conv_transposes_roundtrip_shapes():
+    x = _t(R.randn(1, 4, 5).astype(np.float32))
+    w = _t((R.randn(4, 3, 2) * 0.1).astype(np.float32))  # [in, out, k]
+    y = F.conv1d_transpose(x, w, stride=2)
+    assert tuple(y.shape) == (1, 3, 10)
+    x3 = _t(R.randn(1, 2, 3, 3, 3).astype(np.float32))
+    w3 = _t((R.randn(2, 2, 2, 2, 2) * 0.1).astype(np.float32))
+    y3 = F.conv3d_transpose(x3, w3, stride=2)
+    assert tuple(y3.shape) == (1, 2, 6, 6, 6)
+
+
+def test_activations():
+    x = _t(R.randn(4, 6).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(F.log_sigmoid(x)._value),
+        np.asarray(jax.nn.log_sigmoid(np.asarray(x._value))), rtol=1e-5)
+    g = F.glu(x, axis=-1)
+    assert tuple(g.shape) == (4, 3)
+    mo = F.maxout(_t(R.randn(2, 6, 3).astype(np.float32)), groups=3, axis=1)
+    assert tuple(mo.shape) == (2, 2, 3)
+    tr = np.asarray(F.thresholded_relu(_t(np.asarray([0.5, 2.0],
+                                                     np.float32)))._value)
+    np.testing.assert_allclose(tr, [0.0, 2.0])
+    paddle.seed(0)
+    rr = np.asarray(F.rrelu(_t(np.full((1000,), -1.0, np.float32)))._value)
+    assert (rr <= -1 / 8 + 1e-6).all() and (rr >= -1 / 3 - 1e-6).all()
+    ri = np.asarray(F.rrelu(_t(np.asarray([-1.0], np.float32)),
+                            training=False)._value)
+    np.testing.assert_allclose(ri, [-(1 / 8 + 1 / 3) / 2], rtol=1e-6)
+
+
+def test_lrn_and_dropout3d():
+    x = _t(R.randn(2, 6, 4, 4).astype(np.float32))
+    out = F.local_response_norm(x, size=3)
+    assert out.shape == x.shape
+    # k=1, alpha small -> close to identity
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(x._value), rtol=1e-2, atol=1e-2)
+    paddle.seed(1)
+    x5 = _t(np.ones((2, 8, 2, 2, 2), np.float32))
+    d = np.asarray(F.dropout3d(x5, p=0.5)._value)
+    per_channel = d.reshape(2, 8, -1)
+    # each channel fully kept (scaled) or fully dropped
+    assert all(len(np.unique(per_channel[i, j])) == 1
+               for i in range(2) for j in range(8))
+
+
+def test_simple_losses():
+    p = _t(np.asarray([0.9, 0.2], np.float32))
+    y = _t(np.asarray([1.0, 0.0], np.float32))
+    ll = np.asarray(F.log_loss(p, y)._value)
+    np.testing.assert_allclose(
+        ll, [-np.log(0.9 + 1e-4), -np.log(0.8 + 1e-4)], rtol=1e-4)
+
+    x = _t(R.randn(6).astype(np.float32))
+    t = _t(np.sign(R.randn(6)).astype(np.float32))
+    sm = float(F.soft_margin_loss(x, t)._value)
+    ref = np.log1p(np.exp(-np.asarray(t._value)
+                          * np.asarray(x._value))).mean()
+    np.testing.assert_allclose(sm, ref, rtol=1e-5)
+
+    a, b, n = (_t(R.randn(4, 8).astype(np.float32)) for _ in range(3))
+    tl = float(F.triplet_margin_loss(a, b, n)._value)
+    assert np.isfinite(tl) and tl >= 0
+    pd = F.pairwise_distance(a, b)
+    assert tuple(pd.shape) == (4,)
+    ce = float(F.cosine_embedding_loss(
+        a, b, _t(np.asarray([1, -1, 1, -1], np.float32)))._value)
+    assert np.isfinite(ce)
+    fo = float(F.sigmoid_focal_loss(
+        x, _t((np.sign(np.asarray(t._value)) > 0)
+              .astype(np.float32)))._value)
+    assert np.isfinite(fo)
+    gn = float(F.gaussian_nll_loss(a, b, _t(np.ones((4, 8),
+                                                    np.float32)))._value)
+    assert np.isfinite(gn)
+    pn = float(F.poisson_nll_loss(a, _t(np.abs(np.asarray(b._value))))._value)
+    assert np.isfinite(pn)
+    ml = float(F.multi_label_soft_margin_loss(
+        a, _t((R.rand(4, 8) > 0.5).astype(np.float32)))._value)
+    assert np.isfinite(ml)
+    he = float(F.hinge_embedding_loss(
+        a, _t(np.sign(R.randn(4, 8)).astype(np.float32)))._value)
+    assert np.isfinite(he)
+    dl = float(F.dice_loss(
+        _t(jax.nn.softmax(R.randn(2, 5, 3).astype(np.float32))),
+        _t(R.randint(0, 3, (2, 5, 1)).astype(np.int64)))._value)
+    assert 0 <= dl <= 1
+    npl = float(F.npair_loss(a, b, _t(np.asarray([0, 1, 0, 1],
+                                                 np.int64)))._value)
+    assert np.isfinite(npl)
+
+
+def test_margin_cross_entropy_reduces_to_softmax_at_zero_margin():
+    logits = _t((R.rand(4, 6).astype(np.float32) - 0.5))  # in [-0.5, 0.5]
+    y = _t(np.asarray([0, 2, 4, 5], np.int64))
+    out = float(F.margin_cross_entropy(logits, y, margin1=1.0, margin2=0.0,
+                                       margin3=0.0, scale=1.0)._value)
+    lf = np.asarray(logits._value)
+    ref = -np.take_along_axis(
+        np.asarray(jax.nn.log_softmax(lf, axis=-1)),
+        np.asarray(y._value, np.int64)[:, None], axis=1).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def _ctc_bruteforce(log_probs, label, T):
+    """Sum over all alignments that collapse to `label`."""
+    C = log_probs.shape[-1]
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks (blank=0)
+        out = []
+        prev = None
+        for s in path:
+            if s != prev:
+                out.append(s)
+            prev = s
+        out = [s for s in out if s != 0]
+        if out == list(label):
+            lp = sum(log_probs[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_ctc_loss_matches_bruteforce():
+    T, B, C, L = 4, 2, 3, 2
+    paddle.seed(0)
+    logits = R.randn(T, B, C).astype(np.float32)
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    labels = np.asarray([[1, 2], [2, 0]], np.int32)  # second: length 1
+    ilen = np.asarray([4, 3], np.int32)
+    llen = np.asarray([2, 1], np.int32)
+    got = np.asarray(F.ctc_loss(_t(logp), _t(labels), _t(ilen), _t(llen),
+                                reduction="none")._value)
+    ref0 = _ctc_bruteforce(logp[:, 0], [1, 2], 4)
+    ref1 = _ctc_bruteforce(logp[:3, 1], [2], 3)
+    np.testing.assert_allclose(got, [ref0, ref1], rtol=1e-4)
+
+
+def test_ctc_loss_grad_flows():
+    logp = _t(np.asarray(jax.nn.log_softmax(
+        R.randn(5, 1, 4).astype(np.float32), axis=-1)))
+    logp.stop_gradient = False
+    loss = F.ctc_loss(logp, _t(np.asarray([[1, 2]], np.int32)),
+                      _t(np.asarray([5], np.int32)),
+                      _t(np.asarray([2], np.int32)))
+    loss.backward()
+    assert logp.grad is not None
+    assert np.isfinite(np.asarray(logp.grad._value)).all()
+
+
+def test_functional_reexports():
+    x = _t(R.randn(1, 4, 4, 4).astype(np.float32))
+    assert tuple(F.pixel_unshuffle(x, 2).shape) == (1, 16, 2, 2)
+    assert tuple(F.channel_shuffle(x, 2).shape) == (1, 4, 4, 4)
+
+
+def test_conv2d_transpose_matches_scatter_oracle():
+    """Round-2 fix: the old transpose_kernel path transposed channel mixing
+    and rejected in_c != out_c. Oracle: explicit scatter accumulation."""
+    def oracle(x, w, s, p):
+        n, ci, H, W_ = x.shape
+        _, co, kh, kw = w.shape
+        full = np.zeros((n, co, (H - 1) * s + kh, (W_ - 1) * s + kw),
+                        np.float32)
+        for nn in range(n):
+            for i in range(ci):
+                for o in range(co):
+                    for h in range(H):
+                        for ww in range(W_):
+                            full[nn, o, h * s:h * s + kh,
+                                 ww * s:ww * s + kw] += x[nn, i, h, ww] * w[i, o]
+        return full[:, :, p:full.shape[2] - p, p:full.shape[3] - p] \
+            if p else full
+
+    x = R.randn(2, 4, 3, 3).astype(np.float32)
+    w = R.randn(4, 3, 2, 2).astype(np.float32)
+    for s, p in [(1, 0), (2, 0), (2, 1)]:
+        got = np.asarray(F.conv2d_transpose(_t(x), _t(w), stride=s,
+                                            padding=p)._value)
+        np.testing.assert_allclose(got, oracle(x, w, s, p), rtol=1e-4,
+                                   atol=1e-5, err_msg=f"s={s} p={p}")
+
+
+def test_conv2d_transpose_grouped():
+    x = R.randn(1, 4, 3, 3).astype(np.float32)
+    w = R.randn(4, 2, 2, 2).astype(np.float32)  # groups=2: [in, out/g, k, k]
+    got = np.asarray(F.conv2d_transpose(_t(x), _t(w), stride=1,
+                                        groups=2)._value)
+    # per-group scatter oracle
+    full = np.zeros((1, 4, 4, 4), np.float32)
+    for g in range(2):
+        for i in range(2):
+            for o in range(2):
+                for h in range(3):
+                    for ww in range(3):
+                        full[0, g * 2 + o, h:h + 2, ww:ww + 2] += \
+                            x[0, g * 2 + i, h, ww] * w[g * 2 + i, o]
+    np.testing.assert_allclose(got, full, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,268 @@
+"""Round-2 layer-audit batch: RNN family, Transformer surface, wrappers."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+R = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestRNN:
+    def test_lstm_shapes_and_scan_matches_cell_loop(self):
+        paddle.seed(0)
+        lstm = nn.LSTM(input_size=4, hidden_size=6)
+        x = _t(R.randn(2, 5, 4).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert tuple(out.shape) == (2, 5, 6)
+        assert tuple(h.shape) == (1, 2, 6) == tuple(c.shape)
+        # final h equals last output step
+        np.testing.assert_allclose(np.asarray(h._value)[0],
+                                   np.asarray(out._value)[:, -1], rtol=1e-5)
+        # scan output == stepping the cell with the same weights
+        cell = nn.LSTMCell(4, 6)
+        cell.weight_ih._value = lstm.weight_ih_l0._value
+        cell.weight_hh._value = lstm.weight_hh_l0._value
+        cell.bias_ih._value = lstm.bias_ih_l0._value
+        cell.bias_hh._value = lstm.bias_hh_l0._value
+        st = None
+        for tstep in range(5):
+            y, st = cell(_t(np.asarray(x._value)[:, tstep]), st)
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   np.asarray(out._value)[:, -1],
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("klass", [nn.SimpleRNN, nn.GRU])
+    def test_rnn_variants_forward(self, klass):
+        paddle.seed(1)
+        rnn = klass(input_size=3, hidden_size=5, num_layers=2,
+                    direction="bidirect")
+        x = _t(R.randn(2, 4, 3).astype(np.float32))
+        out, h = rnn(x)
+        assert tuple(out.shape) == (2, 4, 10)      # bi: 2*hidden
+        assert tuple(h.shape) == (4, 2, 5)         # layers*dirs
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_rnn_trains(self):
+        paddle.seed(2)
+        rnn = nn.GRU(input_size=3, hidden_size=4)
+        head = nn.Linear(4, 1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2,
+            parameters=list(rnn.parameters()) + list(head.parameters()))
+        x = _t(R.randn(8, 6, 3).astype(np.float32))
+        y = _t(R.randn(8, 1).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            out, h = rnn(x)
+            loss = ((head(out[:, -1]) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_rnn_cell_wrapper(self):
+        paddle.seed(3)
+        cell = nn.GRUCell(3, 5)
+        runner = nn.RNN(cell)
+        x = _t(R.randn(2, 4, 3).astype(np.float32))
+        out, h = runner(x)
+        assert tuple(out.shape) == (2, 4, 5)
+        np.testing.assert_allclose(np.asarray(h._value),
+                                   np.asarray(out._value)[:, -1], rtol=1e-5)
+
+
+class TestTransformer:
+    def test_mha_self_attention_matches_manual(self):
+        import jax
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = _t(R.randn(2, 5, 8).astype(np.float32))
+        out = mha(x)
+        assert tuple(out.shape) == (2, 5, 8)
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_encoder_decoder_pipeline(self):
+        paddle.seed(1)
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32,
+                               dropout=0.0)
+        src = _t(R.randn(2, 6, 16).astype(np.float32))
+        tgt = _t(R.randn(2, 4, 16).astype(np.float32))
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        out = model(src, tgt, tgt_mask=mask)
+        assert tuple(out.shape) == (2, 4, 16)
+        # stacked layers have DISTINCT parameters (deepcopy, not aliasing)
+        p0 = model.encoder.layers[0].linear1.weight
+        p1 = model.encoder.layers[1].linear1.weight
+        assert p0 is not p1
+
+    def test_causal_mask_blocks_future(self):
+        paddle.seed(2)
+        layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+        layer.eval()
+        mem = _t(R.randn(1, 3, 8).astype(np.float32))
+        t1 = R.randn(1, 4, 8).astype(np.float32)
+        t2 = t1.copy()
+        t2[0, -1] += 10.0  # change the LAST position only
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        o1 = np.asarray(layer(_t(t1), mem, tgt_mask=mask)._value)
+        o2 = np.asarray(layer(_t(t2), mem, tgt_mask=mask)._value)
+        np.testing.assert_allclose(o1[0, :-1], o2[0, :-1], rtol=1e-5,
+                                   atol=1e-6)
+        assert np.abs(o1[0, -1] - o2[0, -1]).max() > 1e-3
+
+
+class TestExtraLayers:
+    def test_pool_pad_upsample(self):
+        x = _t(R.randn(2, 3, 8).astype(np.float32))
+        assert tuple(nn.MaxPool1D(2)(x).shape) == (2, 3, 4)
+        assert tuple(nn.AvgPool1D(2)(x).shape) == (2, 3, 4)
+        assert tuple(nn.AdaptiveAvgPool1D(2)(x).shape) == (2, 3, 2)
+        assert tuple(nn.Pad1D(1)(x).shape) == (2, 3, 10)
+        x4 = _t(R.randn(1, 2, 4, 4).astype(np.float32))
+        assert tuple(nn.ZeroPad2D(1)(x4).shape) == (1, 2, 6, 6)
+        assert tuple(nn.UpsamplingBilinear2D(scale_factor=2)(x4).shape) \
+            == (1, 2, 8, 8)
+        x5 = _t(R.randn(1, 2, 3, 3, 3).astype(np.float32))
+        assert tuple(nn.Pad3D(1)(x5).shape) == (1, 2, 5, 5, 5)
+
+    def test_glu_bilinear_instance_norm(self):
+        x = _t(R.randn(2, 8).astype(np.float32))
+        assert tuple(nn.GLU()(x).shape) == (2, 4)
+        paddle.seed(0)
+        bl = nn.Bilinear(3, 4, 5)
+        out = bl(_t(R.randn(2, 3).astype(np.float32)),
+                 _t(R.randn(2, 4).astype(np.float32)))
+        assert tuple(out.shape) == (2, 5)
+        inorm = nn.InstanceNorm1D(3)
+        y = inorm(_t(R.randn(2, 3, 16).astype(np.float32)))
+        m = np.asarray(y._value).mean(-1)
+        np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+
+    def test_losses_and_distances(self):
+        a = _t(R.randn(4, 6).astype(np.float32))
+        b = _t(R.randn(4, 6).astype(np.float32))
+        h = float(nn.HuberLoss()(a, b)._value)
+        # huber <= mse/2 elementwise mean
+        mse = ((np.asarray(a._value) - np.asarray(b._value)) ** 2).mean()
+        assert 0 <= h <= mse / 2 + 1e-6
+        lbl = _t(np.sign(R.randn(4)).astype(np.float32))
+        mr = float(nn.MarginRankingLoss()(a[:, 0], b[:, 0], lbl)._value)
+        assert np.isfinite(mr)
+        tm = float(nn.TripletMarginLoss()(a, b, _t(
+            R.randn(4, 6).astype(np.float32)))._value)
+        assert tm >= 0
+        cs = nn.CosineSimilarity(axis=-1)(a, b)
+        assert tuple(cs.shape) == (4,)
+        pdist = nn.PairwiseDistance()(a, b)
+        assert tuple(pdist.shape) == (4,)
+
+    def test_unfold_fold_wrappers(self):
+        x = _t(R.randn(1, 2, 6, 6).astype(np.float32))
+        cols = nn.Unfold(2, strides=2)(x)
+        back = nn.Fold((6, 6), 2, strides=2)(cols)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x._value), rtol=1e-6)
+
+    def test_spectral_norm_unit_sigma(self):
+        paddle.seed(0)
+        sn = nn.SpectralNorm(weight_shape=[6, 4], power_iters=20)
+        w = _t(R.randn(6, 4).astype(np.float32))
+        out = sn(w)
+        s = np.linalg.svd(np.asarray(out._value), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_alpha_dropout_preserves_moments(self):
+        paddle.seed(1)
+        ad = nn.AlphaDropout(p=0.3)
+        x = _t(R.randn(20000).astype(np.float32))
+        y = np.asarray(ad(x)._value)
+        assert abs(y.mean()) < 0.05 and abs(y.std() - 1.0) < 0.1
+
+
+class TestReviewRegressions:
+    """Round-2 review findings on the layer/functional audit batch."""
+
+    def test_rnn_initial_states_honored(self):
+        paddle.seed(0)
+        lstm = nn.LSTM(input_size=3, hidden_size=4)
+        x = _t(R.randn(2, 3, 3).astype(np.float32))
+        h0 = _t(np.ones((1, 2, 4), np.float32))
+        c0 = _t(np.ones((1, 2, 4), np.float32))
+        out0, _ = lstm(x)
+        out1, _ = lstm(x, (h0, c0))
+        assert np.abs(np.asarray(out0._value)
+                      - np.asarray(out1._value)).max() > 1e-4
+
+    def test_max_pool_return_mask_and_ceil(self):
+        import paddle_tpu.nn.functional as F
+        x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        np.testing.assert_allclose(np.asarray(mask._value).ravel(),
+                                   [5, 7, 13, 15])
+        x7 = _t(np.arange(7, dtype=np.float32).reshape(1, 1, 7))
+        assert tuple(F.max_pool1d(x7, 2, stride=2,
+                                  ceil_mode=True).shape) == (1, 1, 4)
+
+    def test_mha_need_weights_and_cache(self):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(8, 2, need_weights=True)
+        mha.eval()
+        x = _t(R.randn(1, 4, 8).astype(np.float32))
+        out, w = mha(x)
+        assert tuple(w.shape) == (1, 2, 4, 4)
+        np.testing.assert_allclose(np.asarray(w._value).sum(-1),
+                                   np.ones((1, 2, 4)), rtol=1e-5)
+
+        dec = nn.MultiHeadAttention(8, 2)
+        dec.eval()
+        cache = dec.gen_cache(x[:, :0])
+        outs1, cache = dec(x[:, :1], cache=cache)[0], dec(
+            x[:, :1], cache=dec.gen_cache(x[:, :0]))[1]
+        assert cache.k.shape[1] == 1  # accumulated one step
+
+    def test_ctc_mean_divides_by_label_length(self):
+        import jax
+        import paddle_tpu.nn.functional as F
+        logp = _t(np.asarray(jax.nn.log_softmax(
+            R.randn(4, 1, 3).astype(np.float32), axis=-1)))
+        labels = _t(np.asarray([[1, 2]], np.int32))
+        ilen = _t(np.asarray([4], np.int32))
+        llen = _t(np.asarray([2], np.int32))
+        none = np.asarray(F.ctc_loss(logp, labels, ilen, llen,
+                                     reduction="none")._value)
+        mean = float(F.ctc_loss(logp, labels, ilen, llen,
+                                reduction="mean")._value)
+        np.testing.assert_allclose(mean, none[0] / 2.0, rtol=1e-5)
+
+    def test_lrn_matches_size_normalised_formula(self):
+        import paddle_tpu.nn.functional as F
+        x = np.abs(R.randn(1, 5, 2, 2)).astype(np.float32) + 1.0
+        out = np.asarray(F.local_response_norm(
+            _t(x), size=3, alpha=1.0, beta=1.0, k=1.0)._value)
+        # manual: div = 1 + (1/3) * sum_{neighbourhood} x^2
+        sq = x ** 2
+        acc = np.zeros_like(x)
+        for c in range(5):
+            lo, hi = max(0, c - 1), min(5, c + 2)
+            acc[:, c] = sq[:, lo:hi].sum(axis=1)
+        ref = x / (1.0 + acc / 3.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_conv1d_transpose_nlc(self):
+        import paddle_tpu.nn.functional as F
+        x = R.randn(1, 5, 4).astype(np.float32)  # NLC
+        w = R.randn(4, 3, 2).astype(np.float32)
+        out = F.conv1d_transpose(_t(x), _t(w), stride=2, data_format="NLC")
+        ref = F.conv1d_transpose(_t(np.swapaxes(x, 1, 2)), _t(w), stride=2)
+        np.testing.assert_allclose(
+            np.asarray(out._value),
+            np.swapaxes(np.asarray(ref._value), 1, 2), rtol=1e-5)
