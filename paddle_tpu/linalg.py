@@ -141,3 +141,166 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 
 def multi_dot(xs, name=None):
     return _op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *xs)
+
+
+# ---------------------------------------------------------------------------
+# round-2 audit batch
+# ---------------------------------------------------------------------------
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A z = x given y = cholesky factor of A (paddle arg order:
+    x is the right-hand side, y the factor)."""
+    import jax
+
+    def fn(b, L):
+        if upper:
+            z = jax.scipy.linalg.solve_triangular(L, b, lower=False,
+                                                  trans="T")
+            return jax.scipy.linalg.solve_triangular(L, z, lower=False)
+        z = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(L, z, lower=True, trans="T")
+
+    return _op("cholesky_solve", fn, x, y)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    extra = [w for w in (fweights, aweights) if w is not None]
+    has_f, has_a = fweights is not None, aweights is not None
+
+    def fn(v, *ws):
+        it = iter(ws)
+        fw = next(it) if has_f else None
+        aw = next(it) if has_a else None
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    return _op("cov", fn, x, *extra)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization. Returns (LU-packed, pivots[, infos]) — paddle
+    layout: pivots are 1-based row-swap indices."""
+    import jax
+
+    def fn(v):
+        packed, pivots = jax.scipy.linalg.lu_factor(v)
+        outs = (packed, pivots.astype(jnp.int32) + 1)
+        if get_infos:
+            outs = outs + (jnp.zeros((), jnp.int32),)
+        return outs
+
+    return _op("lu", fn, x, n_outputs=3 if get_infos else 2)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """(P, L, U) from the packed LU factorization."""
+    def fn2d(packed, piv):
+        m = packed.shape[-2]
+        n = packed.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(packed[:, :k], -1) + jnp.eye(m, k, dtype=packed.dtype)
+        U = jnp.triu(packed[:k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[i] - 1
+            pi = perm[i]
+            perm = perm.at[i].set(perm[j]).at[j].set(pi)
+        P = jnp.eye(m, dtype=packed.dtype)[perm].T
+        return P, L, U
+
+    def fn(packed, piv):
+        f = fn2d
+        for _ in range(packed.ndim - 2):  # batched: vmap leading dims
+            f = jax.vmap(f)
+        return f(packed, piv)
+
+    import jax
+    return _op("lu_unpack", fn, lu_data, lu_pivots, n_outputs=3)
+
+
+def _householder_full_2d(a, t):
+    m = a.shape[0]
+    q = jnp.eye(m, dtype=a.dtype)
+    for i in range(t.shape[0]):
+        v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0)
+        v = v.at[i].set(1.0)
+        q = q - t[i] * (q @ v)[:, None] * v[None, :]
+    return q
+
+
+def _householder_full(a, t):
+    """Full m x m  Q = H_0 H_1 ... from geqrf-packed reflectors
+    (batched via vmap over leading dims)."""
+    import jax
+
+    f = _householder_full_2d
+    for _ in range(a.ndim - 2):
+        f = jax.vmap(f)
+    return f(a, t)
+
+
+def householder_product(x, tau, name=None):
+    """Q (economy, m x n) from Householder reflectors (geqrf layout) —
+    paddle.linalg.householder_product."""
+    def fn(a, t):
+        return _householder_full(a, t)[..., :, :a.shape[-1]]
+
+    return _op("householder_product", fn, x, tau)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by the FULL m x m Q of a geqrf factorization
+    (LAPACK ormqr semantics)."""
+    def fn(a, t, ov):
+        qq = _householder_full(a, t)
+        if transpose:
+            qq = jnp.swapaxes(qq, -1, -2)
+        return qq @ ov if left else ov @ qq
+
+    return _op("ormqr", fn, x, tau, other)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD (Halko et al.) — paddle.linalg.svd_lowrank."""
+    import jax
+
+    extra = [M] if M is not None else []
+
+    def fn(a, *rest):
+        if rest:
+            a = a - rest[0]  # paddle: SVD of A - M (the PCA/centered path)
+        mT = lambda z: jnp.swapaxes(z, -1, -2)  # noqa: E731 — batch-safe
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(q, m, n)
+        # fixed-seed sketch: deterministic under jit, adequate for the
+        # low-rank approximation contract
+        g = jax.random.normal(jax.random.key(0), (n, k), a.dtype)
+        y = a @ g
+        for _ in range(niter):
+            y = a @ (mT(a) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = mT(qmat) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, mT(vh)
+
+    return _op("svd_lowrank", fn, x, *extra, n_outputs=3)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.linalg.vector_norm(v, ord=p, axis=axis, keepdims=keepdim)
+    return _op("vector_norm", fn, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    ax = tuple(axis)
+
+    def fn(v):
+        vm = jnp.moveaxis(v, ax, (-2, -1))
+        return jnp.linalg.matrix_norm(vm, ord=p, keepdims=keepdim)
+    return _op("matrix_norm", fn, x)

@@ -95,3 +95,92 @@ def test_matrix_rank_absolute_tol():
     d = np.diag([100.0, 1.0, 1e-4]).astype(np.float32)
     assert int(linalg.matrix_rank(_t(d), tol=1e-3)._value) == 2
     assert int(linalg.matrix_rank(_t(d))._value) == 3  # default eps-based
+
+
+class TestRound2Batch:
+    """cholesky_solve / cov / corrcoef / lu(+unpack) / householder_product /
+    ormqr / svd_lowrank / vector_norm / matrix_norm (audit closure)."""
+
+    def test_cholesky_solve_and_lu_roundtrip(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 5).astype(np.float32)
+        a = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+        b = rng.randn(5, 3).astype(np.float32)
+        f = linalg.cholesky(paddle.to_tensor(a))
+        z = np.asarray(linalg.cholesky_solve(paddle.to_tensor(b), f)._value)
+        np.testing.assert_allclose(a @ z, b, atol=1e-3)
+
+        packed, piv = linalg.lu(paddle.to_tensor(a))
+        P, L, U = linalg.lu_unpack(packed, piv)
+        np.testing.assert_allclose(
+            np.asarray(P._value) @ np.asarray(L._value)
+            @ np.asarray(U._value), a, atol=1e-3)
+
+    @staticmethod
+    def _np_geqrf(m):
+        """Reference Householder QR in geqrf layout (packed + tau)."""
+        a = m.astype(np.float64).copy()
+        rows, cols = a.shape
+        tau = np.zeros(min(rows, cols))
+        for i in range(min(rows, cols)):
+            x = a[i:, i].copy()
+            normx = np.linalg.norm(x)
+            alpha = -np.sign(x[0] or 1.0) * normx
+            v = x.copy()
+            v[0] -= alpha
+            vn = np.linalg.norm(v)
+            if vn < 1e-12:
+                tau[i] = 0.0
+                continue
+            v = v / v[0]
+            tau[i] = (alpha - x[0]) / alpha * 0 + 2.0 / (v @ v)
+            a[i:, i:] -= np.outer(v * tau[i], v @ a[i:, i:])
+            a[i + 1:, i] = v[1:]
+        return a, tau
+
+    def test_householder_product_matches_qr(self):
+        rng = np.random.RandomState(1)
+        m = rng.randn(5, 3).astype(np.float32)
+        a, tau = self._np_geqrf(m)
+        q = np.asarray(linalg.householder_product(
+            paddle.to_tensor(a.astype(np.float32)),
+            paddle.to_tensor(tau.astype(np.float32)))._value)
+        # Q orthonormal and Q @ R reconstructs m
+        np.testing.assert_allclose(q.T @ q, np.eye(3), atol=1e-4)
+        r = np.triu(a[:3, :])
+        np.testing.assert_allclose(q @ r, m, atol=1e-4)
+        # ormqr: Q @ other
+        other = rng.randn(5, 2).astype(np.float32)
+        got = np.asarray(linalg.ormqr(
+            paddle.to_tensor(a.astype(np.float32)),
+            paddle.to_tensor(tau.astype(np.float32)),
+            paddle.to_tensor(other))._value)
+        # full m x m Q applied to other
+        qf = np.eye(5)
+        for i in range(3):
+            v = np.zeros(5)
+            v[i] = 1.0
+            v[i + 1:] = a[i + 1:, i]
+            qf = qf - tau[i] * np.outer(qf @ v, v)
+        np.testing.assert_allclose(got, qf @ other, atol=1e-4)
+
+    def test_cov_corrcoef_norms_lowrank(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 10).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.cov(paddle.to_tensor(x))._value),
+            np.cov(x), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.corrcoef(paddle.to_tensor(x))._value),
+            np.corrcoef(x), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            float(linalg.vector_norm(paddle.to_tensor(x))._value),
+            np.linalg.norm(x.ravel()), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(linalg.matrix_norm(paddle.to_tensor(x))._value),
+            np.linalg.norm(x, "fro"), rtol=1e-5)
+        m = rng.randn(8, 4).astype(np.float32)
+        u, s, v = linalg.svd_lowrank(paddle.to_tensor(m), q=4)
+        approx = np.asarray(u._value) @ np.diag(np.asarray(s._value)) \
+            @ np.asarray(v._value).T
+        np.testing.assert_allclose(approx, m, atol=1e-3)
