@@ -189,7 +189,14 @@ def lu(x, pivot=True, get_infos=False, name=None):
         packed, pivots = jax.scipy.linalg.lu_factor(v)
         outs = (packed, pivots.astype(jnp.int32) + 1)
         if get_infos:
-            outs = outs + (jnp.zeros((), jnp.int32),)
+            # LAPACK getrf info: 1-based index of the first zero pivot on
+            # the U diagonal, 0 on success (per matrix for batched input)
+            diag = jnp.diagonal(packed, axis1=-2, axis2=-1)
+            zero = diag == 0
+            first = jnp.argmax(zero, axis=-1) + 1
+            info = jnp.where(jnp.any(zero, axis=-1), first, 0) \
+                .astype(jnp.int32)
+            outs = outs + (info,)
         return outs
 
     return _op("lu", fn, x, n_outputs=3 if get_infos else 2)
@@ -282,7 +289,10 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         g = jax.random.normal(jax.random.key(0), (n, k), a.dtype)
         y = a @ g
         for _ in range(niter):
-            y = a @ (mT(a) @ y)
+            # re-orthonormalize each iteration: without it y scales as
+            # sigma_max^(2*niter+1) and overflows fp32 for large inputs
+            qy, _ = jnp.linalg.qr(y)
+            y = a @ (mT(a) @ qy)
         qmat, _ = jnp.linalg.qr(y)
         b = mT(qmat) @ a
         u, s, vh = jnp.linalg.svd(b, full_matrices=False)
@@ -302,5 +312,9 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
 
     def fn(v):
         vm = jnp.moveaxis(v, ax, (-2, -1))
-        return jnp.linalg.matrix_norm(vm, ord=p, keepdims=keepdim)
+        out = jnp.linalg.matrix_norm(vm, ord=p, keepdims=keepdim)
+        if keepdim:
+            # restore the kept 1-dims to the REDUCED axes' positions
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
     return _op("matrix_norm", fn, x)

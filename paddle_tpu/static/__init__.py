@@ -133,3 +133,69 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
     if target is None:
         raise ValueError("save_inference_model needs layer= (an nn.Layer)")
     _save(target, path_prefix, input_spec=spec)
+
+
+# ---------------------------------------------------------------------------
+# legacy static-era script surface (round-2): the names static scripts
+# import at module top. Graph BUILDING stays replaced by jax tracing (the
+# design stance above); these shims let eval/serving scripts that only
+# feed/fetch keep working unchanged.
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder declaration → InputSpec (the reference creates a graph
+    Variable; under tracing the spec is what jit.to_static consumes)."""
+    return InputSpec([s if s is not None and s >= 0 else None
+                      for s in shape], dtype, name)
+
+
+@_contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """No-op scope: programs are traced, not built (kept so `with
+    paddle.static.program_guard(...)` blocks run unchanged)."""
+    yield
+
+
+@_contextlib.contextmanager
+def scope_guard(scope=None):
+    yield
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+class _GlobalScope:
+    def find_var(self, name):
+        return None
+
+    def var(self, name):
+        return None
+
+
+_scope = _GlobalScope()
+
+
+def global_scope():
+    return _scope
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import TPUPlace
+    import jax as _jax
+    n = len(_jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def cpu_places(device_count=1):
+    from ..core.place import CPUPlace
+    return [CPUPlace() for _ in range(device_count)]
