@@ -37,12 +37,19 @@ def fused_allreduce_gradients(parameter_list: Sequence, hcg=None,
         # single controller, no multi-process dp group: grads are already
         # globally reduced (they were computed from the global batch)
         return
+    if scale is None:
+        # comm() all-reduces replicated copies (nranks * grad under one
+        # controller), so the dp average requires dividing by the group
+        # size. Default it so reference-convention callers
+        # fused_allreduce_gradients(params, hcg) can't get inflated grads;
+        # pass scale=1.0 explicitly to opt out.
+        scale = float(group.nranks)
     for buf in fused_parameters(params, comm_group=group,
                                 use_main_grad=use_main_grad):
         for p in buf._params:
             buf.add_grad(p)
         buf.comm()
-        if scale is not None:
+        if scale != 1.0:
             # dp averaging (reference divides the reduced grads by the dp
             # degree); done on the flat buffer before scatter so each param
             # slice is written back exactly once.

@@ -339,7 +339,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
                          dilation, groups, nd, op_name,
-                         _channel_last=False):
+                         _channel_last=False, output_size=None):
     """Transpose conv as a fractionally-strided conv_general_dilated
     (lhs_dilation = stride) — the only jax formulation that supports
     groups. Paddle weight layout [in_c, out_c/groups, *k]; the kernel is
@@ -356,6 +356,24 @@ def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
         raise NotImplementedError("string padding for conv_transpose")
     op = _pair(output_padding, nd)
     channel_last = _channel_last
+    if output_size is not None:
+        # derive the output_padding that realises the requested size:
+        # out = (in-1)*s - p_lo - p_hi + d*(k-1) + 1 + op
+        osz = _pair(output_size, nd)
+        in_sp = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
+        ksp = weight.shape[2:2 + nd]
+        op = []
+        for i in range(nd):
+            base = ((in_sp[i] - 1) * s[i] - pad[i][0] - pad[i][1]
+                    + d[i] * (ksp[i] - 1) + 1)
+            o = osz[i] - base
+            # paddle constraint: output_padding < max(stride, dilation)
+            if not 0 <= o < max(s[i], d[i]):
+                raise ValueError(
+                    f"{op_name}: output_size[{i}]={osz[i]} unreachable "
+                    f"(base size {base}, stride {s[i]})")
+            op.append(o)
+        op = tuple(op)
     lhs_spec = {1: "NCH", 2: "NCHW", 3: "NCDHW"}[nd] if not channel_last \
         else {1: "NHC", 2: "NHWC", 3: "NDHWC"}[nd]
     spec = (lhs_spec, {1: "OIH", 2: "OIHW", 3: "OIDHW"}[nd], lhs_spec)
@@ -391,15 +409,17 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return _conv_transpose_impl(x, weight, bias, stride, padding,
                                 output_padding, dilation, groups, 2,
                                 "conv2d_transpose",
-                                _channel_last=data_format == "NHWC")
+                                _channel_last=data_format == "NHWC",
+                                output_size=output_size)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    if data_format != "NCHW":
-        raise NotImplementedError("max_pool2d: only NCHW is supported")
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"max_pool2d: unknown data_format {data_format!r}")
     return _pool_nd(x, 2, kernel_size, stride, padding, "max", "max_pool2d",
-                    ceil_mode=ceil_mode, return_mask=return_mask)
+                    ceil_mode=ceil_mode, return_mask=return_mask,
+                    channel_last=data_format == "NHWC")
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -754,6 +774,12 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     """paddle.nn.functional.grid_sample parity (NCHW): sample x at grid
     locations in [-1, 1]. Reference: phi grid_sample kernel:§0 — here
     gathers + lerp, which XLA fuses; differentiable through the tape."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unknown mode {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample: padding_mode {padding_mode!r} not supported "
+            "(use 'zeros' or 'border')")
 
     def fn(v, g):
         nb, c, h, w = v.shape
@@ -1036,7 +1062,8 @@ def _ceil_extra(sp, k, s, pad):
 
 
 def _pool_nd(x, nd, kernel_size, stride, padding, reduce_op, op_name,
-             exclusive=True, ceil_mode=False, return_mask=False):
+             exclusive=True, ceil_mode=False, return_mask=False,
+             channel_last=False):
     k = _pair(kernel_size, nd)
     s = _pair(stride, nd) if stride is not None else k
     pad = _conv_padding(padding, nd)
@@ -1045,6 +1072,16 @@ def _pool_nd(x, nd, kernel_size, stride, padding, reduce_op, op_name,
     pad = list(pad)
 
     def fn(v):
+        if channel_last:
+            # run channel-first and permute back; XLA folds the transposes
+            v = jnp.moveaxis(v, -1, 1)
+            res = fn_cf(v)
+            if isinstance(res, tuple):
+                return tuple(jnp.moveaxis(r, 1, -1) for r in res)
+            return jnp.moveaxis(res, 1, -1)
+        return fn_cf(v)
+
+    def fn_cf(v):
         sp = v.shape[2:]
         extra = _ceil_extra(sp, k, s, pad) if ceil_mode else [0] * nd
         pads = [(0, 0), (0, 0)] + [(pad[i][0], pad[i][1] + extra[i])
@@ -1108,8 +1145,11 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if data_format not in ("NCDHW", "NDHWC"):
+        raise ValueError(f"max_pool3d: unknown data_format {data_format!r}")
     return _pool_nd(x, 3, kernel_size, stride, padding, "max", "max_pool3d",
-                    ceil_mode=ceil_mode, return_mask=return_mask)
+                    ceil_mode=ceil_mode, return_mask=return_mask,
+                    channel_last=data_format == "NDHWC")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -1160,7 +1200,8 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     return _conv_transpose_impl(x, weight, bias, stride, padding,
                                 output_padding, dilation, groups, 1,
                                 "conv1d_transpose",
-                                _channel_last=data_format == "NLC")
+                                _channel_last=data_format == "NLC",
+                                output_size=output_size)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -1169,7 +1210,8 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     return _conv_transpose_impl(x, weight, bias, stride, padding,
                                 output_padding, dilation, groups, 3,
                                 "conv3d_transpose",
-                                _channel_last=data_format == "NDHWC")
+                                _channel_last=data_format == "NDHWC",
+                                output_size=output_size)
 
 
 # -- activations -------------------------------------------------------------

@@ -162,6 +162,7 @@ class _RNNBase(Layer):
         self.num_layers = num_layers
         self.bidirectional = direction != "forward"
         self.time_major = time_major
+        self.dropout = float(dropout)
         ndir = 2 if self.bidirectional else 1
         self.num_directions = ndir
         g = self.n_gates
@@ -193,33 +194,63 @@ class _RNNBase(Layer):
         z = jnp.zeros((n, batch, self.hidden_size), jnp.float32)
         return z
 
-    def forward(self, inputs, initial_states=None):
+    def forward(self, inputs, initial_states=None, sequence_length=None):
         kind = self.kind
         nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
         time_major = self.time_major
         params = [p for tup in self._weights for p in tup]
         has_init = initial_states is not None
+        has_seq = sequence_length is not None
         init_args = []
         if has_init:
             if kind == "lstm":
                 init_args = [initial_states[0], initial_states[1]]
             else:
                 init_args = [initial_states]
+        if has_seq:
+            init_args = init_args + [sequence_length]
+        # inter-layer dropout (reference: applied to every stacked layer's
+        # output except the last, training only)
+        drop_keys = None
+        if self.training and self.dropout > 0.0 and nl > 1:
+            from .. import random as _random
+            drop_keys = [_random.next_key() for _ in range(nl - 1)]
 
         def fn(x, *flat):
             if not time_major:
                 x = jnp.swapaxes(x, 0, 1)                 # (T, B, I)
-            B = x.shape[1]
+            T, B = x.shape[0], x.shape[1]
             n_w = nl * nd * 4
             ws = [tuple(flat[i * 4:(i + 1) * 4]) for i in range(nl * nd)]
-            init_h = flat[n_w] if has_init else None
-            init_c = flat[n_w + 1] if (has_init and kind == "lstm") else None
+            pos = n_w
+            init_h = init_c = None
+            if has_init:
+                init_h = flat[pos]
+                pos += 1
+                if kind == "lstm":
+                    init_c = flat[pos]
+                    pos += 1
+            if has_seq:
+                slen = flat[pos].astype(jnp.int32)        # (B,)
+                mask = (jnp.arange(T)[:, None] < slen[None, :])  # (T, B)
+                # per-example reversal of the VALID prefix; an involution,
+                # so the same gather un-reverses scan outputs
+                t_idx = jnp.arange(T)[:, None]
+                rev_idx = jnp.where(t_idx < slen[None, :],
+                                    slen[None, :] - 1 - t_idx, t_idx)
+            else:
+                mask = rev_idx = None
             finals_h, finals_c = [], []
             for layer in range(nl):
                 outs = []
                 for d in range(nd):
                     p = ws[layer * nd + d]
-                    xs = x[::-1] if d == 1 else x
+                    if d == 1:
+                        xs = jnp.take_along_axis(
+                            x, rev_idx[:, :, None], axis=0) if has_seq \
+                            else x[::-1]
+                    else:
+                        xs = x
                     slot = layer * nd + d
                     h0 = init_h[slot] if has_init else jnp.zeros((B, hs),
                                                                  x.dtype)
@@ -230,14 +261,33 @@ class _RNNBase(Layer):
                     else:
                         state0 = h0
 
-                    def step(st, xt, p=p):
+                    def step(st, xt_m, p=p):
+                        if has_seq:
+                            xt, m = xt_m
+                            keep = m[:, None]
+                        else:
+                            xt = xt_m
                         _, new = _cell_step(kind, p, xt, st)
+                        if has_seq:
+                            # freeze state and zero output past seq_len
+                            if kind == "lstm":
+                                new = (jnp.where(keep, new[0], st[0]),
+                                       jnp.where(keep, new[1], st[1]))
+                            else:
+                                new = jnp.where(keep, new, st)
                         out = new[0] if kind == "lstm" else new
+                        if has_seq:
+                            out = out * keep.astype(out.dtype)
                         return new, out
 
-                    final, seq = jax.lax.scan(step, state0, xs)
+                    xs_in = (xs, mask) if has_seq else xs
+                    final, seq = jax.lax.scan(step, state0, xs_in)
                     if d == 1:
-                        seq = seq[::-1]
+                        seq = jnp.take_along_axis(
+                            seq, rev_idx[:, :, None], axis=0) if has_seq \
+                            else seq[::-1]
+                        if has_seq:
+                            seq = seq * mask[:, :, None].astype(seq.dtype)
                     outs.append(seq)
                     if kind == "lstm":
                         finals_h.append(final[0])
@@ -245,6 +295,11 @@ class _RNNBase(Layer):
                     else:
                         finals_h.append(final)
                 x = jnp.concatenate(outs, axis=-1) if nd == 2 else outs[0]
+                if drop_keys is not None and layer < nl - 1:
+                    keep = jax.random.bernoulli(
+                        drop_keys[layer], 1.0 - self.dropout, x.shape)
+                    x = jnp.where(keep, x / (1.0 - self.dropout),
+                                  0.0).astype(x.dtype)
             out = x if time_major else jnp.swapaxes(x, 0, 1)
             fh = jnp.stack(finals_h)
             if kind == "lstm":

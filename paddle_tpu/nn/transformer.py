@@ -51,20 +51,23 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         """paddle parity: StaticCache holds precomputed cross-attention
-        K/V; Cache accumulates self-attention K/V across decode steps."""
-        if type is MultiHeadAttention.StaticCache or value is not None:
+        K/V; Cache accumulates self-attention K/V across decode steps.
+        With both key and value given and type=Cache, the tensors are
+        taken as ALREADY-projected K/V and wrapped raw (reference
+        gen_cache third branch)."""
+        if type is MultiHeadAttention.StaticCache:
             value = key if value is None else value
             b = key.shape[0]
             h, d = self.num_heads, self.head_dim
             k = self.k_proj(key).reshape([b, key.shape[1], h, d])
             v = self.v_proj(value).reshape([b, value.shape[1], h, d])
             return MultiHeadAttention.StaticCache(k, v)
+        if value is not None:
+            return MultiHeadAttention.Cache(key, value)
         b = key.shape[0]
         h, d = self.num_heads, self.head_dim
-        import numpy as _np
-        import jax.numpy as _jnp
-        from ..core.tensor import Tensor as _T
-        z = _T(_jnp.zeros((b, 0, h, d), _jnp.float32))
+        dtype = getattr(key, "dtype", jnp.float32)
+        z = Tensor(jnp.zeros((b, 0, h, d), dtype))
         return MultiHeadAttention.Cache(z, z)
 
     def forward(self, query, key=None, value=None, attn_mask=None,
@@ -197,16 +200,38 @@ class TransformerDecoderLayer(Layer):
         self.activation = activation
         self.normalize_before = normalize_before
 
+    def gen_cache(self, memory):
+        """(incremental self-attn Cache, cross-attn StaticCache) — the
+        tuple threaded through forward's ``cache`` (reference
+        TransformerDecoderLayer.gen_cache)."""
+        incremental = self.self_attn.gen_cache(
+            memory, type=MultiHeadAttention.Cache)
+        static = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return incremental, static
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        new_cache = None
         residual = tgt
         x = self.norm1(tgt) if self.normalize_before else tgt
-        x = residual + self.dropout1(self.self_attn(x, attn_mask=tgt_mask))
+        if cache is None:
+            y = self.self_attn(x, attn_mask=tgt_mask)
+        else:
+            y, incremental = self.self_attn(x, x, x, attn_mask=tgt_mask,
+                                            cache=cache[0])
+        x = residual + self.dropout1(y)
         if not self.normalize_before:
             x = self.norm1(x)
         residual = x
         y = self.norm2(x) if self.normalize_before else x
-        y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        if cache is None:
+            y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        else:
+            y, static = self.cross_attn(y, memory, memory,
+                                        attn_mask=memory_mask,
+                                        cache=cache[1])
+            new_cache = (incremental, static)
         x = residual + self.dropout2(y)
         if not self.normalize_before:
             x = self.norm2(x)
@@ -217,7 +242,7 @@ class TransformerDecoderLayer(Layer):
         x = residual + self.dropout_out(y)
         if not self.normalize_before:
             x = self.norm3(x)
-        return x
+        return x if cache is None else (x, new_cache)
 
 
 class TransformerDecoder(Layer):
@@ -229,15 +254,30 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_cache(self, memory, do_zip=False):
+        """Per-layer (incremental, static) cache tuples; ``do_zip``
+        transposes to ([incrementals...], [statics...]) for pipelined
+        decode loops (reference TransformerDecoder.gen_cache)."""
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            return list(map(list, zip(*caches)))
+        return caches
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask,
-                        memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+            else:
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(nc)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
 
 
 class Transformer(Layer):

@@ -1,0 +1,204 @@
+"""Regression tests for the round-2 ADVICE findings.
+
+Covers: fused_allreduce_gradients default dp-average scale, RNN
+inter-layer dropout + sequence_length masking, TransformerDecoder cache
+threading for incremental decode, grid_sample argument validation,
+max_pool2d NHWC, and conv2d_transpose output_size.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util as hpu
+
+
+def _tiny_net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_fused_allreduce_default_scale_is_dp_average():
+    """ADVICE r2 #1: the reference calling convention (params, group) with
+    no explicit scale must yield the dp AVERAGE, not nranks * grad."""
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.distributed import collective as C
+
+    old = pmesh.get_global_mesh()
+    try:
+        m = pmesh.build_mesh({"dp": 8})
+        pmesh.set_global_mesh(m)
+        g = C.Group("dp", m)
+        net = _tiny_net()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        net(x).sum().backward()
+        params = list(net.parameters())
+        before = {id(p): np.asarray(p.grad._value).copy() for p in params}
+        hpu.fused_allreduce_gradients(params, group=g)   # no scale arg
+        for p in params:
+            np.testing.assert_allclose(np.asarray(p.grad._value),
+                                       before[id(p)], rtol=1e-5)
+    finally:
+        pmesh.set_global_mesh(old)
+
+
+class TestRNNDropoutAndSeqLen:
+    def test_interlayer_dropout_active_in_train(self):
+        paddle.seed(7)
+        net = nn.LSTM(4, 6, num_layers=2, dropout=0.5)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 5, 4).astype(np.float32))
+        net.train()
+        a, _ = net(x)
+        b, _ = net(x)
+        # different dropout masks -> different outputs in train mode
+        assert not np.allclose(np.asarray(a._value), np.asarray(b._value))
+        net.eval()
+        c, _ = net(x)
+        d, _ = net(x)
+        np.testing.assert_allclose(np.asarray(c._value),
+                                   np.asarray(d._value))
+
+    def test_dropout_zero_unchanged_by_mode(self):
+        paddle.seed(7)
+        net = nn.GRU(4, 6, num_layers=2, dropout=0.0)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 5, 4).astype(np.float32))
+        net.train()
+        a, _ = net(x)
+        net.eval()
+        b, _ = net(x)
+        np.testing.assert_allclose(np.asarray(a._value),
+                                   np.asarray(b._value), rtol=1e-6)
+
+    def test_sequence_length_masks_outputs_and_freezes_state(self):
+        paddle.seed(1)
+        net = nn.LSTM(3, 5)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 6, 3).astype(np.float32))
+        slen = paddle.to_tensor(np.array([4, 6], np.int64))
+        out, (h, c) = net(x, sequence_length=slen)
+        o = np.asarray(out._value)
+        # padded steps of example 0 are zeroed
+        np.testing.assert_allclose(o[0, 4:], 0.0)
+        assert np.abs(o[1, 4:]).sum() > 0
+        # final state of example 0 == full-run state at t=3
+        out_full, (h_full, _) = net(x)
+        of = np.asarray(out_full._value)
+        np.testing.assert_allclose(np.asarray(h._value)[0, 0],
+                                   of[0, 3], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h._value)[0, 1],
+                                   np.asarray(h_full._value)[0, 1],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bidirectional_sequence_length_reverses_valid_prefix(self):
+        paddle.seed(2)
+        net = nn.SimpleRNN(3, 4, direction="bidirect")
+        net.eval()
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 6, 3).astype(np.float32)
+        slen = np.array([4, 6], np.int64)
+        out, _ = net(paddle.to_tensor(x),
+                     sequence_length=paddle.to_tensor(slen))
+        # example 0 truncated to its valid prefix, run alone, must match
+        out_trunc, _ = net(paddle.to_tensor(x[:1, :4]))
+        np.testing.assert_allclose(
+            np.asarray(out._value)[0, :4],
+            np.asarray(out_trunc._value)[0], rtol=1e-5, atol=1e-6)
+
+
+class TestDecoderCache:
+    def _decoder(self, normalize_before=False):
+        paddle.seed(3)
+        layer = nn.TransformerDecoderLayer(
+            8, 2, 16, dropout=0.0, normalize_before=normalize_before)
+        return nn.TransformerDecoder(layer, 2)
+
+    def test_gen_cache_types(self):
+        dec = self._decoder()
+        memory = paddle.to_tensor(np.random.RandomState(0)
+                                  .randn(2, 5, 8).astype(np.float32))
+        caches = dec.gen_cache(memory)
+        assert len(caches) == 2
+        inc, static = caches[0]
+        assert isinstance(inc, nn.MultiHeadAttention.Cache)
+        assert isinstance(static, nn.MultiHeadAttention.StaticCache)
+        assert inc.k.shape[1] == 0                       # empty accumulator
+        assert static.k.shape[1] == 5                    # projected memory
+        zipped = dec.gen_cache(memory, do_zip=True)
+        assert len(zipped) == 2 and len(zipped[0]) == 2
+
+    def test_gen_cache_preserves_dtype(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        key = paddle.to_tensor(np.zeros((2, 3, 8), np.float32)) \
+            .astype("bfloat16")
+        cache = mha.gen_cache(key)
+        assert cache.k.dtype == jnp.bfloat16
+
+    def test_mha_gen_cache_raw_kv(self):
+        """type=Cache with key AND value wraps them raw (no projection)."""
+        mha = nn.MultiHeadAttention(8, 2)
+        k = paddle.to_tensor(np.zeros((2, 3, 2, 4), np.float32))
+        v = paddle.to_tensor(np.ones((2, 3, 2, 4), np.float32))
+        cache = mha.gen_cache(k, v, type=nn.MultiHeadAttention.Cache)
+        assert isinstance(cache, nn.MultiHeadAttention.Cache)
+        np.testing.assert_allclose(np.asarray(cache.k._value), 0.0)
+        np.testing.assert_allclose(np.asarray(cache.v._value), 1.0)
+
+    @pytest.mark.parametrize("normalize_before", [False, True])
+    def test_incremental_decode_matches_full_forward(self, normalize_before):
+        dec = self._decoder(normalize_before)
+        dec.eval()
+        rs = np.random.RandomState(4)
+        S = 4
+        tgt = rs.randn(2, S, 8).astype(np.float32)
+        memory = paddle.to_tensor(rs.randn(2, 5, 8).astype(np.float32))
+        causal = nn.Transformer.generate_square_subsequent_mask(S)
+        full = dec(paddle.to_tensor(tgt), memory, tgt_mask=causal)
+        caches = dec.gen_cache(memory)
+        steps = []
+        for t in range(S):
+            step, caches = dec(paddle.to_tensor(tgt[:, t:t + 1]), memory,
+                               cache=caches)
+            steps.append(np.asarray(step._value))
+        np.testing.assert_allclose(np.concatenate(steps, axis=1),
+                                   np.asarray(full._value),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestFunctionalValidation:
+    def test_grid_sample_rejects_reflection(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32))
+        g = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+        with pytest.raises(NotImplementedError):
+            F.grid_sample(x, g, padding_mode="reflection")
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, mode="bicubic")
+
+    def test_max_pool2d_nhwc_matches_nchw(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        ref = F.max_pool2d(paddle.to_tensor(x), 2, stride=2)
+        got = F.max_pool2d(paddle.to_tensor(x.transpose(0, 2, 3, 1)), 2,
+                           stride=2, data_format="NHWC")
+        np.testing.assert_allclose(
+            np.asarray(got._value).transpose(0, 3, 1, 2),
+            np.asarray(ref._value))
+
+    def test_conv2d_transpose_output_size(self):
+        rs = np.random.RandomState(6)
+        x = paddle.to_tensor(rs.randn(1, 2, 5, 5).astype(np.float32))
+        w = paddle.to_tensor(rs.randn(2, 3, 3, 3).astype(np.float32))
+        # base size = (5-1)*2 + 3 = 11; output_size=12 needs output_padding=1
+        out = F.conv2d_transpose(x, w, stride=2, output_size=12)
+        assert tuple(out.shape[2:]) == (12, 12)
+        ref = F.conv2d_transpose(x, w, stride=2, output_padding=1)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(ref._value), rtol=1e-5)
+        # base + stride = 13 is already out of range (output_padding < stride)
+        with pytest.raises(ValueError):
+            F.conv2d_transpose(x, w, stride=2, output_size=13)
