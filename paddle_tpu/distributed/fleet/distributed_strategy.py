@@ -23,6 +23,10 @@ _HYBRID_DEFAULTS = {
     "micro_batch_size": 1,
     "accumulate_steps": 1,
     "order": ["dp", "pp", "sharding", "sep", "mp"],
+    # reference pp_configs carries schedule options (schedule_mode in the
+    # reference proto); here it selects the compiled pipeline program:
+    # "fill_drain" (interleaved when virtual_pp > 1) or "1f1b"
+    "pp_configs": {"schedule": "fill_drain", "virtual_pp": 1},
 }
 
 _AMP_DEFAULTS = {
@@ -63,6 +67,8 @@ _TP_DEFAULTS = {
 class DistributedStrategy:
     def __init__(self):
         self._hybrid_configs = dict(_HYBRID_DEFAULTS)
+        self._hybrid_configs["pp_configs"] = dict(
+            _HYBRID_DEFAULTS["pp_configs"])
         self._amp = False
         self._amp_configs = dict(_AMP_DEFAULTS)
         self._recompute = False
@@ -91,7 +97,28 @@ class DistributedStrategy:
         for k, v in configs.items():
             if k not in _HYBRID_DEFAULTS:
                 raise ValueError(f"unknown hybrid config key {k!r}")
+            if k == "pp_configs":
+                unknown = set(v) - set(_HYBRID_DEFAULTS["pp_configs"])
+                if unknown:
+                    raise ValueError(
+                        f"unknown pp_configs key(s) {sorted(unknown)}")
+                # partial update against the INSTANCE's current value
+                merged = dict(self._hybrid_configs["pp_configs"])
+                merged.update(v)
+                if merged["schedule"] not in ("fill_drain", "1f1b"):
+                    raise ValueError(
+                        f"pp_configs.schedule must be 'fill_drain' or "
+                        f"'1f1b', got {merged['schedule']!r}")
+                v = merged
             self._hybrid_configs[k] = v
+
+    def pipeline_schedule(self) -> str:
+        """Compiled pipeline schedule for the hybrid train step; consumed by
+        model builders as build_hybrid_train_step(pipeline_schedule=...)."""
+        return self._hybrid_configs["pp_configs"]["schedule"]
+
+    def virtual_pp_degree(self) -> int:
+        return int(self._hybrid_configs["pp_configs"]["virtual_pp"])
 
     def degrees(self) -> Dict[str, int]:
         h = self._hybrid_configs

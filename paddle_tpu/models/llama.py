@@ -354,7 +354,8 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                             remat: bool = True,
                             seq_shard: bool = False,
                             virtual_pp: int = 1,
-                            remat_policy: str = "full"):
+                            remat_policy: str = "full",
+                            pipeline_schedule: str = "fill_drain"):
     """Returns (step_fn, init_fn).
 
     step_fn(params, opt_state, batch_ids, batch_labels) ->
@@ -370,11 +371,32 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     repartition around attention).
     Optimizer: fused AdamW (state sharded like the weights).
 
+    ``pipeline_schedule``: "fill_drain" (default; becomes the interleaved
+    virtual-pipeline schedule when virtual_pp > 1) or "1f1b" — the
+    memory-scheduled one-forward-one-backward program
+    (parallel/pipeline.py::pipeline_1f1b): O(stages) activation memory
+    instead of O(microbatches), the schedule the reference's
+    PipelineParallel runs by default (SURVEY.md §2.4 PP row). 1f1b
+    composes with dp/mp/sharding; virtual_pp and seq_shard are
+    fill-drain/interleave-only.
+
     Note: with virtual_pp > 1 the stacked layer arrays are stored in the
     interleave-permuted order (init_fn applies it); checkpoints of these
     params carry that layout.
     """
     from ..parallel import pipeline as ppipe
+
+    if pipeline_schedule not in ("fill_drain", "1f1b"):
+        raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+    if pipeline_schedule == "1f1b":
+        if mesh.shape.get("pp", 1) <= 1:
+            raise ValueError("pipeline_schedule='1f1b' needs a pp axis > 1")
+        if virtual_pp > 1:
+            raise ValueError("1f1b and virtual_pp are mutually exclusive "
+                             "(interleave is a fill-drain-family schedule)")
+        if seq_shard:
+            raise ValueError("1f1b with sequence parallelism is not "
+                             "supported; use the fill-drain schedule")
 
     pp = mesh.shape.get("pp", 1)
     mp = mesh.shape.get("mp", 1)
@@ -422,26 +444,35 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     else:
         layer_order = None
 
-    def spmd_loss(params, ids, labels):
-        """Runs per-device inside shard_map. ids/labels: (M, mb_local, S_local)."""
-        M, mb, S = ids.shape
-        s_glob = S * sep if sep_axis is not None else S
-        cos, sin = rope_ops.build_rope_cache(s_glob, config.head_dim,
-                                             config.rope_theta)
-        if sep_axis is not None:
-            # RoPE runs pre-all_to_all on the local chunk: slice its positions
-            off = lax.axis_index(sep_axis) * S
-            cos = lax.dynamic_slice_in_dim(cos, off, S, axis=0)
-            sin = lax.dynamic_slice_in_dim(sin, off, S, axis=0)
+    # ---- closures shared by the fill-drain and 1f1b spmd bodies ------------
+    def make_embed(params):
+        """Token-embedding lookup; vocab-parallel over mp when sharded.
+        Returns (embed_fn, vocab_shard_start, vocab_shard_size)."""
+        if mp_axis is not None:
+            per = params["embed"].shape[0]
+            start = lax.axis_index(mp_axis) * per
+
+            def embed(i):
+                i32 = i.astype(jnp.int32) - start
+                ok = (i32 >= 0) & (i32 < per)
+                e = jnp.take(params["embed"], jnp.where(ok, i32, 0), axis=0)
+                return lax.psum(jnp.where(ok[..., None], e, 0.0), mp_axis)
+
+            return embed, start, per
 
         def embed(i):
             return jnp.take(params["embed"], i.astype(jnp.int32), axis=0)
+
+        return embed, None, None
+
+    def make_stage_fn(cos, sin, use_sep):
+        ax = sep_axis if use_sep else None
 
         def stage_fn(sparams, x):
             def layer_body(carry, lp):
                 fn = functools.partial(_decoder_layer_manual, config=config,
                                        mp_axis=mp_axis, fsdp_axis=fsdp_axis,
-                                       sep_axis=sep_axis)
+                                       sep_axis=ax)
                 if remat:
                     if remat_policy == "dots":
                         # save matmul outputs, recompute elementwise/norms:
@@ -457,17 +488,37 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             x, _ = lax.scan(layer_body, x, layer_params)
             return x
 
-        # vocab-parallel embedding (weight sharded over mp on vocab dim)
-        if mp_axis is not None:
-            idx = lax.axis_index(mp_axis)
-            per = params["embed"].shape[0]
-            start = idx * per
+        return stage_fn
 
-            def embed(i):  # noqa: F811
-                i32 = i.astype(jnp.int32) - start
-                ok = (i32 >= 0) & (i32 < per)
-                e = jnp.take(params["embed"], jnp.where(ok, i32, 0), axis=0)
-                return lax.psum(jnp.where(ok[..., None], e, 0.0), mp_axis)
+    def head_ce(hp, y, lab):
+        """ln_f + lm_head + token CE over arbitrary leading dims (mean)."""
+        out = _rms(y, hp["ln_f"], eps)
+        logits = jnp.einsum("...sh,hv->...sv", out, _dense(hp["lm_head"]))
+        lg = logits.astype(jnp.float32)
+        lab32 = lab.astype(jnp.int32)
+        if mp_axis is not None:
+            from ..distributed.meta_parallel.mp_layers import \
+                vocab_parallel_ce_array
+            return jnp.mean(vocab_parallel_ce_array(lg, lab32, mp_axis))
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        picked = jnp.take_along_axis(logp, lab32[..., None],
+                                     axis=-1)[..., 0]
+        return -jnp.mean(picked)
+
+    def spmd_loss(params, ids, labels):
+        """Runs per-device inside shard_map. ids/labels: (M, mb_local, S_local)."""
+        M, mb, S = ids.shape
+        s_glob = S * sep if sep_axis is not None else S
+        cos, sin = rope_ops.build_rope_cache(s_glob, config.head_dim,
+                                             config.rope_theta)
+        if sep_axis is not None:
+            # RoPE runs pre-all_to_all on the local chunk: slice its positions
+            off = lax.axis_index(sep_axis) * S
+            cos = lax.dynamic_slice_in_dim(cos, off, S, axis=0)
+            sin = lax.dynamic_slice_in_dim(sin, off, S, axis=0)
+
+        embed, _, _ = make_embed(params)
+        stage_fn = make_stage_fn(cos, sin, use_sep=True)
 
         x = embed(ids)  # (M, mb, S, h)
 
@@ -490,19 +541,10 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                 return None, stage_fn({k: params[k] for k in LAYER_KEYS}, xm)
             _, out = lax.scan(micro_body, None, x)
 
-        out = _rms(out, params["ln_f"], eps)
-        logits = jnp.einsum("mbsh,hv->mbsv", out, _dense(params["lm_head"]))
-        # vocab is replicated over mp here (lm_head spec P(None, 'mp') is
-        # sliced by shard_map, so logits are vocab-sharded when mp>1)
-        lg = logits.astype(jnp.float32)
-        lab = labels.astype(jnp.int32)
-        if mp_axis is not None:
-            from ..distributed.meta_parallel.mp_layers import vocab_parallel_ce_array
-            loss = jnp.mean(vocab_parallel_ce_array(lg, lab, mp_axis))
-        else:
-            logp = jax.nn.log_softmax(lg, axis=-1)
-            picked = jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-            loss = -jnp.mean(picked)
+        # lm_head spec P(None, 'mp') is sliced by shard_map, so logits are
+        # vocab-sharded when mp>1 and head_ce runs the vocab-parallel CE
+        loss = head_ce({"ln_f": params["ln_f"],
+                        "lm_head": params["lm_head"]}, out, labels)
         # mean over dp/sharding batch shards (+ sep sequence shards)
         for ax in ("dp", "sharding"):
             if mesh.shape.get(ax, 1) > 1:
@@ -510,6 +552,96 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
         if sep_axis is not None:
             loss = lax.pmean(loss, sep_axis)
         return loss
+
+    def spmd_1f1b_loss_grads(params, ids, labels):
+        """Per-device 1F1B: loss AND hand-scheduled grads in one program.
+
+        The pipeline computes layer grads internally (jax.vjp per tick);
+        the replication sums shard_map's AD transpose would have inserted
+        (for replicated/partial-view tensors) are added explicitly below.
+        """
+        M, mb, S = ids.shape
+        cos, sin = rope_ops.build_rope_cache(S, config.head_dim,
+                                             config.rope_theta)
+        embed, start, per = make_embed(params)
+        stage_fn = make_stage_fn(cos, sin, use_sep=False)
+
+        x = embed(ids)                                   # (M, mb, S, h)
+        h = x.shape[-1]
+        ids32 = ids.astype(jnp.int32)
+        layer_params = {k: params[k] for k in LAYER_KEYS}
+        head_params = {"ln_f": params["ln_f"],
+                       "lm_head": params["lm_head"]}
+
+        def gin_reducer(acc, gx, m_b):
+            # embedding backward folded per backward tick: scatter-add this
+            # microbatch's d loss/d x rows into the local vocab shard, so no
+            # O(M) input-grad buffer rides the scan. gx is this mp slice's
+            # PARTIAL gradient — psum first so every vocab shard sees the
+            # full rows.
+            g = gx.astype(jnp.float32)
+            if mp_axis is not None:
+                g = lax.psum(g, mp_axis)
+            gf = g.reshape(-1, h)
+            idx = lax.dynamic_index_in_dim(ids32, m_b, 0,
+                                           keepdims=False).reshape(-1)
+            if mp_axis is not None:
+                local = idx - start
+                ok = (local >= 0) & (local < per)
+                return acc.at[jnp.where(ok, local, 0)].add(
+                    jnp.where(ok[:, None], gf, 0.0))
+            return acc.at[idx].add(gf)
+
+        loss, lgrads, hgrads, gembed = ppipe.pipeline_1f1b(
+            stage_fn, layer_params, x, labels, head_ce, axis_name="pp",
+            head_params=head_params, strip_stage_dim=False,
+            input_grad_reducer=gin_reducer,
+            input_grad_init=jnp.zeros(params["embed"].shape, jnp.float32))
+        loss = ppipe.last_stage_broadcast(loss, "pp")
+        hgrads = jax.tree_util.tree_map(
+            lambda a: ppipe.last_stage_broadcast(a, "pp"), hgrads)
+        gembed = lax.psum(gembed, "pp")    # valid on stage 0 only
+
+        if mp_axis is not None:
+            # jax transposes psum as psum: the REPLICATED unit seed at the
+            # loss head inflates by mp at its first psum crossing (the CE
+            # denom/target psums), after which partial cotangents sum
+            # correctly at every later crossing — so every grad below the
+            # head is uniformly mp x too large. Rescale once.
+            inv_mp = 1.0 / mesh.shape["mp"]
+            lgrads = jax.tree_util.tree_map(lambda a: a * inv_mp, lgrads)
+            hgrads = jax.tree_util.tree_map(lambda a: a * inv_mp, hgrads)
+            gembed = gembed * inv_mp
+            # ln grads are per-mp-slice partials (their consumers are the
+            # column-sharded matmuls): sum them
+            hgrads = {"ln_f": lax.psum(hgrads["ln_f"], mp_axis),
+                      "lm_head": hgrads["lm_head"]}
+            lgrads = {k: (lax.psum(v, mp_axis) if k in ("ln1", "ln2") else v)
+                      for k, v in lgrads.items()}
+
+        # batch shards: matmul grads arrive summed over (dp, sharding) via
+        # the ZeRO all_gather transpose; replicated tensors need the psum;
+        # everything needs 1/R for global-batch-mean semantics
+        R = mesh.shape.get("dp", 1) * mesh.shape.get("sharding", 1)
+        if R > 1:
+            loss = lax.pmean(loss, ("dp", "sharding"))
+            gembed = lax.psum(gembed, ("dp", "sharding"))
+            hgrads = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, ("dp", "sharding")), hgrads)
+            lgrads = {k: (lax.psum(v, ("dp", "sharding"))
+                          if k in ("ln1", "ln2") else v)
+                      for k, v in lgrads.items()}
+            inv = 1.0 / R
+            lgrads = {k: v * inv for k, v in lgrads.items()}
+            hgrads = jax.tree_util.tree_map(lambda a: a * inv, hgrads)
+            gembed = gembed * inv
+
+        grads = dict(lgrads)
+        grads["ln_f"] = hgrads["ln_f"]
+        grads["lm_head"] = hgrads["lm_head"]
+        grads["embed"] = gembed
+        grads = {k: g.astype(params[k].dtype) for k, g in grads.items()}
+        return loss, grads
 
     batch_in_spec = P(None, ("dp", "sharding"),
                       "sep" if sep_axis is not None else None)
@@ -519,6 +651,13 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             spmd_loss, mesh=mesh,
             in_specs=(specs, batch_in_spec, batch_in_spec),
             out_specs=P(), check_vma=False)
+        return f(params, ids, labels)
+
+    def loss_and_grads_1f1b(params, ids, labels):
+        f = jax.shard_map(
+            spmd_1f1b_loss_grads, mesh=mesh,
+            in_specs=(specs, batch_in_spec, batch_in_spec),
+            out_specs=(P(), specs), check_vma=False)
         return f(params, ids, labels)
 
     # --- fused AdamW over the sharded pytree --------------------------------
@@ -541,7 +680,11 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     state_specs = {"step": P(), "m": specs, "v": specs}
 
     def step(params, opt_state, ids, labels):
-        loss, grads = jax.value_and_grad(loss_shardmapped)(params, ids, labels)
+        if pipeline_schedule == "1f1b":
+            loss, grads = loss_and_grads_1f1b(params, ids, labels)
+        else:
+            loss, grads = jax.value_and_grad(loss_shardmapped)(
+                params, ids, labels)
         t = opt_state["step"] + 1
 
         def upd(p, g, m, v):

@@ -357,6 +357,10 @@ def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
     op = _pair(output_padding, nd)
     channel_last = _channel_last
     if output_size is not None:
+        if any(o != 0 for o in op):
+            raise ValueError(
+                f"{op_name}: output_padding and output_size are mutually "
+                "exclusive")
         # derive the output_padding that realises the requested size:
         # out = (in-1)*s - p_lo - p_hi + d*(k-1) + 1 + op
         osz = _pair(output_size, nd)

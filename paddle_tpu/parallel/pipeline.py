@@ -142,7 +142,10 @@ def pipeline_spmd_interleaved(chunk_fn, chunk_params, microbatches,
 # True 1F1B: hand-scheduled forward+backward, bounded activation memory
 # ---------------------------------------------------------------------------
 def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
-                  labels, loss_fn: Callable, axis_name: str = "pp"):
+                  labels, loss_fn: Callable, axis_name: str = "pp",
+                  head_params: Any = None, strip_stage_dim: bool = True,
+                  input_grad_reducer: Callable = None,
+                  input_grad_init: Any = None):
     """Memory-scheduled 1F1B pipeline: ONE scan carrying forward AND
     backward work, with per-stage activation buffers of depth 2S instead of
     the fill-drain schedule's M in-flight microbatches.
@@ -173,6 +176,30 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
     with :func:`last_stage_broadcast`), grads a pytree like stage_params
     (each stage's slice holds ∑_m of ITS stage's param grads, fp32).
 
+    Extensions for the hybrid train step (models/llama.py
+    ``pipeline_schedule='1f1b'``):
+
+    * ``head_params`` — pytree of trainable parameters consumed by the loss
+      head; loss_fn's signature becomes ``loss_fn(head_params, y, label)``
+      and the return gains ``head_grads`` (mean over microbatches, valid on
+      the LAST stage — broadcast before use).
+    * ``strip_stage_dim=False`` — stage_params arrive as each stage's local
+      slice with an arbitrary leading dim (e.g. layers-per-stage for a
+      scanned multi-layer stage) instead of the (1, ...) shard_map slice;
+      returned grads keep that local shape (no stage-dim reinsertion).
+    * ``input_grad_reducer`` / ``input_grad_init`` — fold each microbatch's
+      input gradient into an accumulator AS IT IS PRODUCED:
+      ``reducer(acc, gx, m_b) -> acc`` runs at every backward tick and its
+      result is kept only on stage 0 for valid ticks (masked elsewhere), so
+      d(mean loss)/d(inputs) reaches the caller as a REDUCED quantity (e.g.
+      an embedding-gradient table) without carrying an O(microbatches)
+      buffer through the scan — the 1F1B memory profile is preserved. The
+      returned accumulator (divided by M, valid on stage 0, zeros
+      elsewhere) is what chains the embedding backward.
+
+    Return shape: ``(loss, grads[, head_grads][, input_grad_acc])`` — the
+    optional entries appear only when requested.
+
     On ZB-H1 (reference passes/pipeline_scheduler_pass.py:§0): zero-bubble
     schedules split backward into dgrad (critical path) and wgrad (bubble
     filler) so idle drain slots do weight-gradient work. In this ONE-program
@@ -191,15 +218,16 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
     T = M + 2 * S - 2
 
-    # shard_map slices the stacked (S, ...) params to (1, ...) per stage;
-    # drop that stage dim so stage_fn sees its own weights directly
-    bad = [a.shape[0] for a in jax.tree_util.tree_leaves(stage_params)
-           if a.shape[0] != 1]
-    if bad:
-        raise ValueError(
-            f"stage_params leaves must arrive stage-sliced (leading dim 1 "
-            f"under shard_map in_specs P(axis)), got leading dims {bad}")
-    stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    if strip_stage_dim:
+        # shard_map slices the stacked (S, ...) params to (1, ...) per
+        # stage; drop that stage dim so stage_fn sees its own weights
+        bad = [a.shape[0] for a in jax.tree_util.tree_leaves(stage_params)
+               if a.shape[0] != 1]
+        if bad:
+            raise ValueError(
+                f"stage_params leaves must arrive stage-sliced (leading dim "
+                f"1 under shard_map in_specs P(axis)), got leading dims {bad}")
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
 
     x_shape = microbatches.shape[1:]
     last = S - 1
@@ -208,7 +236,7 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
         return stage_fn(p, x)
 
     def step(carry, t):
-        fwd_state, grad_state, act_buf, gacc, loss_acc = carry
+        fwd_state, grad_state, act_buf, gacc, loss_acc, hacc, gin = carry
 
         # ---- forward tick: F(m_f, d) at t = d + m_f --------------------
         m_f = jnp.clip(t - d, 0, M - 1)
@@ -228,12 +256,16 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
         x_saved = lax.dynamic_index_in_dim(act_buf, m_b % depth, 0,
                                            keepdims=False)
         # one vjp per tick; the seed is the loss gradient on the last stage
-        # (loss_fn is parameter-free — a trainable head belongs in stage_fn)
         # and the ring-received gy elsewhere
         lab = labels[m_b]
         y_b, vjp = jax.vjp(fwd_only, stage_params, x_saved)
-        loss_m, gy_loss = jax.value_and_grad(
-            lambda yy: loss_fn(yy, lab))(y_b)
+        if head_params is not None:
+            loss_m, loss_vjp = jax.vjp(
+                lambda hp, yy: loss_fn(hp, yy, lab), head_params, y_b)
+            gh, gy_loss = loss_vjp(jnp.ones((), loss_m.dtype))
+        else:
+            loss_m, gy_loss = jax.value_and_grad(
+                lambda yy: loss_fn(yy, lab))(y_b)
         is_last = d == last
         gy = jnp.where(is_last, gy_loss.astype(y_b.dtype), grad_state)
         gp, gx = vjp(gy)
@@ -243,24 +275,53 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
             gacc, gp)
         loss_acc = loss_acc + jnp.where(
             jnp.logical_and(b_valid, is_last), loss_m, 0.0)
+        if head_params is not None:
+            on_last = jnp.logical_and(b_valid, is_last)
+            hacc = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(on_last, g, 0.0)
+                .astype(acc.dtype), hacc, gh)
+        if input_grad_reducer is not None:
+            # fold d loss_m / d microbatches[m_b] into the accumulator,
+            # exact on stage 0 where the injection happened; masked so
+            # other stages contribute zeros (the reducer may contain
+            # collectives, so it runs unconditionally on every device)
+            reduced = input_grad_reducer(gin, gx, m_b)
+            keep = jnp.logical_and(b_valid, d == 0)
+            gin = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(keep, new, old), reduced, gin)
 
         # ---- rings ------------------------------------------------------
         fwd_state = lax.ppermute(jnp.where(f_valid, y, jnp.zeros_like(y)),
                                  axis_name, fwd_perm)
         grad_state = lax.ppermute(jnp.where(b_valid, gx, jnp.zeros_like(gx)),
                                   axis_name, bwd_perm)
-        return (fwd_state, grad_state, act_buf, gacc, loss_acc), None
+        return (fwd_state, grad_state, act_buf, gacc, loss_acc, hacc,
+                gin), None
 
     fwd0 = jnp.zeros(x_shape, microbatches.dtype)
     grad0 = jnp.zeros(x_shape, microbatches.dtype)
     buf0 = jnp.zeros((depth,) + x_shape, microbatches.dtype)
     gacc0 = jax.tree_util.tree_map(
         lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
-    carry, _ = lax.scan(step, (fwd0, grad0, buf0, gacc0,
-                               jnp.zeros((), jnp.float32)), jnp.arange(T))
-    _, _, _, gacc, loss_acc = carry
-    # mean-over-microbatches semantics for both outputs (matches
-    # grad(mean_m loss_m)); restore the stage dim so out_specs P(axis)
-    # reassembles the stack
-    gacc = jax.tree_util.tree_map(lambda a: a[None] / M, gacc)
-    return loss_acc / M, gacc
+    hacc0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), head_params) \
+        if head_params is not None else jnp.zeros((), jnp.float32)
+    gin0 = input_grad_init if input_grad_reducer is not None \
+        else jnp.zeros((), jnp.float32)
+    carry, _ = lax.scan(
+        step, (fwd0, grad0, buf0, gacc0, jnp.zeros((), jnp.float32),
+               hacc0, gin0), jnp.arange(T))
+    _, _, _, gacc, loss_acc, hacc, gin = carry
+    # mean-over-microbatches semantics for every output (matches
+    # grad(mean_m loss_m)); with strip_stage_dim restore the stage dim so
+    # out_specs P(axis) reassembles the stack
+    if strip_stage_dim:
+        gacc = jax.tree_util.tree_map(lambda a: a[None] / M, gacc)
+    else:
+        gacc = jax.tree_util.tree_map(lambda a: a / M, gacc)
+    res = (loss_acc / M, gacc)
+    if head_params is not None:
+        res = res + (jax.tree_util.tree_map(lambda a: a / M, hacc),)
+    if input_grad_reducer is not None:
+        res = res + (jax.tree_util.tree_map(lambda a: a / M, gin),)
+    return res
