@@ -945,3 +945,696 @@ register(OpSpec(
     sample=lambda rng: ((rng.randn(2, 6, 3, 3).astype(np.float32),),
                         {"groups": 3}),
 ))
+
+
+# ===========================================================================
+# round-3 migration: the mechanical op families (elementwise, reductions,
+# comparisons, shape/movement, indexing) onto the schema so the uniform
+# fp32+bf16 oracle sweep covers the live public ops (VERDICT round-2 item 5;
+# reference paddle/phi/api/yaml/ops.yaml + test/legacy_test/op_test.py:§0).
+# install(only_missing=True) keeps every hand-written implementation — these
+# specs add test coverage, not new dispatch paths.
+# ===========================================================================
+def _u1(lo=-2.0, hi=2.0, shape=(8,)):
+    def gen(rng):
+        return ((rng.rand(*shape) * (hi - lo) + lo).astype(np.float32),), {}
+    return gen
+
+
+def _u2(lo=-2.0, hi=2.0, shape=(6,)):
+    def gen(rng):
+        a = (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+        b = (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+        return (a, b), {}
+    return gen
+
+
+# --- smooth unary elementwise (grad-checked, fp32+bf16) ---------------------
+for _name, _jf, _nf, _gen in [
+    ("abs", jnp.abs, np.abs, _u1()),
+    ("cos", jnp.cos, np.cos, _u1()),
+    ("sin", jnp.sin, np.sin, _u1()),
+    ("tan", jnp.tan, np.tan, _u1(-1.0, 1.0)),
+    ("cosh", jnp.cosh, np.cosh, _u1()),
+    ("sinh", jnp.sinh, np.sinh, _u1()),
+    ("tanh", jnp.tanh, np.tanh, _u1()),
+    ("exp", jnp.exp, np.exp, _u1()),
+    ("expm1", jnp.expm1, np.expm1, _u1()),
+    ("sigmoid", jax.nn.sigmoid, lambda x: 1 / (1 + np.exp(-x)), _u1()),
+    ("neg", jnp.negative, np.negative, _u1()),
+    ("square", jnp.square, np.square, _u1()),
+    ("sqrt", jnp.sqrt, np.sqrt, _u1(0.1, 4.0)),
+    ("rsqrt", lambda x: jax.lax.rsqrt(x), lambda x: 1 / np.sqrt(x),
+     _u1(0.1, 4.0)),
+    ("reciprocal", jnp.reciprocal, np.reciprocal, _u1(0.3, 3.0)),
+    ("log", jnp.log, np.log, _u1(0.1, 5.0)),
+    ("log2", jnp.log2, np.log2, _u1(0.1, 5.0)),
+    ("log10", jnp.log10, np.log10, _u1(0.1, 5.0)),
+    ("log1p", jnp.log1p, np.log1p, _u1(-0.5, 4.0)),
+    ("erf", jax.scipy.special.erf, None, _u1()),
+    ("acos", jnp.arccos, np.arccos, _u1(-0.9, 0.9)),
+    ("asin", jnp.arcsin, np.arcsin, _u1(-0.9, 0.9)),
+    ("atan", jnp.arctan, np.arctan, _u1()),
+    ("acosh", jnp.arccosh, np.arccosh, _u1(1.1, 4.0)),
+    ("asinh", jnp.arcsinh, np.arcsinh, _u1()),
+    ("atanh", jnp.arctanh, np.arctanh, _u1(-0.9, 0.9)),
+]:
+    if _nf is None:  # erf oracle from scipy (numpy has none)
+        import scipy.special as _sps
+        _nf = _sps.erf
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen,
+                    tol={"bfloat16": 4e-2}))
+
+# --- non-smooth unary (no FD grad) ------------------------------------------
+for _name, _jf, _nf in [
+    ("ceil", jnp.ceil, np.ceil),
+    ("floor", jnp.floor, np.floor),
+    ("round", jnp.round, np.round),
+    ("trunc", jnp.trunc, np.trunc),
+    ("sign", jnp.sign, np.sign),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_u1(), grad=False))
+
+# --- special functions (scipy oracles, fp32) --------------------------------
+import scipy.special as _sps  # noqa: E402
+
+for _name, _jf, _nf, _gen, _grad in [
+    ("erfinv", jax.scipy.special.erfinv, _sps.erfinv, _u1(-0.9, 0.9), True),
+    ("digamma", jax.scipy.special.digamma, _sps.digamma,
+     _u1(0.5, 4.0), True),
+    ("lgamma", jax.scipy.special.gammaln, _sps.gammaln,
+     _u1(0.5, 4.0), True),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen, grad=_grad,
+                    dtypes=("float32",), tol={"float32": 1e-4}))
+
+# --- binary elementwise -----------------------------------------------------
+for _name, _jf, _nf, _gen, _grad in [
+    ("add", jnp.add, np.add, _u2(), True),
+    ("subtract", jnp.subtract, np.subtract, _u2(), True),
+    ("multiply", jnp.multiply, np.multiply, _u2(), True),
+    ("divide", jnp.divide, np.divide, _u2(0.5, 3.0), True),
+    ("maximum", jnp.maximum, np.maximum, _u2(), True),
+    ("minimum", jnp.minimum, np.minimum, _u2(), True),
+    ("fmax", jnp.fmax, np.fmax, _u2(), True),
+    ("fmin", jnp.fmin, np.fmin, _u2(), True),
+    ("atan2", jnp.arctan2, np.arctan2, _u2(0.2, 2.0), True),
+    ("pow", jnp.power, np.power, _u2(0.3, 2.0), True),
+    ("mod", jnp.mod, np.mod, _u2(0.5, 3.0), False),
+    ("remainder", jnp.mod, np.mod, _u2(0.5, 3.0), False),
+    ("floor_divide", jnp.floor_divide, np.floor_divide,
+     _u2(0.5, 5.0), False),
+    ("floor_mod", jnp.mod, np.mod, _u2(0.5, 3.0), False),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen, grad=_grad,
+                    tol={"bfloat16": 4e-2}))
+
+register(OpSpec(
+    name="lerp",
+    fn=lambda x, y, weight: x + weight * (y - x),
+    oracle=lambda x, y, weight: x + weight * (y - x),
+    sample=lambda rng: ((rng.randn(6).astype(np.float32),
+                         rng.randn(6).astype(np.float32),
+                         rng.rand(6).astype(np.float32)), {}),
+))
+
+register(OpSpec(
+    name="scale",
+    fn=lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+        x * scale + bias if bias_after_scale else (x + bias) * scale,
+    oracle=lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+        x * scale + bias if bias_after_scale else (x + bias) * scale,
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),),
+                        {"scale": 2.0, "bias": 0.5,
+                         "bias_after_scale": False}),
+))
+
+register(OpSpec(
+    name="increment",
+    fn=lambda x, value=1.0: x + value,
+    oracle=lambda x, value=1.0: x + value,
+    sample=lambda rng: ((rng.randn(4).astype(np.float32),), {"value": 2.5}),
+    grad=False,  # paddle-faithful IN-PLACE op: mutates x, not a tape leaf
+))
+
+register(OpSpec(
+    name="clip",
+    fn=lambda x, min=None, max=None: jnp.clip(x, min, max),
+    oracle=lambda x, min=None, max=None: np.clip(x, min, max),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32) * 2,),
+                        {"min": -1.0, "max": 1.5}),
+    grad=False,  # FD undefined at the clip boundaries
+))
+
+# --- comparisons / logicals / predicates (fp32, no grad) --------------------
+def _b2(rng):
+    a = (rng.rand(8) > 0.5).astype(np.float32)
+    b = (rng.rand(8) > 0.5).astype(np.float32)
+    return (a, b), {}
+
+
+for _name, _jf, _nf, _gen in [
+    ("equal", jnp.equal, np.equal, _u2()),
+    ("not_equal", jnp.not_equal, np.not_equal, _u2()),
+    ("greater_than", jnp.greater, np.greater, _u2()),
+    ("greater_equal", jnp.greater_equal, np.greater_equal, _u2()),
+    ("less_than", jnp.less, np.less, _u2()),
+    ("less_equal", jnp.less_equal, np.less_equal, _u2()),
+    ("logical_and", jnp.logical_and, np.logical_and, _b2),
+    ("logical_or", jnp.logical_or, np.logical_or, _b2),
+    ("logical_xor", jnp.logical_xor, np.logical_xor, _b2),
+    ("logical_not", jnp.logical_not, np.logical_not,
+     lambda rng: (((rng.rand(8) > 0.5).astype(np.float32),), {})),
+    ("isfinite", jnp.isfinite, np.isfinite,
+     lambda rng: ((np.asarray([1.0, np.inf, -np.inf, np.nan, 2.0],
+                              np.float32),), {})),
+    ("isinf", jnp.isinf, np.isinf,
+     lambda rng: ((np.asarray([1.0, np.inf, -np.inf, np.nan, 2.0],
+                              np.float32),), {})),
+    ("isnan", jnp.isnan, np.isnan,
+     lambda rng: ((np.asarray([1.0, np.inf, np.nan, 2.0],
+                              np.float32),), {})),
+    ("isclose", jnp.isclose, np.isclose,
+     lambda rng: ((np.asarray([1.0, 2.0, 3.0], np.float32),
+                   np.asarray([1.0, 2.000001, 3.5], np.float32)), {})),
+    ("allclose", lambda x, y, **kw: jnp.allclose(x, y, **kw),
+     lambda x, y, **kw: np.allclose(x, y, **kw),
+     lambda rng: ((np.ones(4, np.float32),
+                   np.ones(4, np.float32) * (1 + 1e-7)), {})),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen,
+                    dtypes=("float32",), grad=False))
+
+# --- bitwise (int32) --------------------------------------------------------
+for _name, _jf, _nf, _nargs in [
+    ("bitwise_and", jnp.bitwise_and, np.bitwise_and, 2),
+    ("bitwise_or", jnp.bitwise_or, np.bitwise_or, 2),
+    ("bitwise_xor", jnp.bitwise_xor, np.bitwise_xor, 2),
+    ("bitwise_not", jnp.bitwise_not, np.bitwise_not, 1),
+]:
+    register(OpSpec(
+        name=_name, fn=_jf, oracle=_nf,
+        sample=(lambda k: lambda rng: (tuple(
+            rng.randint(0, 63, 8).astype(np.int32) for _ in range(k)),
+            {}))(_nargs),
+        dtypes=("int32",), integer_inputs=(0, 1), grad=False))
+
+# --- reductions -------------------------------------------------------------
+def _red(shape=(4, 6), **attrs):
+    def gen(rng):
+        return (rng.randn(*shape).astype(np.float32),), dict(attrs)
+    return gen
+
+
+for _name, _jf, _nf, _gen, _grad in [
+    ("sum", lambda x, axis=None, keepdim=False: jnp.sum(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.sum(
+         x, axis=axis, keepdims=keepdim), _red(axis=1), True),
+    ("mean", lambda x, axis=None, keepdim=False: jnp.mean(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.mean(
+         x, axis=axis, keepdims=keepdim), _red(axis=1, keepdim=True), True),
+    ("prod", lambda x, axis=None, keepdim=False: jnp.prod(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.prod(
+         x, axis=axis, keepdims=keepdim),
+     lambda rng: ((rng.rand(4, 5).astype(np.float32) + 0.5,), {"axis": 1}),
+     True),
+    ("max", lambda x, axis=None, keepdim=False: jnp.max(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.max(
+         x, axis=axis, keepdims=keepdim), _red(axis=0), False),
+    ("min", lambda x, axis=None, keepdim=False: jnp.min(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.min(
+         x, axis=axis, keepdims=keepdim), _red(axis=0), False),
+    ("amax", lambda x, axis=None, keepdim=False: jnp.max(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.max(
+         x, axis=axis, keepdims=keepdim), _red(axis=1), False),
+    ("amin", lambda x, axis=None, keepdim=False: jnp.min(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.min(
+         x, axis=axis, keepdims=keepdim), _red(axis=1), False),
+    ("logsumexp", lambda x, axis=None, keepdim=False: jax.nn.logsumexp(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.log(np.sum(
+         np.exp(x), axis=axis, keepdims=keepdim)), _red(axis=1), True),
+    ("count_nonzero", lambda x, axis=None, keepdim=False: jnp.count_nonzero(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.count_nonzero(
+         x, axis=axis, keepdims=keepdim),
+     lambda rng: ((np.where(rng.rand(4, 5) < 0.3, 0.0,
+                            rng.randn(4, 5)).astype(np.float32),),
+                  {"axis": 1}), False),
+    ("argmax", lambda x, axis=None, keepdim=False: jnp.argmax(x, axis=axis),
+     lambda x, axis=None, keepdim=False: np.argmax(x, axis=axis),
+     _red(axis=1), False),
+    ("argmin", lambda x, axis=None, keepdim=False: jnp.argmin(x, axis=axis),
+     lambda x, axis=None, keepdim=False: np.argmin(x, axis=axis),
+     _red(axis=1), False),
+    ("cumsum", lambda x, axis=None: jnp.cumsum(
+        x, axis=axis if axis is not None else None),
+     lambda x, axis=None: np.cumsum(x, axis=axis), _red(axis=1), True),
+    ("median", lambda x, axis=None, keepdim=False: jnp.median(
+        x, axis=axis, keepdims=keepdim),
+     lambda x, axis=None, keepdim=False: np.median(
+         x, axis=axis, keepdims=keepdim), _red(shape=(3, 7), axis=1), False),
+    ("quantile", lambda x, q, axis=None, keepdim=False: jnp.quantile(
+        x, q, axis=axis, keepdims=keepdim),
+     lambda x, q, axis=None, keepdim=False: np.quantile(
+         x, q, axis=axis, keepdims=keepdim),
+     lambda rng: ((rng.randn(4, 9).astype(np.float32),),
+                  {"q": 0.25, "axis": 1}), False),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen, grad=_grad,
+                    dtypes=("float32", "bfloat16")
+                    if _name in ("sum", "mean", "max", "min", "amax", "amin")
+                    else ("float32",),
+                    tol={"bfloat16": 5e-2}))
+
+register(OpSpec(
+    name="cumprod",
+    fn=lambda x, dim=None: jnp.cumprod(x, axis=dim),
+    oracle=lambda x, dim=None: np.cumprod(x, axis=dim),
+    sample=lambda rng: ((rng.rand(3, 6).astype(np.float32) + 0.5,),
+                        {"dim": 1}),
+    dtypes=("float32",),
+))
+
+for _name, _unb in [("std", True), ("var", True)]:
+    register(OpSpec(
+        name=_name,
+        fn=(lambda f: lambda x, axis=None, unbiased=True, keepdim=False:
+            f(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))(
+            jnp.std if _name == "std" else jnp.var),
+        oracle=(lambda f: lambda x, axis=None, unbiased=True, keepdim=False:
+                f(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))(
+            np.std if _name == "std" else np.var),
+        sample=_red(axis=1),
+        dtypes=("float32",),
+    ))
+
+register(OpSpec(
+    name="norm",
+    fn=lambda x, p="fro", axis=None, keepdim=False: jnp.linalg.norm(
+        x, ord=p, axis=axis, keepdims=keepdim),
+    oracle=lambda x, p="fro", axis=None, keepdim=False: np.linalg.norm(
+        x, ord=p, axis=axis, keepdims=keepdim),
+    sample=lambda rng: ((rng.randn(4, 6).astype(np.float32),),
+                        {"p": 2, "axis": 1}),
+    dtypes=("float32",),
+))
+
+register(OpSpec(
+    name="kthvalue",
+    fn=lambda x, k, axis=-1, keepdim=False: (
+        jnp.take(jnp.sort(x, axis=axis), k - 1, axis=axis),
+        jnp.take(jnp.argsort(x, axis=axis), k - 1, axis=axis)),
+    oracle=lambda x, k, axis=-1, keepdim=False: (
+        np.take(np.sort(x, axis=axis), k - 1, axis=axis),
+        np.take(np.argsort(x, axis=axis), k - 1, axis=axis)),
+    sample=lambda rng: ((rng.randn(3, 8).astype(np.float32),), {"k": 3}),
+    dtypes=("float32",), grad=False, n_outputs=2,
+))
+
+# --- shape / movement -------------------------------------------------------
+for _name, _jf, _nf, _gen in [
+    ("reshape", lambda x, shape: jnp.reshape(x, shape),
+     lambda x, shape: np.reshape(x, shape),
+     lambda rng: ((rng.randn(3, 8).astype(np.float32),),
+                  {"shape": (4, 6)})),
+    ("transpose", lambda x, perm: jnp.transpose(x, perm),
+     lambda x, perm: np.transpose(x, perm),
+     lambda rng: ((rng.randn(2, 3, 4).astype(np.float32),),
+                  {"perm": (2, 0, 1)})),
+    ("squeeze", lambda x, axis=None: jnp.squeeze(x, axis=axis),
+     lambda x, axis=None: np.squeeze(x, axis=axis),
+     lambda rng: ((rng.randn(3, 1, 4).astype(np.float32),), {"axis": 1})),
+    ("unsqueeze", lambda x, axis: jnp.expand_dims(x, axis),
+     lambda x, axis: np.expand_dims(x, axis),
+     lambda rng: ((rng.randn(3, 4).astype(np.float32),), {"axis": 1})),
+    ("flatten", lambda x, start_axis=0, stop_axis=-1: x.reshape(
+        x.shape[:start_axis]
+        + (-1,) + x.shape[(stop_axis % x.ndim) + 1:]),
+     lambda x, start_axis=0, stop_axis=-1: x.reshape(
+         x.shape[:start_axis]
+         + (-1,) + x.shape[(stop_axis % x.ndim) + 1:]),
+     lambda rng: ((rng.randn(2, 3, 4).astype(np.float32),),
+                  {"start_axis": 1, "stop_axis": 2})),
+    ("flip", lambda x, axis: jnp.flip(x, axis=axis),
+     lambda x, axis: np.flip(x, axis=axis),
+     lambda rng: ((rng.randn(3, 4).astype(np.float32),), {"axis": 1})),
+    ("roll", lambda x, shifts, axis=None: jnp.roll(x, shifts, axis=axis),
+     lambda x, shifts, axis=None: np.roll(x, shifts, axis=axis),
+     lambda rng: ((rng.randn(3, 5).astype(np.float32),),
+                  {"shifts": 2, "axis": 1})),
+    ("tile", lambda x, repeat_times: jnp.tile(x, repeat_times),
+     lambda x, repeat_times: np.tile(x, repeat_times),
+     lambda rng: ((rng.randn(2, 3).astype(np.float32),),
+                  {"repeat_times": (2, 2)})),
+    ("broadcast_to", lambda x, shape: jnp.broadcast_to(x, shape),
+     lambda x, shape: np.broadcast_to(x, shape),
+     lambda rng: ((rng.randn(1, 4).astype(np.float32),),
+                  {"shape": (3, 4)})),
+    ("expand", lambda x, shape: jnp.broadcast_to(x, shape),
+     lambda x, shape: np.broadcast_to(x, shape),
+     lambda rng: ((rng.randn(1, 5).astype(np.float32),),
+                  {"shape": (4, 5)})),
+    ("moveaxis", lambda x, source, destination: jnp.moveaxis(
+        x, source, destination),
+     lambda x, source, destination: np.moveaxis(x, source, destination),
+     lambda rng: ((rng.randn(2, 3, 4).astype(np.float32),),
+                  {"source": 0, "destination": 2})),
+    ("t", lambda x: x.T, lambda x: x.T,
+     lambda rng: ((rng.randn(3, 5).astype(np.float32),), {})),
+    ("tril", lambda x, diagonal=0: jnp.tril(x, k=diagonal),
+     lambda x, diagonal=0: np.tril(x, k=diagonal),
+     lambda rng: ((rng.randn(4, 5).astype(np.float32),),
+                  {"diagonal": 1})),
+    ("triu", lambda x, diagonal=0: jnp.triu(x, k=diagonal),
+     lambda x, diagonal=0: np.triu(x, k=diagonal),
+     lambda rng: ((rng.randn(4, 5).astype(np.float32),),
+                  {"diagonal": -1})),
+    ("diag", lambda x, offset=0, padding_value=0: jnp.diag(x, k=offset),
+     lambda x, offset=0, padding_value=0: np.diag(x, k=offset),
+     lambda rng: ((rng.randn(5).astype(np.float32),), {"offset": 1})),
+    ("diagonal", lambda x, offset=0, axis1=0, axis2=1: jnp.diagonal(
+        x, offset=offset, axis1=axis1, axis2=axis2),
+     lambda x, offset=0, axis1=0, axis2=1: np.diagonal(
+         x, offset=offset, axis1=axis1, axis2=axis2),
+     lambda rng: ((rng.randn(4, 5).astype(np.float32),), {"offset": 1})),
+    ("diag_embed", lambda x, offset=0, dim1=-2, dim2=-1: _jax_diag_embed(
+        x, offset),
+     lambda x, offset=0, dim1=-2, dim2=-1: _np_diag_embed(x, offset),
+     lambda rng: ((rng.randn(3, 4).astype(np.float32),), {})),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen,
+                    tol={"bfloat16": 4e-2}))
+
+
+def _jax_diag_embed(x, offset=0):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(x)
+
+
+def _np_diag_embed(x, offset=0):
+    n = x.shape[-1] + abs(offset)
+    out = np.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = np.arange(x.shape[-1])
+    out[..., idx + max(-offset, 0), idx + max(offset, 0)] = x
+    return out
+
+
+# --- matmul family ----------------------------------------------------------
+for _name, _jf, _nf, _gen in [
+    ("dot", jnp.dot, np.dot,
+     lambda rng: ((rng.randn(6).astype(np.float32),
+                   rng.randn(6).astype(np.float32)), {})),
+    ("outer", jnp.outer, np.outer,
+     lambda rng: ((rng.randn(4).astype(np.float32),
+                   rng.randn(5).astype(np.float32)), {})),
+    ("kron", jnp.kron, np.kron,
+     lambda rng: ((rng.randn(2, 3).astype(np.float32),
+                   rng.randn(3, 2).astype(np.float32)), {})),
+    ("bmm", jnp.matmul, np.matmul,
+     lambda rng: ((rng.randn(2, 3, 4).astype(np.float32),
+                   rng.randn(2, 4, 5).astype(np.float32)), {})),
+    ("mm", jnp.matmul, np.matmul,
+     lambda rng: ((rng.randn(3, 4).astype(np.float32),
+                   rng.randn(4, 5).astype(np.float32)), {})),
+    ("matmul", jnp.matmul, np.matmul,
+     lambda rng: ((rng.randn(3, 4).astype(np.float32),
+                   rng.randn(4, 5).astype(np.float32)), {})),
+]:
+    register(OpSpec(name=_name, fn=_jf, oracle=_nf, sample=_gen,
+                    tol={"bfloat16": 5e-2}))
+
+register(OpSpec(
+    name="einsum",
+    fn=lambda equation, *ops: jnp.einsum(equation, *ops),
+    oracle=lambda equation, *ops: np.einsum(equation, *ops),
+    sample=lambda rng: (("ij,jk->ik", rng.randn(3, 4).astype(np.float32),
+                         rng.randn(4, 5).astype(np.float32)), {}),
+    integer_inputs=(0,), grad=False,
+    tol={"bfloat16": 5e-2},
+))
+
+# --- indexing / selection ---------------------------------------------------
+register(OpSpec(
+    name="gather",
+    fn=lambda x, index, axis=0: jnp.take(x, index, axis=axis),
+    oracle=lambda x, index, axis=0: np.take(x, index, axis=axis),
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32),
+                         rng.randint(0, 6, 5).astype(np.int32)), {}),
+    integer_inputs=(1,),
+))
+
+register(OpSpec(
+    name="index_select",
+    fn=lambda x, index, axis=0: jnp.take(x, index, axis=axis),
+    oracle=lambda x, index, axis=0: np.take(x, index, axis=axis),
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32),
+                         rng.randint(0, 6, 3).astype(np.int32)),
+                        {"axis": 1 - 1}),
+    integer_inputs=(1,),
+))
+
+register(OpSpec(
+    name="gather_nd",
+    fn=lambda x, index: x[tuple(index[..., i]
+                               for i in range(index.shape[-1]))],
+    oracle=lambda x, index: np.stack(
+        [x[tuple(ix)] for ix in index.reshape(-1, index.shape[-1])]
+    ).reshape(index.shape[:-1] + x.shape[index.shape[-1]:]),
+    sample=lambda rng: ((rng.randn(5, 4).astype(np.float32),
+                         rng.randint(0, 4, (6, 2)).astype(np.int32)), {}),
+    integer_inputs=(1,),
+))
+
+register(OpSpec(
+    name="take_along_axis",
+    fn=lambda arr, indices, axis: jnp.take_along_axis(arr, indices, axis),
+    oracle=lambda arr, indices, axis: np.take_along_axis(
+        arr, indices, axis),
+    sample=lambda rng: ((rng.randn(4, 6).astype(np.float32),
+                         rng.randint(0, 6, (4, 3)).astype(np.int32)),
+                        {"axis": 1}),
+    integer_inputs=(1,),
+))
+
+def _jax_put_along_axis(arr, indices, values, axis, reduce="assign"):
+    if reduce != "assign":
+        raise NotImplementedError(
+            f"put_along_axis: reduce={reduce!r} not supported")
+    return jnp.put_along_axis(arr, indices, values, axis, inplace=False)
+
+
+register(OpSpec(
+    name="put_along_axis",
+    fn=_jax_put_along_axis,
+    oracle=lambda arr, indices, values, axis, reduce="assign":
+        _np_put_along_axis(arr, indices, values, axis),
+    sample=lambda rng: ((rng.randn(4, 5).astype(np.float32),
+                         np.stack([rng.permutation(5)[:2]
+                                   for _ in range(4)]).astype(np.int32),
+                         rng.randn(4, 2).astype(np.float32)),
+                        {"axis": 1}),
+    integer_inputs=(1,), grad_arg=0,
+))
+
+
+def _np_put_along_axis(arr, indices, values, axis):
+    out = np.asarray(arr).copy()
+    np.put_along_axis(out, np.asarray(indices), np.asarray(values), axis)
+    return out
+
+
+register(OpSpec(
+    name="index_sample",
+    fn=lambda x, index: jnp.take_along_axis(x, index, axis=1),
+    oracle=lambda x, index: np.take_along_axis(x, index, axis=1),
+    sample=lambda rng: ((rng.randn(4, 6).astype(np.float32),
+                         rng.randint(0, 6, (4, 3)).astype(np.int32)), {}),
+    integer_inputs=(1,),
+))
+
+register(OpSpec(
+    name="scatter",
+    fn=lambda x, index, updates, overwrite=True:
+        x.at[index].set(updates) if overwrite else x.at[index].add(updates),
+    oracle=lambda x, index, updates, overwrite=True:
+        _np_scatter(x, index, updates, overwrite),
+    sample=lambda rng: ((rng.randn(6, 4).astype(np.float32),
+                         rng.permutation(6)[:3].astype(np.int32),
+                         rng.randn(3, 4).astype(np.float32)), {}),
+    integer_inputs=(1,), grad_arg=0,
+))
+
+
+def _np_scatter(x, index, updates, overwrite):
+    out = np.asarray(x, np.float64).copy()
+    for i, ix in enumerate(index):
+        if overwrite:
+            out[ix] = updates[i]
+        else:
+            out[ix] += updates[i]
+    return out
+
+
+register(OpSpec(
+    name="scatter_nd_add",
+    fn=lambda x, index, updates: x.at[
+        tuple(index[..., i] for i in range(index.shape[-1]))].add(updates),
+    oracle=lambda x, index, updates: _np_scatter_nd_add(x, index, updates),
+    sample=lambda rng: ((rng.randn(5, 4).astype(np.float32),
+                         rng.randint(0, 5, (6, 1)).astype(np.int32),
+                         rng.randn(6, 4).astype(np.float32)), {}),
+    integer_inputs=(1,), grad_arg=0,
+))
+
+
+def _np_scatter_nd_add(x, index, updates):
+    out = np.asarray(x, np.float64).copy()
+    for i in range(index.shape[0]):
+        out[tuple(index[i])] += updates[i]
+    return out
+
+
+register(OpSpec(
+    name="masked_fill",
+    fn=lambda x, mask, value: jnp.where(mask.astype(bool), value, x),
+    oracle=lambda x, mask, value: np.where(np.asarray(mask, bool), value, x),
+    sample=lambda rng: ((rng.randn(4, 5).astype(np.float32),
+                         (rng.rand(4, 5) > 0.5)), {"value": 9.0}),
+    integer_inputs=(1,), grad_arg=0,
+))
+
+register(OpSpec(
+    name="masked_select",
+    fn=lambda x, mask: x[mask.astype(bool)],
+    oracle=lambda x, mask: np.asarray(x)[np.asarray(mask, bool)],
+    sample=lambda rng: ((rng.randn(4, 5).astype(np.float32),
+                         (rng.rand(4, 5) > 0.5)), {}),
+    integer_inputs=(1,), grad=False,
+))
+
+register(OpSpec(
+    name="where",
+    fn=lambda condition, x=None, y=None: jnp.where(
+        condition.astype(bool), x, y),
+    oracle=lambda condition, x=None, y=None: np.where(
+        np.asarray(condition, bool), x, y),
+    sample=lambda rng: (((rng.rand(6) > 0.5),
+                         rng.randn(6).astype(np.float32),
+                         rng.randn(6).astype(np.float32)), {}),
+    integer_inputs=(0,), grad_arg=1,
+))
+
+register(OpSpec(
+    name="one_hot",
+    fn=lambda x, num_classes: jax.nn.one_hot(x, num_classes),
+    oracle=lambda x, num_classes: np.eye(num_classes, dtype=np.float32)[x],
+    sample=lambda rng: ((rng.randint(0, 5, 7).astype(np.int32),),
+                        {"num_classes": 5}),
+    dtypes=("float32",), integer_inputs=(0,), grad=False,
+))
+
+def _jax_topk(x, k, axis=-1, largest=True, sorted=True):
+    xm = jnp.moveaxis(x, axis, -1)
+    v, i = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        v = -v
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+
+
+register(OpSpec(
+    name="topk",
+    fn=_jax_topk,
+    oracle=lambda x, k, axis=-1, largest=True, sorted=True: (
+        np.sort(x, axis=axis)[..., ::-1][..., :k] if largest
+        else np.sort(x, axis=axis)[..., :k],
+        np.argsort(-x if largest else x, kind="stable",
+                   axis=axis)[..., :k]),
+    sample=lambda rng: ((rng.randn(3, 9).astype(np.float32),), {"k": 4}),
+    dtypes=("float32",), grad=False, n_outputs=2,
+))
+
+register(OpSpec(
+    name="sort",
+    fn=lambda x, axis=-1, descending=False: (
+        jnp.flip(jnp.sort(x, axis=axis), axis=axis) if descending
+        else jnp.sort(x, axis=axis)),
+    oracle=lambda x, axis=-1, descending=False: (
+        np.flip(np.sort(x, axis=axis), axis=axis) if descending
+        else np.sort(x, axis=axis)),
+    sample=lambda rng: ((rng.randn(3, 7).astype(np.float32),),
+                        {"descending": True}),
+    grad=False,
+))
+
+register(OpSpec(
+    name="argsort",
+    fn=lambda x, axis=-1, descending=False: jnp.argsort(
+        -x if descending else x, axis=axis),
+    oracle=lambda x, axis=-1, descending=False: np.argsort(
+        -x if descending else x, kind="stable", axis=axis),
+    sample=lambda rng: ((rng.randn(3, 7).astype(np.float32),), {}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="searchsorted",
+    fn=lambda sorted_sequence, values, out_int32=False, right=False:
+        jnp.searchsorted(sorted_sequence, values,
+                         side="right" if right else "left"),
+    oracle=lambda sorted_sequence, values, out_int32=False, right=False:
+        np.searchsorted(sorted_sequence, values,
+                        side="right" if right else "left"),
+    sample=lambda rng: ((np.sort(rng.randn(8)).astype(np.float32),
+                         rng.randn(5).astype(np.float32)), {}),
+    dtypes=("float32",), grad=False,
+))
+
+register(OpSpec(
+    name="bincount",
+    fn=lambda x, weights=None, minlength=0: jnp.bincount(
+        x, weights=weights, minlength=minlength),
+    oracle=lambda x, weights=None, minlength=0: np.bincount(
+        x, weights=weights, minlength=minlength),
+    sample=lambda rng: ((rng.randint(0, 6, 12).astype(np.int32),),
+                        {"minlength": 8}),
+    dtypes=("int32",), integer_inputs=(0,), grad=False,
+))
+
+register(OpSpec(
+    name="repeat_interleave",
+    fn=lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis),
+    oracle=lambda x, repeats, axis=None: np.repeat(x, repeats, axis=axis),
+    sample=lambda rng: ((rng.randn(3, 4).astype(np.float32),),
+                        {"repeats": 2, "axis": 1}),
+))
+
+register(OpSpec(
+    name="shard_index",
+    fn=lambda input, index_num, nshards, shard_id, ignore_value=-1:
+        jnp.where(input // ((index_num + nshards - 1) // nshards) == shard_id,
+                  input % ((index_num + nshards - 1) // nshards),
+                  ignore_value),
+    oracle=lambda input, index_num, nshards, shard_id, ignore_value=-1:
+        _np_shard_index(input, index_num, nshards, shard_id, ignore_value),
+    sample=lambda rng: ((rng.randint(0, 12, (6, 1)).astype(np.int32),),
+                        {"index_num": 12, "nshards": 3, "shard_id": 1}),
+    dtypes=("int32",), integer_inputs=(0,), grad=False,
+))
+
+
+def _np_shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+    inp = np.asarray(input)
+    return np.where(inp // size == shard_id, inp % size, ignore_value)

@@ -76,11 +76,18 @@ class CompileGuard:
 
 
 class StaticFunction:
-    """jit-compiled forward (inference/eval) over an imperative fn/Layer."""
+    """jit-compiled forward (inference/eval) over an imperative fn/Layer.
+
+    The wrapped fn passes through the dy2static AST rewrite first
+    (jit/dy2static.py), so data-dependent Python ``if``/``while`` over
+    Tensors lower to lax.cond / lax.while_loop instead of failing at trace
+    time — the SOT-conversion analog.
+    """
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  donate_params: bool = False):
-        self._fn = fn
+        from .dy2static import convert_control_flow
+        self._fn = convert_control_flow(fn)
         self._layer = layer
         self._jitted = None
         self.guard = CompileGuard(getattr(fn, "__name__", "to_static"))
@@ -258,3 +265,5 @@ def enable_to_static(flag: bool):
 
 
 from .save_load import save, load, TranslatedLayer  # noqa: E402,F401
+from .bucketing import ShapeBucketer, pad_to_bucket, next_bucket  # noqa: E402,F401
+from .dy2static import ConversionError, convert_control_flow  # noqa: E402,F401

@@ -1,0 +1,92 @@
+"""Dynamic-shape bucketing: pad ragged inputs to a small set of bucket
+shapes so jit caches stay warm.
+
+This is the survey's named CINN-replacement policy for dynamic shapes
+(SURVEY.md §2.5 CINN row): XLA compiles per concrete shape, so a stream of
+ragged batches (variable sequence lengths, variable image sizes, ragged
+detection counts) recompiles per step unless inputs are padded to buckets.
+:class:`ShapeBucketer` rounds each dynamic dim up to the next bucket and
+returns a validity mask; CompileGuard (jit/__init__.py) then sees at most
+``len(buckets)`` signatures instead of one per shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["next_bucket", "pad_to_bucket", "ShapeBucketer"]
+
+
+def next_bucket(n: int, buckets: Optional[Sequence[int]] = None,
+                multiple: int = 64) -> int:
+    """Smallest bucket >= n. With an explicit ``buckets`` list, pick from
+    it (the last bucket caps — larger inputs raise); otherwise round up to
+    ``multiple`` (TPU-friendly default 64: keeps padded dims lane-aligned
+    for the MXU/VPU)."""
+    if buckets:
+        for b in sorted(buckets):
+            if n <= b:
+                return int(b)
+        raise ValueError(
+            f"size {n} exceeds the largest bucket {max(buckets)}; add a "
+            "larger bucket or pre-truncate")
+    return int(-(-n // multiple) * multiple)
+
+
+def pad_to_bucket(x, axis: int = 0, buckets: Optional[Sequence[int]] = None,
+                  multiple: int = 64, pad_value=0):
+    """Pad ``x`` along ``axis`` up to the next bucket.
+
+    Returns ``(padded, valid_len)`` — valid_len is the ORIGINAL extent, for
+    masking downstream (losses, NMS, pooling).
+    """
+    arr = x._value if isinstance(x, Tensor) else np.asarray(x)
+    n = arr.shape[axis]
+    target = next_bucket(n, buckets, multiple)
+    if target == n:
+        return (x if isinstance(x, Tensor) else arr), n
+    import jax.numpy as jnp
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    out = jnp.pad(arr, widths, constant_values=pad_value)
+    return (Tensor(out) if isinstance(x, Tensor) else out), n
+
+
+class ShapeBucketer:
+    """Pads a batch of arrays to shared bucket shapes before a compiled
+    call. Tracks how many distinct bucket signatures it has produced; a
+    production loop can assert this stays small.
+
+    Example (ragged detection eval)::
+
+        bucketer = ShapeBucketer(axes={0: (64, 128, 256)})
+        padded, valid = bucketer(boxes)      # (128, 4), valid == {0: 87}
+        scores = compiled_fn(padded)[:valid[0]]
+    """
+
+    def __init__(self, axes: dict, multiple: int = 64, pad_value=0):
+        #: axes: {axis: buckets tuple or None (round to ``multiple``)}
+        self.axes = dict(axes)
+        self.multiple = multiple
+        self.pad_value = pad_value
+        self.signatures: set = set()
+
+    def __call__(self, x) -> Tuple[object, dict]:
+        """Pad every configured axis; returns (padded, {axis: valid_len})."""
+        valid = {}
+        for axis, buckets in sorted(self.axes.items()):
+            x, n = pad_to_bucket(x, axis=axis, buckets=buckets,
+                                 multiple=self.multiple,
+                                 pad_value=self.pad_value)
+            valid[axis] = n
+        shape = tuple(np.shape(x._value if isinstance(x, Tensor) else x))
+        self.signatures.add(shape)
+        return x, valid
+
+    @property
+    def num_signatures(self) -> int:
+        return len(self.signatures)

@@ -1,0 +1,520 @@
+"""dy2static control-flow conversion — the SOT analog.
+
+Reference: python/paddle/jit/sot/ + python/paddle/jit/dy2static/ (SURVEY.md
+§2.5 dy2static row). The reference rewrites bytecode/AST so data-dependent
+Python ``if``/``while`` over Tensors become graph ops (cond/while); here the
+same AST rewrite targets ``lax.cond`` / ``lax.while_loop``:
+
+* every ``if``/``while`` statement is rewritten into a call to
+  :func:`convert_ifelse` / :func:`convert_while`,
+* at RUNTIME those helpers dispatch: a plain Python/concrete-bool predicate
+  executes the branch normally (zero behavioural change outside tracing); a
+  traced Tensor predicate becomes ``lax.cond`` / ``lax.while_loop`` so the
+  function compiles ONCE instead of failing with TracerBoolConversionError,
+* anything outside the convertible subset fails with a
+  :class:`ConversionError` naming the source line and the rule it broke —
+  the actionable-diagnostic half of the contract.
+
+Convertible subset (documented limits, mirroring the reference's supported
+cases): branch bodies that assign variables and/or both-return; loop bodies
+that assign carried variables. ``break``/``continue``/``return`` inside a
+converted-while and single-branch ``return`` raise ConversionError.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ConversionError", "convert_ifelse", "convert_while",
+           "convert_control_flow"]
+
+
+class ConversionError(RuntimeError):
+    """Data-dependent control flow that cannot be converted; the message
+    names the offending source location and what to change."""
+
+
+def _is_traced(v) -> bool:
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _wrap_like(tree, template):
+    t_leaves = jax.tree_util.tree_leaves(
+        template, is_leaf=lambda v: isinstance(v, Tensor))
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = [Tensor(v) if isinstance(t, Tensor) else v
+           for v, t in zip(leaves, t_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class _Undefined:
+    """Placeholder for a name not bound before the branch assigned it."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def check_unconvertible(pred, loc: str, reason: str):
+    """Guard for control flow left in Python form: concrete predicates pass
+    through (original behaviour); traced ones get the actionable error."""
+    p = pred._value if isinstance(pred, Tensor) else pred
+    if isinstance(p, jax.core.Tracer):
+        raise ConversionError(f"{loc}: {reason}")
+    return bool(p)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = ""):
+    """Runtime dispatch for a rewritten ``if`` statement.
+
+    Both branch fns take no arguments (they close over the local scope) and
+    return the tuple of names assigned in either branch.
+    """
+    p = pred._value if isinstance(pred, Tensor) else pred
+    if not isinstance(p, jax.core.Tracer):
+        # concrete: behave exactly like the original Python if
+        return true_fn() if bool(p) else false_fn()
+    pb = jnp.asarray(p)
+    if pb.shape != ():
+        raise ConversionError(
+            f"{loc}: tensor predicate of a converted `if` must be a scalar, "
+            f"got shape {tuple(pb.shape)}; reduce it (e.g. .all()/.any()) "
+            "first")
+    try:
+        t_out = true_fn()
+        f_out = false_fn()
+    except NameError as e:
+        raise ConversionError(
+            f"{loc}: {e} while tracing both branches of a data-dependent "
+            "`if` — a variable assigned in only one branch must be "
+            "initialised before the `if`") from e
+    tu, fu = _unwrap(t_out), _unwrap(f_out)
+    t_struct = jax.tree_util.tree_structure(tu)
+    f_struct = jax.tree_util.tree_structure(fu)
+    if t_struct != f_struct:
+        raise ConversionError(
+            f"{loc}: the two branches of a converted `if` produced "
+            f"different variable structures ({t_struct} vs {f_struct}); "
+            "assign the same variables (with the same nesting) in both "
+            "branches")
+    for a, b in zip(jax.tree_util.tree_leaves(tu),
+                    jax.tree_util.tree_leaves(fu)):
+        if isinstance(a, _Undefined) or isinstance(b, _Undefined):
+            raise ConversionError(
+                f"{loc}: a variable assigned in only one branch of a "
+                "data-dependent `if` is undefined in the other; initialise "
+                "it before the `if`")
+        sa = getattr(a, "shape", None)
+        sb = getattr(b, "shape", None)
+        if sa != sb:
+            raise ConversionError(
+                f"{loc}: branch outputs disagree on shape ({sa} vs {sb}); "
+                "lax.cond requires both branches to produce identical "
+                "shapes/dtypes")
+    out = jax.lax.cond(pb.astype(bool), lambda: tu, lambda: fu)
+    return _wrap_like(out, t_out)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, carry, loc: str = ""):
+    """Runtime dispatch for a rewritten ``while``.
+
+    cond_fn(carry) -> predicate; body_fn(carry) -> new carry (same
+    structure). Concrete predicates run the plain Python loop; traced ones
+    lower to ``lax.while_loop`` (one compile, data-dependent trip count).
+    """
+    first = cond_fn(carry)
+    if not _is_traced(first) and not any(
+            _is_traced(v) for v in jax.tree_util.tree_leaves(
+                _unwrap(carry))):
+        while bool(first._value if isinstance(first, Tensor) else first):
+            carry = body_fn(carry)
+            first = cond_fn(carry)
+        return carry
+    for v in jax.tree_util.tree_leaves(_unwrap(carry)):
+        if isinstance(v, _Undefined):
+            raise ConversionError(
+                f"{loc}: a loop-carried variable is undefined before a "
+                "data-dependent `while`; initialise every variable the "
+                "loop assigns")
+    ucarry = _unwrap(carry)
+
+    def cond(u):
+        p = _unwrap(cond_fn(_wrap_like(u, carry)))
+        return jnp.asarray(p).astype(bool).reshape(())
+
+    def body(u):
+        new = _unwrap(body_fn(_wrap_like(u, carry)))
+        ns = jax.tree_util.tree_structure(new)
+        os = jax.tree_util.tree_structure(ucarry)
+        if ns != os:
+            raise ConversionError(
+                f"{loc}: converted `while` body changed the carried "
+                f"variable structure ({os} -> {ns}); a compiled loop needs "
+                "a fixed set of variables")
+        return new
+
+    try:
+        out = jax.lax.while_loop(cond, body, ucarry)
+    except TypeError as e:
+        raise ConversionError(
+            f"{loc}: lax.while_loop rejected the loop ({e}); carried "
+            "shapes/dtypes must be identical every iteration — pad or "
+            "bucket growing tensors (paddle_tpu.jit.pad_to_bucket)") from e
+    return _wrap_like(out, carry)
+
+
+# ===========================================================================
+# AST rewrite
+# ===========================================================================
+def _store_names(nodes) -> set:
+    """VARIABLE names bound by assignment/augassign/for-targets within
+    ``nodes``. Does not descend into nested function/class definitions and
+    does NOT include def/class names — function/class objects cannot ride a
+    lax.cond/while carry (this also excludes the __dy2st_* helper defs a
+    nested rewrite plants)."""
+    found = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # do not descend, do not carry
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                found.add(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return found
+
+
+def _load_names(node) -> set:
+    found = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load):
+                found.add(node.id)
+
+    V().visit(node)
+    return found
+
+
+def _has(nodes, kinds) -> ast.AST:
+    """First node of any of ``kinds`` inside ``nodes``, PRUNING nested
+    function/class subtrees (a Return inside a nested def — including the
+    __dy2st_* branch helpers an inner rewrite plants — does not belong to
+    the enclosing statement)."""
+    hit = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # prune
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def generic_visit(self, node):
+            if not hit and isinstance(node, kinds):
+                hit.append(node)
+            if not hit:
+                super().generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+        if hit:
+            return hit[0]
+    return None
+
+
+class _RewriteControlFlow(ast.NodeTransformer):
+    """Rewrite If/While statements into convert_ifelse/convert_while calls."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.counter = 0
+
+    def _loc(self, node) -> str:
+        return f"{self.filename}:{node.lineno}"
+
+    @staticmethod
+    def _undef_preamble(names):
+        """`try: name / except NameError: name = UNDEFINED` per name, so a
+        name bound in only one branch/iteration traces as an UNDEFINED leaf
+        instead of crashing with NameError inside the branch closure."""
+        out = []
+        for a in names:
+            out.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=a, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=a, ctx=ast.Store())],
+                        value=ast.Name(id="__dy2st_UNDEFINED",
+                                       ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return out
+
+    @staticmethod
+    def _undef_cleanup(names):
+        """`if name is UNDEFINED: del name` per name — restores the exact
+        unbound-variable behaviour after the concrete path leaves a
+        placeholder in a variable its taken branch never assigned."""
+        out = []
+        for a in names:
+            out.append(ast.If(
+                test=ast.Compare(
+                    left=ast.Name(id=a, ctx=ast.Load()),
+                    ops=[ast.Is()],
+                    comparators=[ast.Name(id="__dy2st_UNDEFINED",
+                                          ctx=ast.Load())]),
+                body=[ast.Delete(
+                    targets=[ast.Name(id=a, ctx=ast.Del())])],
+                orelse=[]))
+        return out
+
+    def _guard_test(self, node, reason: str):
+        """Leave the statement in Python form, but wrap its test so a
+        TRACED predicate raises the actionable ConversionError while
+        concrete predicates behave exactly as before."""
+        node.test = ast.Call(
+            func=ast.Name(id="__dy2st_check_unconvertible", ctx=ast.Load()),
+            args=[node.test, ast.Constant(value=self._loc(node)),
+                  ast.Constant(value=reason)],
+            keywords=[])
+        ast.copy_location(node.test, node)
+        return node
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        esc = _has(body + orelse, (ast.Break, ast.Continue))
+        if esc is not None:
+            # cannot pull a loop-escape statement into a branch function;
+            # keep Python form, diagnose only if the predicate is traced
+            return self._guard_test(
+                node,
+                f"`{type(esc).__name__.lower()}` inside a data-dependent "
+                "`if` is not convertible; fold the condition into the "
+                "enclosing loop predicate")
+        rets = (_has(body, ast.Return), _has(orelse, ast.Return))
+        loc = self._loc(node)
+        n = self.counter
+        self.counter += 1
+        if rets[0] or rets[1]:
+            # supported: BOTH branches are a single `return <expr>`
+            if (len(body) == 1 and isinstance(body[0], ast.Return)
+                    and len(orelse) == 1 and isinstance(orelse[0], ast.Return)
+                    and body[0].value is not None
+                    and orelse[0].value is not None):
+                defs, call = self._make_call(
+                    node, n, [ast.Return(value=body[0].value)],
+                    [ast.Return(value=orelse[0].value)], returning=True)
+                return [ast.copy_location(s, node)
+                        for s in defs + [ast.Return(value=call)]]
+            return self._guard_test(
+                node,
+                "`return` inside a data-dependent `if` is convertible only "
+                "as `if p: return a` + `else: return b` (both branches a "
+                "single return); restructure, or compute the value with "
+                "paddle.where")
+        assigned = sorted((_store_names(body) | _store_names(orelse)))
+        defs, call = self._make_call(node, n, body, orelse, names=assigned)
+        if assigned:
+            target = ast.Tuple(
+                elts=[ast.Name(id=a, ctx=ast.Store()) for a in assigned],
+                ctx=ast.Store())
+            stmts = (self._undef_preamble(assigned) + defs
+                     + [ast.Assign(targets=[target], value=call)]
+                     + self._undef_cleanup(assigned))
+        else:
+            stmts = defs + [ast.Expr(value=call)]
+        return [ast.copy_location(s, node) for s in stmts]
+
+    def _make_call(self, node, n, body, orelse, names=None, returning=False):
+        """Build __dy2st_true_N/__dy2st_false_N defs + the convert call."""
+        def branch(name, stmts):
+            stmts = list(stmts) or [ast.Pass()]
+            params, defaults = [], []
+            if not returning:
+                tup = ast.Tuple(
+                    elts=[ast.Name(id=a, ctx=ast.Load()) for a in names],
+                    ctx=ast.Load())
+                stmts = stmts + [ast.Return(value=tup)]
+                # read+assign of the same name inside the branch closure
+                # (e.g. `s = s + x`) would shadow the enclosing binding and
+                # hit UnboundLocalError; snapshot the pre-if values as
+                # default arguments instead (evaluated at def time, after
+                # the UNDEFINED preamble, so always bound)
+                params = [ast.arg(arg=a) for a in names]
+                defaults = [ast.Name(id=a, ctx=ast.Load()) for a in names]
+            return ast.FunctionDef(
+                name=name, args=ast.arguments(
+                    posonlyargs=[], args=params, kwonlyargs=[],
+                    kw_defaults=[], defaults=defaults),
+                body=stmts, decorator_list=[], type_params=[])
+
+        tfn = branch(f"__dy2st_true_{n}", body)
+        ffn = branch(f"__dy2st_false_{n}", orelse)
+        call = ast.Call(
+            func=ast.Name(id="__dy2st_convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tfn.name, ctx=ast.Load()),
+                  ast.Name(id=ffn.name, ctx=ast.Load()),
+                  ast.Constant(value=self._loc(node))],
+            keywords=[])
+        return [tfn, ffn], call
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        loc = self._loc(node)
+        bad = _has(node.body, (ast.Break, ast.Continue, ast.Return))
+        if bad is not None:
+            kind = type(bad).__name__.lower()
+            return self._guard_test(
+                node,
+                f"`{kind}` (line {bad.lineno}) inside a data-dependent "
+                "`while` is not convertible to lax.while_loop; fold the "
+                "exit condition into the loop predicate")
+        if node.orelse:
+            return self._guard_test(
+                node, "`while ... else` is not convertible")
+        # carry = names the body assigns; loop-invariant reads (modules,
+        # helper fns, constants) stay closure-captured
+        carried = sorted(_store_names(node.body))
+        n = self.counter
+        self.counter += 1
+
+        def loads():
+            return ast.Tuple(
+                elts=[ast.Name(id=a, ctx=ast.Load()) for a in carried],
+                ctx=ast.Load())
+
+        carry_tuple_s = ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Store()) for a in carried],
+            ctx=ast.Store())
+        def arg():
+            return ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__dy2st_carry")],
+                kwonlyargs=[], kw_defaults=[], defaults=[])
+
+        def unpack():
+            return ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=a, ctx=ast.Store()) for a in carried],
+                    ctx=ast.Store())],
+                value=ast.Name(id="__dy2st_carry", ctx=ast.Load()))
+
+        cond_fn = ast.FunctionDef(
+            name=f"__dy2st_cond_{n}", args=arg(),
+            body=[unpack(), ast.Return(value=node.test)],
+            decorator_list=[], type_params=[])
+        body_fn = ast.FunctionDef(
+            name=f"__dy2st_body_{n}", args=arg(),
+            body=[unpack()] + list(node.body)
+            + [ast.Return(value=loads())],
+            decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=ast.Name(id="__dy2st_convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cond_fn.name, ctx=ast.Load()),
+                  ast.Name(id=body_fn.name, ctx=ast.Load()),
+                  loads(),
+                  ast.Constant(value=loc)],
+            keywords=[])
+        assign = ast.Assign(targets=[carry_tuple_s], value=call)
+        return [ast.copy_location(s, node)
+                for s in (self._undef_preamble(carried)
+                          + [cond_fn, body_fn, assign]
+                          + self._undef_cleanup(carried))]
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """AST-rewrite ``fn`` so tensor-predicated if/while lower to
+    lax.cond/lax.while_loop at trace time (and run unchanged eagerly).
+
+    Returns ``fn`` unmodified (with a warning) when its source is
+    unavailable (builtins, C extensions, REPL-defined lambdas).
+    """
+    if inspect.ismethod(fn):
+        conv = convert_control_flow(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        filename = inspect.getsourcefile(fn) or "<dy2static>"
+    except (OSError, TypeError):
+        warnings.warn(
+            f"dy2static: source of {getattr(fn, '__name__', fn)!r} is "
+            "unavailable; data-dependent control flow will fail under jit",
+            stacklevel=2)
+        return fn
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    # drop decorators (to_static etc.) so exec doesn't re-apply them
+    fdef.decorator_list = []
+    if _has(fdef.body, (ast.If, ast.While)) is None:
+        return fn  # nothing to rewrite
+    new_tree = _RewriteControlFlow(filename).visit(tree)
+    ast.fix_missing_locations(new_tree)
+    glb = dict(fn.__globals__)
+    glb["__dy2st_convert_ifelse"] = convert_ifelse
+    glb["__dy2st_convert_while"] = convert_while
+    glb["__dy2st_check_unconvertible"] = check_unconvertible
+    glb["__dy2st_UNDEFINED"] = UNDEFINED
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # re-bind the original closure: wrap the rewritten def in a factory
+        # taking the free variables as parameters (their CURRENT cell values
+        # are snapshotted at conversion time)
+        factory = ast.FunctionDef(
+            name="__dy2st_factory",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[new_tree.body[0],
+                  ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+            decorator_list=[], type_params=[])
+        new_tree = ast.Module(body=[factory], type_ignores=[])
+        ast.fix_missing_locations(new_tree)
+        code = compile(new_tree, filename, "exec")
+        loc: dict = {}
+        exec(code, glb, loc)
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = loc["__dy2st_factory"](*cells)
+    else:
+        code = compile(new_tree, filename, "exec")
+        loc = {}
+        exec(code, glb, loc)
+        new_fn = loc[fdef.name]
+    return functools.wraps(fn)(new_fn)

@@ -259,6 +259,24 @@ class PPYOLOE(nn.Layer):
         return apply(fn, scores, boxes, op_name="ppyoloe_predict",
                      n_outputs=4)
 
+    def predict_bucketed(self, images, score_threshold=0.25, top_k=100,
+                         batch_buckets=(1, 2, 4, 8)):
+        """Ragged-batch eval with shape bucketing — the workload-#5
+        dynamic-shape story (SURVEY.md §2.5 CINN row).
+
+        ``images``: (B, C, H, W) with B varying call-to-call (e.g. the last
+        incomplete batch of an eval epoch, or a dynamic serving batch). The
+        batch axis is padded up to the next bucket so the compiled program
+        is reused across at most ``len(batch_buckets)`` signatures instead
+        of recompiling per distinct B; padded rows are sliced off the
+        outputs.
+        """
+        from ...jit.bucketing import pad_to_bucket
+        padded, b = pad_to_bucket(images, axis=0, buckets=batch_buckets,
+                                  pad_value=0.0)
+        val, sel, lab, keep = self.predict(padded, score_threshold, top_k)
+        return val[:b], sel[:b], lab[:b], keep[:b]
+
     def compute_loss(self, images, gt_boxes, gt_labels, radius=2.5):
         """gt_boxes (B, G, 4) xyxy pixels (pad: zeros), gt_labels (B, G)
         int (-1 = pad). Center-radius assignment: an anchor is positive for
@@ -334,3 +352,22 @@ def ppyoloe_m(num_classes=80, **kw):
 
 def ppyoloe_l(num_classes=80, **kw):
     return PPYOLOE(num_classes, width_mult=1.0, depth_mult=1.0, **kw)
+
+
+def pad_ground_truth(boxes_list, labels_list, buckets=(8, 16, 32, 64)):
+    """Pad a ragged list of per-image ground truths into the dense
+    (B, G_bucket, 4) / (B, G_bucket) layout ``compute_loss`` consumes
+    (labels -1 = pad), with G rounded up to a bucket so the compiled loss
+    sees a bounded signature set (workload-#5 dynamic-shape policy)."""
+    from ...jit.bucketing import next_bucket
+    b = len(boxes_list)
+    gmax = max((np.shape(bx)[0] for bx in boxes_list), default=1)
+    g = next_bucket(max(gmax, 1), buckets)
+    boxes = np.zeros((b, g, 4), np.float32)
+    labels = np.full((b, g), -1, np.int32)
+    for i, (bx, lb) in enumerate(zip(boxes_list, labels_list)):
+        n = np.shape(bx)[0]
+        if n:
+            boxes[i, :n] = np.asarray(bx, np.float32)
+            labels[i, :n] = np.asarray(lb, np.int32)
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(labels))

@@ -62,3 +62,37 @@ def test_train_step_reduces_loss():
     losses = [float(step(x, gt_boxes, gt_labels)) for _ in range(8)]
     assert all(np.isfinite(v) for v in losses), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_predict_bucketed_ragged_batches():
+    """Workload-#5 dynamic-shape story: ragged eval batches pad to batch
+    buckets, so the compiled predict sees a bounded signature set and
+    padded rows are sliced off."""
+    from paddle_tpu.vision.models.ppyoloe import pad_ground_truth
+
+    net = _model()
+    net.eval()
+    rng = np.random.RandomState(1)
+    full = rng.randn(4, 3, 64, 64).astype(np.float32)
+    shapes = set()
+    for b in (1, 2, 3, 4):
+        val, sel, lab, keep = net.predict_bucketed(
+            paddle.to_tensor(full[:b]), top_k=10, batch_buckets=(2, 4))
+        assert val.shape[0] == b and sel.shape[0] == b
+        shapes.add(2 if b <= 2 else 4)
+    assert shapes == {2, 4}
+    # bucketed result == direct predict on the unpadded batch
+    v1, s1, l1, k1 = net.predict_bucketed(
+        paddle.to_tensor(full[:3]), top_k=10, batch_buckets=(4,))
+    v2, s2, l2, k2 = net.predict(paddle.to_tensor(full[:3]), top_k=10)
+    np.testing.assert_allclose(np.asarray(v1._value),
+                               np.asarray(v2._value), rtol=1e-5, atol=1e-6)
+
+    # ragged ground truths pad into the compute_loss layout
+    boxes, labels = pad_ground_truth(
+        [rng.rand(3, 4) * 32, rng.rand(7, 4) * 32, np.zeros((0, 4))],
+        [np.arange(3), np.arange(7), np.zeros((0,))], buckets=(8, 16))
+    assert tuple(boxes.shape) == (3, 8, 4)
+    assert tuple(labels.shape) == (3, 8)
+    lab_np = np.asarray(labels._value)
+    assert (lab_np[0, 3:] == -1).all() and (lab_np[2] == -1).all()
