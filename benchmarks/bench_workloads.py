@@ -1,0 +1,225 @@
+"""On-chip perf rows for the remaining BASELINE.md workloads (VERDICT
+round-2 item 2):
+
+* ``bert``   — workload #3: BERT-large-geometry MLM pretraining step over
+               the FusedMultiHeadAttention/FusedFeedForward encoder path.
+* ``moe``    — workload #4: GPT-MoE causal-LM train step, dense single-chip
+               expert path (the all_to_all path needs a mesh; its dryrun is
+               driver config 3).
+* ``decode`` — serving: GenerationEngine prefill + KV-cache decode split
+               (the AnalysisPredictor-replacement path).
+
+Run on the real chip:  python benchmarks/bench_workloads.py [bert|moe|decode]
+CPU smoke:             JAX_PLATFORMS=cpu BENCH_WORKLOADS_SMOKE=1 python ...
+Timing fences through a device->host transfer (float(...)) — on the axon
+platform block_until_ready returns early.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import detect_peak  # noqa: E402 — chip table lives in bench.py
+
+PEAK_V5E, _PEAK_GEN = detect_peak()
+
+
+def _setup():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.ops._common import is_tpu_platform
+
+    platform = jax.devices()[0].platform
+    smoke = os.environ.get("BENCH_WORKLOADS_SMOKE") == "1" or \
+        not is_tpu_platform(platform)
+    return jax, smoke
+
+
+def bench_bert():
+    jax, smoke = _setup()
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
+
+    if smoke:
+        cfg = ErnieConfig(vocab_size=512, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128, max_position_embeddings=64)
+        B, S, steps, warm = 2, 32, 2, 1
+    else:
+        # BERT-large geometry (workload #3 reference config)
+        cfg = ErnieConfig(vocab_size=30522, hidden_size=1024,
+                          num_hidden_layers=24, num_attention_heads=16,
+                          intermediate_size=4096,
+                          max_position_embeddings=512)
+        B, S, steps, warm = 16, 512, 10, 2
+
+    paddle.seed(0)
+    net = ErnieForMaskedLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
+    if not smoke:
+        amp.decorate(models=net, optimizers=opt, level="O2",
+                     dtype="bfloat16")
+
+    def loss_fn(model, ids, labels):
+        return model.compute_loss(ids, labels)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = rng.randint(0, cfg.vocab_size, (B, S))
+    labels[rng.rand(B, S) > 0.15] = -100       # MLM: 15% positions scored
+    labels = paddle.to_tensor(labels.astype(np.int64))
+
+    for _ in range(warm):
+        loss = step(ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = B * S * steps / dt
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    embed = cfg.vocab_size * cfg.hidden_size
+    # 6 flops/param/token on matmul params (embed gather excluded; the tied
+    # MLM head projection IS a matmul — count it once) + bidirectional
+    # attention 12·L·S·h
+    n_matmul = n_params - embed + embed  # tied head re-uses the embed matrix
+    flops_tok = 6.0 * n_matmul + 12.0 * cfg.num_hidden_layers * S * cfg.hidden_size
+    mfu = flops_tok * tok_s / PEAK_V5E if not smoke else 0.0
+    return {"metric": "bert_large_mlm_train", "tokens_per_sec": round(tok_s, 1),
+            "step_ms": round(dt / steps * 1e3, 1), "mfu": round(mfu, 4),
+            "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
+
+
+def bench_moe():
+    jax, smoke = _setup()
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.gpt_moe import GPTMoEConfig, GPTMoEForCausalLM
+
+    if smoke:
+        cfg = GPTMoEConfig(vocab_size=512, hidden_size=64,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           intermediate_size=128,
+                           max_position_embeddings=64, num_experts=4,
+                           moe_topk=2)
+        B, S, steps, warm = 2, 32, 2, 1
+    else:
+        cfg = GPTMoEConfig(vocab_size=50304, hidden_size=1024,
+                           num_hidden_layers=8, num_attention_heads=16,
+                           intermediate_size=4096,
+                           max_position_embeddings=1024, num_experts=8,
+                           moe_topk=2)
+        B, S, steps, warm = 8, 1024, 10, 2
+
+    paddle.seed(0)
+    net = GPTMoEForCausalLM(cfg)                  # moe_group None: dense path
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
+
+    def loss_fn(model, ids, labels):
+        return model.compute_loss(ids, labels)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(
+        np.roll(np.asarray(ids._value), -1, axis=-1).astype(np.int64))
+
+    for _ in range(warm):
+        loss = step(ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = B * S * steps / dt
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    # ACTIVE flops/token: attention block 6·4h² + topk experts 6·2·h·ff
+    # per layer + lm head + causal attention 6·L·S·h
+    flops_tok = L * (6 * 4 * h * h
+                     + cfg.moe_topk * 6 * 2 * h * cfg.intermediate_size) \
+        + 6 * h * cfg.vocab_size + 6.0 * L * S * h
+    mfu = flops_tok * tok_s / PEAK_V5E if not smoke else 0.0
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    return {"metric": "gpt_moe_train_dense", "tokens_per_sec": round(tok_s, 1),
+            "step_ms": round(dt / steps * 1e3, 1), "active_mfu": round(mfu, 4),
+            "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
+
+
+def bench_decode():
+    jax, smoke = _setup()
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.inference.decoding import (GenerationConfig,
+                                               llama_engine)
+
+    if smoke:
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        B, T, new = 2, 16, 8
+    else:
+        # the 876M serving config (wide3072) in bf16 — decode is
+        # HBM-bandwidth-bound, so tokens/s tracks bytes-of-weights/step
+        cfg = L.LlamaConfig(
+            vocab_size=32000, hidden_size=3072, intermediate_size=8192,
+            num_hidden_layers=6, num_attention_heads=24,
+            num_key_value_heads=24, max_position_embeddings=2048,
+            dtype=jnp.bfloat16)
+        B, T, new = 8, 512, 128
+
+    params = L.init_stacked_params(cfg, seed=0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+
+    def run(max_new):
+        eng = llama_engine(cfg, GenerationConfig(max_new_tokens=max_new))
+        out = eng.generate(params, ids)          # compile
+        t0 = time.perf_counter()
+        out = eng.generate(params, ids)
+        _ = int(np.asarray(out)[0, -1])          # host fence
+        return time.perf_counter() - t0
+
+    t_prefill = run(1)                            # ≈ prefill + 1 token
+    t_full = run(new)
+    decode_s = max(t_full - t_prefill, 1e-9)
+    decode_tok_s = B * (new - 1) / decode_s
+    # bandwidth ceiling note: every decode step streams the full weight set
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    bytes_per_tok = n_params * 2 / B              # bf16, amortised over batch
+    return {"metric": "llama_876M_serving_decode",
+            "prefill_ms": round(t_prefill * 1e3, 1),
+            "decode_tokens_per_sec": round(decode_tok_s, 1),
+            "per_seq_tokens_per_sec": round(decode_tok_s / B, 1),
+            "hbm_gbps_implied": round(decode_tok_s * bytes_per_tok / 1e9, 1),
+            "batch": B, "prompt": T, "new_tokens": new}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    benches = {"bert": bench_bert, "moe": bench_moe, "decode": bench_decode}
+    if which != "all" and which not in benches:
+        sys.exit(f"unknown bench {which!r}; pick from "
+                 f"{['all'] + sorted(benches)}")
+    for name, fn in benches.items():
+        if which not in ("all", name):
+            continue
+        print(json.dumps(fn()))
+
+
+if __name__ == "__main__":
+    main()

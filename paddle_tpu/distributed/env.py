@@ -49,7 +49,11 @@ def init_parallel_env(strategy=None, timeout_s: Optional[int] = None
     if _initialized[0]:
         return ParallelEnv()
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if nprocs > 1 and jax.process_count() == 1:
+    # IMPORTANT: do not probe jax.process_count() here — it initialises the
+    # XLA backend, after which jax.distributed.initialize() refuses to run
+    # (found by the round-3 two-process rehearsal, tests/test_launch.py).
+    # is_initialized() only checks the coordination-service client handle.
+    if nprocs > 1 and not jax.distributed.is_initialized():
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         master = coordinator_address()
         kwargs = {}
