@@ -92,10 +92,11 @@ def bench_bert():
     tok_s = B * S * steps / dt
     n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
     embed = cfg.vocab_size * cfg.hidden_size
-    # 6 flops/param/token on matmul params (embed gather excluded; the tied
-    # MLM head projection IS a matmul — count it once) + bidirectional
-    # attention 12·L·S·h
-    n_matmul = n_params - embed + embed  # tied head re-uses the embed matrix
+    # 6 flops/param/token on matmul params: the embedding GATHER is free,
+    # but the tied MLM head re-uses that same matrix as a real projection
+    # matmul, so the embed params stay in the count — net n_params.
+    # Plus bidirectional attention 12·L·S·h
+    n_matmul = n_params
     flops_tok = 6.0 * n_matmul + 12.0 * cfg.num_hidden_layers * S * cfg.hidden_size
     mfu = flops_tok * tok_s / PEAK_V5E if not smoke else 0.0
     return {"metric": "bert_large_mlm_train", "tokens_per_sec": round(tok_s, 1),

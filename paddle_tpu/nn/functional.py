@@ -1027,6 +1027,33 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return (out, None) if return_softmax else (out, None)
 
 
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        training=True, name=None):
+    """Varlen (packed/unpadded) flash attention — parity with
+    python/paddle/nn/functional/flash_attention.py::flash_attn_unpadded
+    (SURVEY.md §2.2). q/k/v: [total_tokens, num_heads, head_dim] with
+    sequences contiguous; cu_seqlens_*: [batch+1] cumulative lengths.
+    Runs the segment-masked Pallas kernel on TPU (ops/flash_attention.py);
+    dropout inside the varlen kernel is not supported.
+    """
+    if dropout > 0.0 and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not supported in the "
+            "varlen kernel; use dropout=0.0 or the padded flash_attention")
+    from ..core.dispatch import apply as _apply
+    from ..ops import flash_attention as fa
+
+    def fn(q, k, v, cq, ck):
+        return fa.flash_attention_varlen(q, k, v, cq, ck, scale=scale,
+                                         causal=causal)
+
+    out = _apply(fn, _t(query), _t(key), _t(value), _t(cu_seqlens_q),
+                 _t(cu_seqlens_k), op_name="flash_attn_unpadded")
+    return (out, None) if return_softmax else (out, None)
+
+
 # ---------------------------------------------------------------------------
 # misc
 # ---------------------------------------------------------------------------

@@ -37,8 +37,12 @@ def _mult(a: int, b: int) -> bool:
 # ===========================================================================
 # Forward kernel
 # ===========================================================================
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, bq, bk, nkv):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nkv,
+                has_seg=False, kv_valid=None, causal_offset=0):
+    if has_seg:
+        segq_ref, segk_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -48,7 +52,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    run = (j * bk < (i + 1) * bq) if causal else True
+    run = (j * bk < (i + 1) * bq + causal_offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -58,10 +62,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
-        if causal:
+        if causal or kv_valid is not None or has_seg:
             row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            keep = jnp.ones((bq, bk), dtype=bool)
+            if causal:
+                keep &= row + causal_offset >= col
+            if kv_valid is not None:
+                # static bound: keys beyond the unpadded length are masked
+                keep &= col < kv_valid
+            if has_seg:
+                keep &= (segq_ref[0, 0][:, None] == segk_ref[0, 0][None, :])
+            s = jnp.where(keep, s, _NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -87,20 +99,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0] = (m + jnp.log(safe_l))[:, 0]
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk):
+def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk, seg_q=None, seg_k=None,
+                      kv_valid=None, causal_offset=0):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nkv = sq // bq, sk // bk
     grid = (bh, nq, nkv)
+    has_seg = seg_q is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        # segment ids travel lane-major as (1, 1, S); shared by every bh
+        in_specs += [pl.BlockSpec((1, 1, bq), lambda b, i, j: (0, 0, i)),
+                     pl.BlockSpec((1, 1, bk), lambda b, i, j: (0, 0, j))]
+        args += [seg_q[None, None, :], seg_k[None, None, :]]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nkv=nkv),
+                          bq=bq, bk=bk, nkv=nkv, has_seg=has_seg,
+                          kv_valid=kv_valid, causal_offset=causal_offset),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
@@ -114,15 +136,20 @@ def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-    )(q, k, v)
+    )(*args)
     return out, lse[:, 0]
 
 
 # ===========================================================================
 # Backward kernels
 # ===========================================================================
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, bq, bk, nkv):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, bq, bk, nkv, has_seg=False, kv_valid=None,
+                   causal_offset=0):
+    if has_seg:
+        segq_ref, segk_ref, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -130,7 +157,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    run = (j * bk < (i + 1) * bq) if causal else True
+    run = (j * bk < (i + 1) * bq + causal_offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -144,10 +171,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         p = jnp.exp(s - lse)
-        if causal:
+        if causal or kv_valid is not None or has_seg:
             row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(row >= col, p, 0.0)
+            keep = jnp.ones((bq, bk), dtype=bool)
+            if causal:
+                keep &= row + causal_offset >= col
+            if kv_valid is not None:
+                keep &= col < kv_valid
+            if has_seg:
+                keep &= (segq_ref[0, 0][:, None] == segk_ref[0, 0][None, :])
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(
             do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -161,8 +195,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, bq, bk, nq, has_seg=False, kv_valid=None,
+                    causal_offset=0):
+    if has_seg:
+        segq_ref, segk_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -171,7 +210,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    run = ((i + 1) * bq > j * bk) if causal else True
+    run = ((i + 1) * bq + causal_offset > j * bk) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -185,10 +224,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         p = jnp.exp(s - lse)
-        if causal:
+        if causal or kv_valid is not None or has_seg:
             row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(row >= col, p, 0.0)
+            keep = jnp.ones((bq, bk), dtype=bool)
+            if causal:
+                keep &= row + causal_offset >= col
+            if kv_valid is not None:
+                keep &= col < kv_valid
+            if has_seg:
+                keep &= (segq_ref[0, 0][:, None] == segk_ref[0, 0][None, :])
+            p = jnp.where(keep, p, 0.0)
         pt = p.astype(do.dtype)
         dv_acc[...] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -205,44 +251,62 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk):
+def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
+                      seg_q=None, seg_k=None, kv_valid=None, causal_offset=0):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nkv = sq // bq, sk // bk
+    has_seg = seg_q is not None
     # lse/delta travel as (BH, 1, S) — see _fwd_kernel note on Mosaic tiling.
     lse3 = lse[:, None, :]
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
 
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_args = [q, k, v, g, lse3, delta]
+    if has_seg:
+        sq3 = seg_q[None, None, :]
+        sk3 = seg_k[None, None, :]
+        dq_in_specs += [pl.BlockSpec((1, 1, bq), lambda b, i, j: (0, 0, i)),
+                        pl.BlockSpec((1, 1, bk), lambda b, i, j: (0, 0, j))]
+        dq_args += [sq3, sk3]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nkv=nkv),
+                          bq=bq, bk=bk, nkv=nkv, has_seg=has_seg,
+                          kv_valid=kv_valid, causal_offset=causal_offset),
         grid=(bh, nq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-    )(q, k, v, g, lse3, delta)
+    )(*dq_args)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+    ]
+    dkv_args = [q, k, v, g, lse3, delta]
+    if has_seg:
+        dkv_in_specs += [pl.BlockSpec((1, 1, bq), lambda b, j, i: (0, 0, i)),
+                         pl.BlockSpec((1, 1, bk), lambda b, j, i: (0, 0, j))]
+        dkv_args += [sq3, sk3]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, has_seg=has_seg,
+                          kv_valid=kv_valid, causal_offset=causal_offset),
         grid=(bh, nkv, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -255,7 +319,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-    )(q, k, v, g, lse3, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -284,10 +348,13 @@ def _pick_blocks(sq, sk):
     return pick(sq), pick(sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_bhsd(q, k, v, scale, causal):
-    """(BH, S, D) flash attention; differentiable; pallas on TPU."""
-    out, _ = _fa_fwd(q, k, v, scale, causal)
+def _pad_to(s: int, mult: int = 128) -> int:
+    return -(-s // mult) * mult
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd_inner(q, k, v, scale, causal, kv_valid, causal_offset):
+    out, _ = _fa_fwd(q, k, v, scale, causal, kv_valid, causal_offset)
     return out
 
 
@@ -296,25 +363,183 @@ def _pallas_ok(q, k):
     return use_pallas() and bq is not None and bk is not None and _mult(q.shape[2], 128)
 
 
-def _fa_fwd(q, k, v, scale, causal):
+def _fa_fwd(q, k, v, scale, causal, kv_valid, causal_offset):
     if _pallas_ok(q, k):
         bq, bk = _pick_blocks(q.shape[1], k.shape[1])
-        out, lse = _flash_fwd_pallas(q, k, v, scale, causal, bq, bk)
+        out, lse = _flash_fwd_pallas(q, k, v, scale, causal, bq, bk,
+                                     kv_valid=kv_valid,
+                                     causal_offset=causal_offset)
         return out, (q, k, v, out, lse)
-    out = _attn_ref(q, k, v, scale, causal)
+    out = _attn_ref_kv(q, k, v, scale, causal, kv_valid, causal_offset)
     return out, (q, k, v, out, None)
 
 
-def _fa_bwd(scale, causal, res, g):
+def _fa_bwd(scale, causal, kv_valid, causal_offset, res, g):
     q, k, v, out, lse = res
     if lse is not None and _pallas_ok(q, k):
         bq, bk = _pick_blocks(q.shape[1], k.shape[1])
-        return _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk)
-    _, vjp = jax.vjp(lambda a, b, c: _attn_ref(a, b, c, scale, causal), q, k, v)
+        return _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
+                                 kv_valid=kv_valid,
+                                 causal_offset=causal_offset)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _attn_ref_kv(a, b, c, scale, causal, kv_valid,
+                                     causal_offset),
+        q, k, v)
     return vjp(g)
 
 
-flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+_flash_bhsd_inner.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _attn_ref_kv(q, k, v, scale, causal, kv_valid, causal_offset=0):
+    """Reference path with the kernel's mask semantics: causal keeps
+    row + causal_offset >= col (causal_offset = sk - sq of the ORIGINAL
+    shapes — the end-aligned decode convention, 0 for self-attention) and
+    cols >= kv_valid are masked. Slicing k instead would shift _attn_ref's
+    end-aligned convention under padding."""
+    if kv_valid is None and causal_offset == 0:
+        return _attn_ref(q, k, v, scale, causal)
+    sq, sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    keep = (jnp.arange(sk) < (kv_valid if kv_valid is not None else sk)
+            )[None, :]
+    if causal:
+        keep = keep & (jnp.arange(sq)[:, None] + causal_offset
+                       >= jnp.arange(sk)[None, :])
+    s = jnp.where(keep[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention_bhsd(q, k, v, scale, causal):
+    """(BH, S, D) flash attention; differentiable; pallas on TPU.
+
+    Ragged lengths (S % 128 != 0) no longer silently fall back to XLA:
+    q/k/v are zero-padded to the next 128 multiple, padded KEYS are masked
+    in-kernel via the static ``kv_valid`` bound, and the output is sliced
+    back (padded-query rows carry zero cotangents, so gradients are exact).
+    """
+    sq, sk = q.shape[1], k.shape[1]
+    # end-aligned causal for sq != sk (decode over a KV prefix): real row i
+    # attends cols <= i + (sk - sq), matching _attn_ref / flash-attn
+    offset = (sk - sq) if causal and sq != sk else 0
+    psq, psk = _pad_to(sq), _pad_to(sk)
+    if psq == sq and psk == sk:
+        return _flash_bhsd_inner(q, k, v, scale, causal, None, offset)
+    qp = jnp.pad(q, ((0, 0), (0, psq - sq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, psk - sk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, psk - sk), (0, 0)))
+    out = _flash_bhsd_inner(qp, kp, vp, scale, causal,
+                            sk if psk != sk else None, offset)
+    return out[:, :sq]
+
+
+# ===========================================================================
+# Varlen (unpadded / packed) attention
+# ===========================================================================
+def _segments_from_cu(cu, total):
+    """cu_seqlens (B+1,) -> per-token segment ids (total,), int32."""
+    cu = jnp.asarray(cu, jnp.int32)
+    return jnp.searchsorted(cu[1:], jnp.arange(total, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
+
+
+def _varlen_ref(q, k, v, seg_q, seg_k, scale, causal):
+    """(H, T, D) packed reference path with segment + causal mask."""
+    s = jnp.einsum("htd,hsd->hts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    keep = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        keep &= (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+    s = jnp.where(keep[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no visible key (padding segments) are fully masked; their
+    # softmax is a uniform garbage row — zero it
+    any_keep = jnp.any(keep, axis=-1)
+    p = jnp.where(any_keep[None, :, None], p, 0.0)
+    return jnp.einsum("hts,hsd->htd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                           scale: Optional[float] = None,
+                           causal: bool = True):
+    """Unpadded (packed) flash attention — the reference's
+    ``flash_attn_unpadded`` (python/paddle/nn/functional/flash_attention.py
+    :§0, SURVEY.md §2.2).
+
+    q/k/v: (total_tokens, H, D) with every sequence's tokens CONTIGUOUS;
+    cu_seqlens_*: (B+1,) int cumulative lengths. TPU-native formulation: the
+    packed stream runs as ONE dense kernel invocation with per-token
+    segment ids masked in-kernel (cross-sequence attention blocked; causal
+    within each sequence falls out of global positions because packing is
+    order-preserving) — no per-sequence padding, no wasted MXU tiles
+    beyond the final 128-alignment pad.
+    """
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    if causal:
+        # causal in packed coordinates is only defined when both sides
+        # share the packing (self-attention); a drifting q/k offset would
+        # silently zero-mask real rows
+        if tq != tk or jnp.shape(cu_seqlens_q) != jnp.shape(cu_seqlens_k):
+            raise ValueError(
+                "flash_attention_varlen: causal=True requires "
+                "cu_seqlens_q == cu_seqlens_k (self-attention packing)")
+        try:
+            same = bool(jnp.all(jnp.asarray(cu_seqlens_q)
+                                == jnp.asarray(cu_seqlens_k)))
+            if not same:
+                raise ValueError(
+                    "flash_attention_varlen: causal=True requires "
+                    "cu_seqlens_q == cu_seqlens_k (self-attention packing)")
+        except jax.errors.TracerBoolConversionError:
+            pass  # traced lengths: requirement is documented
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    seg_q = _segments_from_cu(cu_seqlens_q, tq)
+    seg_k = _segments_from_cu(cu_seqlens_k, tk)
+    ptq, ptk = _pad_to(tq), _pad_to(tk)
+    qp = jnp.pad(q, ((0, ptq - tq), (0, 0), (0, 0)))
+    kp = jnp.pad(k, ((0, ptk - tk), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, ptk - tk), (0, 0), (0, 0)))
+    # distinct pad ids per side so padded q never matches padded k
+    seg_qp = jnp.pad(seg_q, (0, ptq - tq), constant_values=-1)
+    seg_kp = jnp.pad(seg_k, (0, ptk - tk), constant_values=-2)
+    qt = jnp.moveaxis(qp, 1, 0)                      # (H, T, D)
+    kt = jnp.moveaxis(kp, 1, 0)
+    vt = jnp.moveaxis(vp, 1, 0)
+
+    use_kernel = _pallas_ok(qt, kt)
+
+    @jax.custom_vjp
+    def run(qq, kk, vv):
+        out, _ = run_fwd(qq, kk, vv)
+        return out
+
+    def run_fwd(qq, kk, vv):
+        if use_kernel:
+            bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
+            out, lse = _flash_fwd_pallas(qq, kk, vv, sc, causal, bq, bk,
+                                         seg_q=seg_qp, seg_k=seg_kp)
+            return out, (qq, kk, vv, out, lse)
+        return _varlen_ref(qq, kk, vv, seg_qp, seg_kp, sc, causal), \
+            (qq, kk, vv, None, None)
+
+    def run_bwd(res, g):
+        qq, kk, vv, out, lse = res
+        if lse is not None:
+            bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
+            return _flash_bwd_pallas(qq, kk, vv, out, lse, g, sc, causal,
+                                     bq, bk, seg_q=seg_qp, seg_k=seg_kp)
+        _, vjp = jax.vjp(
+            lambda a, b, c: _varlen_ref(a, b, c, seg_qp, seg_kp, sc, causal),
+            qq, kk, vv)
+        return vjp(g)
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(qt, kt, vt)                             # (H, Tq_pad, D)
+    return jnp.moveaxis(out, 0, 1)[:tq]
 
 
 # ===========================================================================

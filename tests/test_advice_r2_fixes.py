@@ -46,6 +46,7 @@ def test_fused_allreduce_default_scale_is_dp_average():
 
 
 class TestRNNDropoutAndSeqLen:
+    @pytest.mark.slow
     def test_interlayer_dropout_active_in_train(self):
         paddle.seed(7)
         net = nn.LSTM(4, 6, num_layers=2, dropout=0.5)
@@ -62,6 +63,7 @@ class TestRNNDropoutAndSeqLen:
         np.testing.assert_allclose(np.asarray(c._value),
                                    np.asarray(d._value))
 
+    @pytest.mark.slow
     def test_dropout_zero_unchanged_by_mode(self):
         paddle.seed(7)
         net = nn.GRU(4, 6, num_layers=2, dropout=0.0)
@@ -74,6 +76,7 @@ class TestRNNDropoutAndSeqLen:
         np.testing.assert_allclose(np.asarray(a._value),
                                    np.asarray(b._value), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_sequence_length_masks_outputs_and_freezes_state(self):
         paddle.seed(1)
         net = nn.LSTM(3, 5)
@@ -95,6 +98,7 @@ class TestRNNDropoutAndSeqLen:
                                    np.asarray(h_full._value)[0, 1],
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_bidirectional_sequence_length_reverses_valid_prefix(self):
         paddle.seed(2)
         net = nn.SimpleRNN(3, 4, direction="bidirect")
@@ -118,6 +122,7 @@ class TestDecoderCache:
             8, 2, 16, dropout=0.0, normalize_before=normalize_before)
         return nn.TransformerDecoder(layer, 2)
 
+    @pytest.mark.slow
     def test_gen_cache_types(self):
         dec = self._decoder()
         memory = paddle.to_tensor(np.random.RandomState(0)
@@ -149,6 +154,7 @@ class TestDecoderCache:
         np.testing.assert_allclose(np.asarray(cache.k._value), 0.0)
         np.testing.assert_allclose(np.asarray(cache.v._value), 1.0)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("normalize_before", [False, True])
     def test_incremental_decode_matches_full_forward(self, normalize_before):
         dec = self._decoder(normalize_before)

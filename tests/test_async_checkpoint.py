@@ -33,6 +33,7 @@ def test_async_save_then_load(tmp_path):
         np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value))
 
 
+@pytest.mark.slow
 def test_async_save_snapshot_isolated_from_mutation(tmp_path):
     """Mutating params after async_save must not corrupt the checkpoint
     (the snapshot is taken synchronously)."""

@@ -88,6 +88,7 @@ def test_missing_key_and_shape_mismatch(tmp_path):
                         str(tmp_path / "ck"))
 
 
+@pytest.mark.slow
 def test_model_and_optimizer_state(tmp_path):
     import paddle_tpu.nn as nn
     mesh = pmesh.build_mesh({"sharding": 8})

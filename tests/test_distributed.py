@@ -106,6 +106,7 @@ def _run_training(step_builder, n=6):
     return losses, net
 
 
+@pytest.mark.slow
 def test_dp_loss_parity_with_single_device():
     def loss_fn(model, x, y):
         return F.cross_entropy(model(x), y)
@@ -127,6 +128,7 @@ def test_dp_loss_parity_with_single_device():
     np.testing.assert_allclose(losses_single, losses_dp, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_tp_gspmd_loss_parity():
     from paddle_tpu.distributed.meta_parallel import (
         ColumnParallelLinear, RowParallelLinear)

@@ -2,6 +2,7 @@
 unique_consecutive, shard_index, poisson (round-2 API-audit batch)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -66,6 +67,7 @@ def test_shard_index():
     np.testing.assert_allclose(np.asarray(out1._value), [-1, -1, -1, 2, 9])
 
 
+@pytest.mark.slow
 def test_inverse_and_poisson():
     a = np.asarray([[2.0, 0.0], [1.0, 3.0]], np.float32)
     inv = np.asarray(paddle.inverse(paddle.to_tensor(a))._value)

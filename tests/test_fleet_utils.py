@@ -2,6 +2,7 @@
 hybrid grad-sync helpers (SURVEY.md §2.4/§2.5)."""
 
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -108,6 +109,7 @@ def test_expert_params_excluded():
     np.testing.assert_allclose(np.asarray(net[0].weight.grad._value), marker)
 
 
+@pytest.mark.slow
 def test_fused_buffer_multirank_replicated_semantics():
     """ADVICE round-1: the flat buffer must NOT be slab-sharded by the
     collective (that summed different params together). Replicated psum over

@@ -107,6 +107,7 @@ class TestFusedMultiTransformer:
                                    np.asarray(out_full[:, 5]),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_grad_flows(self):
         def loss(params):
             out, _ = ftb.fused_multi_transformer_array(
@@ -117,6 +118,7 @@ class TestFusedMultiTransformer:
 
 
 class TestIncubateLayers:
+    @pytest.mark.slow
     def test_fused_multi_transformer_layer(self):
         from paddle_tpu.incubate.nn import FusedMultiTransformer
         layer = FusedMultiTransformer(32, 4, 64, num_layers=2)
@@ -130,6 +132,7 @@ class TestIncubateLayers:
         assert layer.qkv_weights[0].grad is not None
         assert float(np.abs(layer.qkv_weights[1].grad.numpy()).sum()) > 0
 
+    @pytest.mark.slow
     def test_fused_mha_and_ffn(self):
         from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
                                             FusedFeedForward)

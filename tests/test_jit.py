@@ -33,6 +33,7 @@ def test_to_static_matches_eager():
     np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_train_step_matches_eager_training():
     def make():
         paddle.seed(7)

@@ -164,6 +164,7 @@ def test_sdpa_matches_reference():
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sdpa_grads_flow():
     q = paddle.randn([1, 4, 1, 8])
     q.stop_gradient = False
@@ -239,6 +240,7 @@ class TestFoldGridSample:
         outb = F.grid_sample(x, grid, mode="nearest", padding_mode="border")
         np.testing.assert_allclose(np.asarray(outb._value), 15.0)
 
+    @pytest.mark.slow
     def test_grid_sample_grad_flows(self):
         rng = np.random.RandomState(2)
         x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))

@@ -4,6 +4,7 @@ activations, loss zoo, CTC (vs brute-force path enumeration)."""
 import itertools
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -80,6 +81,7 @@ def test_lrn_and_dropout3d():
                for i in range(2) for j in range(8))
 
 
+@pytest.mark.slow
 def test_simple_losses():
     p = _t(np.asarray([0.9, 0.2], np.float32))
     y = _t(np.asarray([1.0, 0.0], np.float32))
@@ -172,6 +174,7 @@ def test_ctc_loss_matches_bruteforce():
     np.testing.assert_allclose(got, [ref0, ref1], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ctc_loss_grad_flows():
     logp = _t(np.asarray(jax.nn.log_softmax(
         R.randn(5, 1, 4).astype(np.float32), axis=-1)))

@@ -38,6 +38,7 @@ class TestRNN:
                                    rtol=1e-5, atol=1e-6)
 
     @pytest.mark.parametrize("klass", [nn.SimpleRNN, nn.GRU])
+    @pytest.mark.slow
     def test_rnn_variants_forward(self, klass):
         paddle.seed(1)
         rnn = klass(input_size=3, hidden_size=5, num_layers=2,
@@ -48,6 +49,7 @@ class TestRNN:
         assert tuple(h.shape) == (4, 2, 5)         # layers*dirs
         assert np.isfinite(np.asarray(out._value)).all()
 
+    @pytest.mark.slow  # convergence-style: full-suite tier
     def test_rnn_trains(self):
         paddle.seed(2)
         rnn = nn.GRU(input_size=3, hidden_size=4)
@@ -89,6 +91,7 @@ class TestTransformer:
         assert tuple(out.shape) == (2, 5, 8)
         assert np.isfinite(np.asarray(out._value)).all()
 
+    @pytest.mark.slow
     def test_encoder_decoder_pipeline(self):
         paddle.seed(1)
         model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,

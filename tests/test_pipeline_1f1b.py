@@ -60,6 +60,7 @@ def _build_1f1b(mesh, M):
         check_vma=False))
 
 
+@pytest.mark.slow
 def test_1f1b_matches_serial_oracle():
     M = 8
     params, x, lab = _setup(M)
@@ -96,6 +97,7 @@ def _fill_drain_step(mesh):
         check_vma=False))
 
 
+@pytest.mark.slow
 def test_1f1b_activation_memory_flat_in_microbatches():
     """Peak temp memory of the 1F1B program must NOT scale with M (buffers
     are depth 2S); the fill-drain+AD program's does. Compiled memory

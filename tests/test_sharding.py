@@ -67,7 +67,10 @@ def test_shard_spec_for():
     assert shard_spec_for((4,), "sharding", 4) == P("sharding")
 
 
-@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+@pytest.mark.parametrize("level", [
+    pytest.param("os", marks=pytest.mark.slow),
+    pytest.param("os_g", marks=pytest.mark.slow),
+    pytest.param("p_g_os", marks=pytest.mark.slow)])
 def test_group_sharded_parity(level):
     batches = _data()
     ref_losses, ref_model = _baseline(batches)
