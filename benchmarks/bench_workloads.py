@@ -210,9 +210,57 @@ def bench_decode():
             "batch": B, "prompt": T, "new_tokens": new}
 
 
+def bench_encoder_int8():
+    """A8W8 fused encoder inference vs the bf16 float stack (reference
+    fused_multi_transformer_int8 path) at BERT-large geometry."""
+    jax, smoke = _setup()
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+
+    if smoke:
+        L, H, F, heads, B, S, iters = 2, 64, 128, 4, 2, 16, 2
+    else:
+        L, H, F, heads, B, S, iters = 12, 1024, 4096, 16, 8, 512, 20
+
+    paddle.seed(0)
+    m = FusedMultiTransformer(H, heads, F, num_layers=L)
+    if not smoke:
+        for _, p in m.named_parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+    q = FusedMultiTransformerInt8.from_float(m)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(B, S, H).astype(np.float32))
+    if not smoke:
+        x = x.astype("bfloat16")
+
+    def timed(net):
+        sf = paddle.jit.to_static(net.forward)     # one compiled program
+        out = sf(x)
+        float(out.astype("float32").sum())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = sf(x)
+        float(out.astype("float32").sum())
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    t_float = timed(m)
+    t_int8 = timed(q)
+    ref = np.asarray(m(x).astype("float32")._value)
+    got = np.asarray(q(x).astype("float32")._value)
+    err = float(np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9))
+    return {"metric": "fused_encoder_int8_vs_bf16",
+            "bf16_ms": round(t_float, 2), "int8_ms": round(t_int8, 2),
+            "speedup": round(t_float / t_int8, 2),
+            "rel_err": round(err, 4),
+            "geometry": f"L{L} h{H} ff{F} B{B} S{S}"}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    benches = {"bert": bench_bert, "moe": bench_moe, "decode": bench_decode}
+    benches = {"bert": bench_bert, "moe": bench_moe, "decode": bench_decode,
+               "encoder_int8": bench_encoder_int8}
     if which != "all" and which not in benches:
         sys.exit(f"unknown bench {which!r}; pick from "
                  f"{['all'] + sorted(benches)}")

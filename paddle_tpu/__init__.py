@@ -58,7 +58,7 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "static", "quantization",
-                "linalg", "fft", "sparse", "distribution"):
+                "linalg", "fft", "sparse", "distribution", "signal"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

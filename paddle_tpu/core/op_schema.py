@@ -1638,3 +1638,60 @@ def _np_shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     size = (index_num + nshards - 1) // nshards
     inp = np.asarray(input)
     return np.where(inp // size == shard_id, inp % size, ignore_value)
+
+
+# --- integration family (round-3 long tail) ---------------------------------
+def _jax_trapezoid(y, x=None, dx=1.0, axis=-1):
+    if x is not None:
+        d = jnp.diff(x, axis=axis if x.ndim > 1 else -1)
+        if x.ndim == 1 and y.ndim > 1:
+            shape = [1] * y.ndim
+            shape[axis % y.ndim] = d.shape[0]
+            d = d.reshape(shape)
+    else:
+        d = dx
+    n = y.shape[axis % y.ndim]
+    lo = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis % y.ndim)
+    hi = jax.lax.slice_in_dim(y, 1, n, axis=axis % y.ndim)
+    return jnp.sum((lo + hi) * 0.5 * d, axis=axis % y.ndim)
+
+
+def _np_trapezoid(y, x=None, dx=1.0, axis=-1):
+    return np.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+register(OpSpec(
+    name="trapezoid",
+    fn=_jax_trapezoid,
+    oracle=_np_trapezoid,
+    sample=lambda rng: ((rng.randn(4, 9).astype(np.float32),),
+                        {"dx": 0.5, "axis": 1}),
+))
+
+
+def _cumtrap(y, x=None, dx=1.0, axis=-1, mod=None):
+    m = mod
+    ax = axis % y.ndim
+    n = y.shape[ax]
+    lo = y.take(indices=range(0, n - 1), axis=ax) if m is np else \
+        jax.lax.slice_in_dim(y, 0, n - 1, axis=ax)
+    hi = y.take(indices=range(1, n), axis=ax) if m is np else \
+        jax.lax.slice_in_dim(y, 1, n, axis=ax)
+    if x is not None:
+        d = m.diff(x, axis=ax if getattr(x, "ndim", 1) > 1 else -1)
+        if getattr(x, "ndim", 1) == 1 and y.ndim > 1:
+            shape = [1] * y.ndim
+            shape[ax] = d.shape[0]
+            d = d.reshape(shape)
+    else:
+        d = dx
+    return m.cumsum((lo + hi) * 0.5 * d, axis=ax)
+
+
+register(OpSpec(
+    name="cumulative_trapezoid",
+    fn=lambda y, x=None, dx=1.0, axis=-1: _cumtrap(y, x, dx, axis, jnp),
+    oracle=lambda y, x=None, dx=1.0, axis=-1: _cumtrap(y, x, dx, axis, np),
+    sample=lambda rng: ((rng.randn(3, 8).astype(np.float32),),
+                        {"dx": 0.25, "axis": 1}),
+))

@@ -95,14 +95,13 @@ class GShardGate(BaseGate):
         if isinstance(factor, (tuple, list)):
             factor = factor[0] if self.training else factor[1]
         cap = self.capacity(n, factor)
-        # joint capacity pruning, choice order = GShard order (index-only)
-        masks = moe_ops.dispatch_masks_topk(raw_idx, self.tot_expert, cap)
-        kept = [m.sum(axis=(1, 2)) > 0 for m in masks]
+        # joint capacity pruning, choice order = GShard order (index-only;
+        # round 3: the index routes replace the dense (N,E,C) masks — same
+        # admission set, O(N·E) instead of O(N·E·C))
+        routes = moe_ops.dispatch_indices_topk(raw_idx, self.tot_expert, cap)
         raw_idx = jnp.stack(
-            [jnp.where(kept[k], raw_idx[:, k], -1) for k in range(2)], axis=1)
-        # pruning zeroed the dropped tokens' rows, so these masks are exactly
-        # the dispatch masks for the pruned indices — let MoELayer reuse them
-        self._dispatch_cache = (raw_idx, cap, masks)
+            [jnp.where(routes[k][1], raw_idx[:, k], -1) for k in range(2)],
+            axis=1)
         return Tensor(raw_idx), topv
 
 

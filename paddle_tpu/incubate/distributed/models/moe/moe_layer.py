@@ -183,29 +183,39 @@ class MoELayer(Layer):
             out = self._forward_expert_parallel(xf, idx, probs, capacity)
             return pm.reshape(out, orig_shape)
 
-        # reuse the gate's dispatch masks when it already built them for
-        # pruning (GShard); identity check guards against stale caches
-        cached = getattr(self.gate, "_dispatch_cache", None)
-        if cached is not None and cached[0] is idx and cached[1] == capacity:
-            masks = cached[2]
-        else:
-            masks = moe_ops.dispatch_masks_topk(idx, self.num_expert, capacity)
-        dtype = str(xf.dtype).split(".")[-1]
-        disp_sum = Tensor(sum(masks))  # (N,E,C) constant
-        expert_in = pm.einsum("nec,nd->ecd", pm.cast(disp_sum, dtype), xf)
+        # index-based dispatch (round 3): the dense (N,E,C) one-hot einsums
+        # cost O(N·E·C·d) — at training scale far more FLOPs than the
+        # experts themselves. Scatter tokens into their (expert, slot)
+        # positions and gather back instead; routing stays identical
+        # (dispatch_indices_topk shares dispatch_masks_topk's joint
+        # capacity ordering — parity-tested in test_moe).
+        from .....core.dispatch import apply as _apply
+
+        routes = moe_ops.dispatch_indices_topk(idx, self.num_expert,
+                                               capacity)
+        route_args = []
+        for flat, ok in routes:
+            route_args += [Tensor(flat), Tensor(ok)]
+        E, C = self.num_expert, capacity
+
+        def fn_dispatch(xv, *rs):
+            rts = [(rs[i], rs[i + 1]) for i in range(0, len(rs), 2)]
+            return moe_ops.moe_dispatch_indices(xv, rts, E, C)
+
+        expert_in = _apply(fn_dispatch, xf, *route_args,
+                           op_name="moe_dispatch")
 
         # run experts on their capacity slots (static python loop: E is small
         # and each expert owns distinct parameters)
         outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
         expert_out = pm.stack(outs, axis=0)  # (E, C, d)
 
-        # combine: sum_k mask_k * prob_k — probs differentiable
-        comb = None
-        for k in range(K):
-            pk = pm.unsqueeze(pm.unsqueeze(probs[:, k], -1), -1)  # (N,1,1)
-            term = pm.cast(Tensor(masks[k]), "float32") * pk
-            comb = term if comb is None else comb + term
-        out = pm.einsum("nec,ecd->nd", pm.cast(comb, dtype), expert_out)
+        def fn_combine(eo, pv, *rs):
+            rts = [(rs[i], rs[i + 1]) for i in range(0, len(rs), 2)]
+            return moe_ops.moe_combine_indices(eo, rts, pv)
+
+        out = _apply(fn_combine, expert_out, probs, *route_args,
+                     op_name="moe_combine")
         return pm.reshape(out, orig_shape)
 
     def _forward_expert_parallel(self, xf, idx, probs, capacity):

@@ -3,6 +3,7 @@ python/paddle/incubate/nn/layer/fused_transformer.py:§0, SURVEY.md §2.5
 "incubate fused layers")."""
 
 from .layer.fused_transformer import (  # noqa: F401
-    FusedMultiTransformer, FusedMultiHeadAttention, FusedFeedForward,
+    FusedMultiTransformer, FusedMultiTransformerInt8,
+    FusedMultiHeadAttention, FusedFeedForward,
 )
 from . import functional  # noqa: F401

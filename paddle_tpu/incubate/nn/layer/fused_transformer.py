@@ -21,6 +21,40 @@ from ....core.dispatch import apply
 from ....ops import fused_transformer_block as ftb
 
 
+def _run_stacked_block(layer, order, src, attn_mask, caches, time_step,
+                       gen_cache_len, seq_lens, extra_consts=None,
+                       int8=False, op_name="fused_multi_transformer"):
+    """Shared forward plumbing for the float and int8 stacks: flatten the
+    per-layer Parameter lists through `apply` (tape records each), stack
+    per key inside the traced fn, run the scanned block op."""
+    import jax.numpy as jnp
+
+    L = layer.num_layers
+    flat = [src]
+    for _, plist in order:
+        flat.extend(plist)
+    mask = attn_mask._value if hasattr(attn_mask, "_value") else attn_mask
+    cache = caches._value if hasattr(caches, "_value") else caches
+    lens = seq_lens._value if hasattr(seq_lens, "_value") else seq_lens
+
+    def fn(xv, *pv):
+        d = {}
+        for idx, (key, _) in enumerate(order):
+            d[key] = jnp.stack(pv[idx * L:(idx + 1) * L])
+        if extra_consts:
+            d.update(extra_consts)
+        out, kv = ftb.fused_multi_transformer_array(
+            xv, d, num_heads=layer.num_heads, act=layer.activation,
+            epsilon=layer.epsilon, attn_mask=mask, cache_kv=cache,
+            time_step=time_step, max_cache_len=gen_cache_len,
+            seq_lens=lens, int8=int8)
+        if kv is None:
+            return out
+        return out, kv
+
+    return apply(fn, *flat, op_name=op_name)
+
+
 class FusedMultiTransformer(Layer):
     """Stack of ``num_layers`` pre-LN decoder layers, fused end-to-end.
 
@@ -84,32 +118,9 @@ class FusedMultiTransformer(Layer):
 
     def forward(self, src, attn_mask=None, caches=None, time_step=None,
                 gen_cache_len=None, seq_lens=None):
-        # Per-layer Parameters go through `apply` individually (tape records
-        # each), then stack inside the traced fn — one jnp.stack per key,
-        # free under jit.
-        L = self.num_layers
-        flat = [src]
-        for _, attr in self._STACK_KEYS:
-            flat.extend(getattr(self, attr))
-        mask = attn_mask._value if hasattr(attn_mask, "_value") else attn_mask
-        cache = caches._value if hasattr(caches, "_value") else caches
-        lens = seq_lens._value if hasattr(seq_lens, "_value") else seq_lens
-
-        def fn(xv, *pv):
-            import jax.numpy as jnp
-            d = {}
-            for idx, (key, _) in enumerate(self._STACK_KEYS):
-                d[key] = jnp.stack(pv[idx * L:(idx + 1) * L])
-            out, kv = ftb.fused_multi_transformer_array(
-                xv, d, num_heads=self.num_heads, act=self.activation,
-                epsilon=self.epsilon, attn_mask=mask, cache_kv=cache,
-                time_step=time_step, max_cache_len=gen_cache_len,
-                seq_lens=lens)
-            if kv is None:
-                return out
-            return out, kv
-
-        return apply(fn, *flat, op_name="fused_multi_transformer")
+        order = [(key, getattr(self, attr)) for key, attr in self._STACK_KEYS]
+        return _run_stacked_block(self, order, src, attn_mask, caches,
+                                  time_step, gen_cache_len, seq_lens)
 
 
 class FusedMultiHeadAttention(Layer):
@@ -199,3 +210,137 @@ class FusedFeedForward(Layer):
 
         return apply(fn, x, self.ln_scale, self.ln_bias, self.w1, self.b1,
                      self.w2, self.b2, op_name="fused_feedforward")
+
+
+class FusedMultiTransformerInt8(Layer):
+    """A8W8 fused decoder stack — the reference's int8 fused encoder
+    (paddle/fluid/operators/fused/fused_multi_transformer_int8_op.cu:§0,
+    paddle.incubate.nn.FusedMultiTransformerInt8).
+
+    Weights are stored int8 with per-output-channel scales; the four
+    projection matmuls quantize their activations (per-token dynamic amax,
+    or the calibrated ``*_in_scale`` lists when provided) and run
+    int8×int8→int32 on the MXU — the TPU's int8 path doubles matmul peak
+    over bf16, which is where the reference CUDA kernel's win comes from
+    too. Build from a trained float stack with :meth:`from_float`.
+    """
+
+    _WKEYS = ("qkv", "linear", "ffn1", "ffn2")
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 epsilon=1e-5, num_layers=1, qkv_in_scale=None,
+                 linear_in_scale=None, ffn1_in_scale=None,
+                 ffn2_in_scale=None, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError("post-LN int8 stack not supported")
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dim_feedforward = dim_feedforward
+        self.activation = activation
+        self.epsilon = epsilon
+        self.num_layers = num_layers
+        self.in_scales = {"qkv": qkv_in_scale, "linear": linear_in_scale,
+                          "ffn1": ffn1_in_scale, "ffn2": ffn2_in_scale}
+        H, F = embed_dim, dim_feedforward
+        wshapes = {"qkv": (H, 3 * H), "linear": (H, H),
+                   "ffn1": (H, F), "ffn2": (F, H)}
+        bshapes = {"qkv": (3 * H,), "linear": (H,),
+                   "ffn1": (F,), "ffn2": (H,)}
+        import jax.numpy as jnp
+
+        names = ("ln_scales", "ln_biases", "ffn_ln_scales", "ffn_ln_biases")
+        for n in names:
+            object.__setattr__(self, n, [])
+        for wk in self._WKEYS:
+            object.__setattr__(self, f"{wk}_weights", [])
+            object.__setattr__(self, f"{wk}_scales", [])
+            object.__setattr__(self, f"{wk}_biases", [])
+        for i in range(num_layers):
+            for n in names:
+                init = I.Constant(1.0) if n.endswith("scales") else I.Constant(0.0)
+                p = self.create_parameter((H,), is_bias=n.endswith("biases"),
+                                          default_initializer=init)
+                self.add_parameter(f"{n}.{i}", p)
+                getattr(self, n).append(p)
+            for wk in self._WKEYS:
+                q = self.create_parameter(wshapes[wk],
+                                          default_initializer=I.Constant(0.0))
+                q._value = jnp.zeros(wshapes[wk], jnp.int8)
+                q.trainable = False
+                q.stop_gradient = True
+                s = self.create_parameter((wshapes[wk][1],),
+                                          default_initializer=I.Constant(1.0))
+                s.trainable = False
+                s.stop_gradient = True
+                b = self.create_parameter(bshapes[wk], is_bias=True,
+                                          default_initializer=I.Constant(0.0))
+                self.add_parameter(f"{wk}_w_q.{i}", q)
+                self.add_parameter(f"{wk}_w_scale.{i}", s)
+                self.add_parameter(f"{wk}_b.{i}", b)
+                getattr(self, f"{wk}_weights").append(q)
+                getattr(self, f"{wk}_scales").append(s)
+                getattr(self, f"{wk}_biases").append(b)
+
+    @classmethod
+    def from_float(cls, float_stack: "FusedMultiTransformer",
+                   qkv_in_scale=None, linear_in_scale=None,
+                   ffn1_in_scale=None, ffn2_in_scale=None):
+        """Quantize a trained FusedMultiTransformer's projection weights."""
+        from ....quantization import weight_quantize
+
+        m = cls(float_stack.embed_dim, float_stack.num_heads,
+                float_stack.dim_feedforward,
+                activation=float_stack.activation,
+                epsilon=float_stack.epsilon,
+                num_layers=float_stack.num_layers,
+                qkv_in_scale=qkv_in_scale, linear_in_scale=linear_in_scale,
+                ffn1_in_scale=ffn1_in_scale, ffn2_in_scale=ffn2_in_scale)
+        src_w = {"qkv": float_stack.qkv_weights,
+                 "linear": float_stack.linear_weights,
+                 "ffn1": float_stack.ffn1_weights,
+                 "ffn2": float_stack.ffn2_weights}
+        src_b = {"qkv": float_stack.qkv_biases,
+                 "linear": float_stack.linear_biases,
+                 "ffn1": float_stack.ffn1_biases,
+                 "ffn2": float_stack.ffn2_biases}
+        for i in range(m.num_layers):
+            m.ln_scales[i]._value = float_stack.ln_scales[i]._value
+            m.ln_biases[i]._value = float_stack.ln_biases[i]._value
+            m.ffn_ln_scales[i]._value = float_stack.ffn_ln_scales[i]._value
+            m.ffn_ln_biases[i]._value = float_stack.ffn_ln_biases[i]._value
+            for wk in cls._WKEYS:
+                q, s = weight_quantize(src_w[wk][i]._value)
+                getattr(m, f"{wk}_weights")[i]._value = q
+                getattr(m, f"{wk}_scales")[i]._value = s
+                getattr(m, f"{wk}_biases")[i]._value = src_b[wk][i]._value
+        return m
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                gen_cache_len=None, seq_lens=None):
+        import jax.numpy as jnp
+        order = [("ln_scale", self.ln_scales), ("ln_bias", self.ln_biases),
+                 ("ffn_ln_scale", self.ffn_ln_scales),
+                 ("ffn_ln_bias", self.ffn_ln_biases)]
+        # block-op key names: qkv_w/out_w/ffn1_w/ffn2_w (+_q/_scale) —
+        # 'linear' in the public attr names maps to 'out' inside the op
+        opname = {"qkv": "qkv_w", "linear": "out_w", "ffn1": "ffn1_w",
+                  "ffn2": "ffn2_w"}
+        opbias = {"qkv": "qkv_b", "linear": "out_b", "ffn1": "ffn1_b",
+                  "ffn2": "ffn2_b"}
+        for wk in self._WKEYS:
+            order.append((opname[wk] + "_q", getattr(self, f"{wk}_weights")))
+            order.append((opname[wk] + "_scale",
+                          getattr(self, f"{wk}_scales")))
+            order.append((opbias[wk], getattr(self, f"{wk}_biases")))
+        in_scales = {opname[wk] + "_in_scale":
+                     jnp.asarray(self.in_scales[wk], jnp.float32)
+                     for wk in self._WKEYS
+                     if self.in_scales[wk] is not None}
+        return _run_stacked_block(self, order, src, attn_mask, caches,
+                                  time_step, gen_cache_len, seq_lens,
+                                  extra_consts=in_scales, int8=True,
+                                  op_name="fused_multi_transformer_int8")

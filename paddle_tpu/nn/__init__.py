@@ -11,7 +11,7 @@ from .common_layers import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv2DTranspose,
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
     SyncBatchNorm, GroupNorm, InstanceNorm2D,
-    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    MaxPool2D, MaxUnPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
     ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Hardswish, Hardsigmoid,
     Hardtanh, ELU, SELU, CELU, Softplus, Softsign, Tanhshrink, Hardshrink,
     Softshrink, LogSoftmax, LeakyReLU, PReLU, Softmax,

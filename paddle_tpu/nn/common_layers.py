@@ -338,10 +338,28 @@ class MaxPool2D(Layer):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
         self.ceil_mode, self.data_format = ceil_mode, data_format
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.max_pool2d(x, self.k, self.s, self.p, self.ceil_mode,
+                            return_mask=self.return_mask,
                             data_format=self.data_format)
+
+
+class MaxUnPool2D(Layer):
+    """paddle.nn.MaxUnPool2D: scatter pooled values back via the argmax
+    mask from MaxPool2D(return_mask=True) (reference phi unpool kernel:§0)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.k, self.s, self.p,
+                              data_format=self.data_format,
+                              output_size=self.output_size)
 
 
 class AvgPool2D(Layer):

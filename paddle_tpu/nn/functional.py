@@ -1603,3 +1603,73 @@ from ..core import op_schema as _op_schema  # noqa: E402
 
 pixel_unshuffle = _op_schema.make_public(_op_schema.OPS["pixel_unshuffle"])
 channel_shuffle = _op_schema.make_public(_op_schema.OPS["channel_shuffle"])
+
+
+def _max_unpool_nd(x, indices, nd, kernel_size, stride, padding, output_size,
+                   op_name):
+    k = _pair(kernel_size, nd)
+    s = _pair(stride, nd) if stride is not None else k
+    p = _pair(padding, nd)
+
+    def out_spatial(in_sp):
+        if output_size is not None:
+            osz = tuple(output_size[-nd:])
+            return osz
+        return tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                     for i in range(nd))
+
+    def fn(v, idx):
+        n, c = v.shape[:2]
+        osp = out_spatial(v.shape[2:])
+        total = int(np.prod(osp))
+        flatv = v.reshape(n, c, -1)
+        flati = idx.reshape(n, c, -1).astype(jnp.int32)
+        # indices are flat positions in the OUTPUT spatial volume (the
+        # max_pool return_mask convention). Paddle raises on out-of-range
+        # indices; enforce eagerly when concrete, drop (never clamp-corrupt
+        # a neighbouring element) under tracing.
+        try:
+            hi = int(jnp.max(flati))
+            if hi >= total or int(jnp.min(flati)) < 0:
+                raise ValueError(
+                    f"{op_name}: index {hi} out of range for output "
+                    f"spatial size {osp} ({total} positions); pass the "
+                    "matching output_size")
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            pass
+        out = jnp.zeros((n, c, total), v.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, val: o.at[i].set(val, mode="drop")))(
+            out, flati, flatv)
+        return out.reshape((n, c) + osp)
+
+    return apply(fn, _t(x), _t(indices), op_name=op_name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True): scatter pooled values back
+    to their argmax positions (reference phi unpool kernel:§0)."""
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d: only NCL")
+    return _max_unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) — paddle.nn.functional
+    .max_unpool2d (reference phi unpool kernel:§0)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d: only NCHW")
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d: only NCDHW")
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size, "max_unpool3d")

@@ -111,13 +111,37 @@ def _decode_attention(q, k_cache, v_cache, cur_len, seq_lens=None,
     return jnp.einsum("bnqk,bnkd->bnqd", p, v_cache)
 
 
+def _int8_mm(x, wq, w_scale, in_scale=None):
+    """A8W8 matmul on the MXU's int8 path: x (..., K) float, wq (K, N)
+    int8, w_scale (N,) per-output-channel. Activations quantize per-token
+    (dynamic amax) unless a calibrated scalar ``in_scale`` is given —
+    the reference fused_multi_transformer_int8's *_in_scale attributes
+    (fused_multi_transformer_int8_op.cu:§0). int8×int8→int32 accumulate,
+    one dequant multiply on the way out."""
+    xf = x.astype(jnp.float32)
+    if in_scale is None:
+        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        xs = jnp.maximum(amax, 1e-6) / 127.0           # (..., 1)
+    else:
+        xs = jnp.asarray(in_scale, jnp.float32)        # calibrated scalar
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    y = lax.dot_general(xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * xs * w_scale
+
+
 def _one_layer(x, p, *, num_heads, act, eps, attn_mask, kv_cache, time_step,
-               seq_lens=None):
+               seq_lens=None, mm=None):
     """One fused decoder layer. Returns (y, (k, v)) where k/v are this
-    layer's new cache contents (or the per-step k/v in decode mode)."""
+    layer's new cache contents (or the per-step k/v in decode mode).
+    ``mm(xn, w_key, b_key)`` overrides the four projection matmuls (the
+    int8 path routes them through _int8_mm)."""
+    if mm is None:
+        def mm(t, wk, bk):
+            return t @ p[wk] + p[bk]
     b, s, h = x.shape
     xn = layer_norm_array(x, p["ln_scale"], p["ln_bias"], eps)
-    qkv = xn @ p["qkv_w"] + p["qkv_b"]
+    qkv = mm(xn, "qkv_w", "qkv_b")
     q, k, v = _split_heads(qkv, num_heads)
 
     if kv_cache is not None and time_step is not None:
@@ -132,18 +156,19 @@ def _one_layer(x, p, *, num_heads, act, eps, attn_mask, kv_cache, time_step,
         new_kv = (k, v)
 
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h)
-    x = x + (attn @ p["out_w"] + p["out_b"]).astype(x.dtype)
+    x = x + mm(attn, "out_w", "out_b").astype(x.dtype)
 
     xn = layer_norm_array(x, p["ffn_ln_scale"], p["ffn_ln_bias"], eps)
-    f = _ACTS[act](xn @ p["ffn1_w"] + p["ffn1_b"])
-    x = x + (f @ p["ffn2_w"] + p["ffn2_b"]).astype(x.dtype)
+    f = _ACTS[act](mm(xn, "ffn1_w", "ffn1_b"))
+    x = x + mm(f, "ffn2_w", "ffn2_b").astype(x.dtype)
     return x, new_kv
 
 
 def fused_multi_transformer_array(
         x, params, *, num_heads: int, act: str = "gelu", epsilon: float = 1e-5,
         attn_mask=None, cache_kv=None, time_step: Optional[int] = None,
-        max_cache_len: Optional[int] = None, seq_lens=None):
+        max_cache_len: Optional[int] = None, seq_lens=None,
+        int8: bool = False):
     """Run the whole decoder stack as one scanned computation.
 
     Prefill (``time_step=None``): causal flash attention; when
@@ -151,11 +176,25 @@ def fused_multi_transformer_array(
     decode. Decode (``time_step`` set, S==1): reads/updates ``cache_kv``
     in place (functionally) and attends over the valid prefix.
 
+    ``int8=True`` (reference fused_multi_transformer_int8_op.cu:§0): the
+    four projection weights arrive quantized — ``{name}_q`` int8 +
+    ``{name}_scale`` per-out-channel, with optional calibrated
+    ``{name}_in_scale`` activation scales — and the matmuls run
+    int8×int8→int32 on the MXU with a fused dequant multiply.
+
     Returns ``(out, cache_kv)`` — ``cache_kv`` is ``[L, 2, B, nh, Sc, hd]``
     or None when no cache was requested.
     """
-    L = params["ln_scale"].shape[0]
-    del L  # scan length is implied by the stacked leading dim
+
+    def make_mm(p):
+        if not int8:
+            return None
+
+        def mm(t, wk, bk):
+            return _int8_mm(t, p[wk + "_q"], p[wk + "_scale"],
+                            p.get(wk + "_in_scale")) + p[bk]
+
+        return mm
 
     if time_step is not None:
         if cache_kv is None:
@@ -166,7 +205,7 @@ def fused_multi_transformer_array(
             y, new_kv = _one_layer(
                 carry, p, num_heads=num_heads, act=act, eps=epsilon,
                 attn_mask=None, kv_cache=(kv[0], kv[1]), time_step=time_step,
-                seq_lens=seq_lens)
+                seq_lens=seq_lens, mm=make_mm(p))
             return y, jnp.stack(new_kv)
 
         out, new_cache = lax.scan(step, x, (params, cache_kv))
@@ -175,7 +214,8 @@ def fused_multi_transformer_array(
     def step(carry, p):
         y, (k, v) = _one_layer(
             carry, p, num_heads=num_heads, act=act, eps=epsilon,
-            attn_mask=attn_mask, kv_cache=None, time_step=None)
+            attn_mask=attn_mask, kv_cache=None, time_step=None,
+            mm=make_mm(p))
         return y, jnp.stack([k, v])
 
     out, kv = lax.scan(step, x, params)

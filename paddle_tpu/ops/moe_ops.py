@@ -212,3 +212,56 @@ def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
     return expert_parallel_apply(x_local, gate_idx, gate_prob, w1_local,
                                  w2_local, axis_name, num_experts, capacity,
                                  act=act)
+
+
+# ---------------------------------------------------------------------------
+# Index-based dispatch (round 3): the (N,E,C) one-hot einsum dispatch costs
+# O(N·E·C·d) FLOPs — at training scale orders of magnitude more than the
+# expert matmuls it feeds. The same routing expressed as scatter/gather by
+# slot index is O(N·d); the masks remain for the expert-parallel all_to_all
+# layout, which needs the dense (E,C) slot structure anyway.
+# ---------------------------------------------------------------------------
+def dispatch_indices_topk(gate_idx, num_experts: int, capacity: int):
+    """Index form of :func:`dispatch_masks_topk` with the SAME joint
+    capacity ordering. Returns a list of K routes
+    ``(flat_slot (N,), admitted (N,) bool)`` where flat_slot indexes the
+    flattened (E*C) expert-slot space."""
+    n, K = gate_idx.shape
+    routes = []
+    admitted = jnp.zeros((num_experts,), jnp.int32)
+    for k in range(K):
+        idx = gate_idx[:, k]
+        valid = idx >= 0
+        safe = jnp.where(valid, idx, 0)
+        oh = jax.nn.one_hot(safe, num_experts, dtype=jnp.int32) * \
+            valid[:, None].astype(jnp.int32)
+        pos = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1 + admitted[safe]
+        in_cap = valid & (pos >= 0) & (pos < capacity)
+        flat = safe * capacity + jnp.where(in_cap, pos, 0)
+        routes.append((flat.astype(jnp.int32), in_cap))
+        admitted = admitted + (oh * in_cap[:, None].astype(jnp.int32)
+                               ).sum(axis=0)
+    return routes
+
+
+def moe_dispatch_indices(x, routes, num_experts: int, capacity: int):
+    """(N,d) + routes -> (E,C,d) by scatter-add (slots are collision-free
+    by construction, so add == set with exact gradients)."""
+    out = jnp.zeros((num_experts * capacity, x.shape[-1]), x.dtype)
+    for flat, ok in routes:
+        out = out.at[jnp.where(ok, flat, 0)].add(
+            jnp.where(ok[:, None], x, jnp.zeros_like(x)))
+    return out.reshape(num_experts, capacity, x.shape[-1])
+
+
+def moe_combine_indices(expert_out, routes, gate_prob):
+    """(E,C,d) + routes + (N,K) probs -> (N,d) by gather."""
+    e, c, d = expert_out.shape
+    flat = expert_out.reshape(e * c, d)
+    out = None
+    for k, (fs, ok) in enumerate(routes):
+        vals = flat[jnp.where(ok, fs, 0)]
+        w = (gate_prob[:, k] * ok.astype(gate_prob.dtype))[:, None]
+        term = vals * w.astype(vals.dtype)
+        out = term if out is None else out + term
+    return out

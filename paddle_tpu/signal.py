@@ -1,0 +1,184 @@
+"""``paddle.signal`` parity: frame / overlap_add / stft / istft.
+
+Rebuild of python/paddle/signal.py (phi frame/overlap_add kernels +
+fft-composed stft/istft — SURVEY.md §2.1 kernel corpus long tail). The
+framing is a gather over strided window starts and overlap-add a
+scatter-add — both XLA-fusable; the transforms ride paddle_tpu.fft.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _frame_gather(v, frame_length: int, hop: int):
+    """(..., N) -> (..., num_frames, frame_length) strided window gather —
+    the single home of the window-start arithmetic."""
+    n = v.shape[-1]
+    num = 1 + (n - frame_length) // hop
+    idx = (jnp.arange(num) * hop)[:, None] +         jnp.arange(frame_length)[None, :]
+    return jnp.take(v, idx, axis=-1), idx
+
+
+def _ola_scatter(frames, hop: int):
+    """(..., num_frames, frame_length) -> (..., N) overlap-add scatter."""
+    num, fl = frames.shape[-2], frames.shape[-1]
+    n = (num - 1) * hop + fl
+    idx = (jnp.arange(num) * hop)[:, None] + jnp.arange(fl)[None, :]
+    out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+    return out.at[..., idx].add(frames), idx
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames: (..., N) -> (..., frame_length, num_frames)
+    for axis=-1 (paddle layout; axis=0 gives (num_frames, frame_length, ...))."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+
+    def fn(v):
+        ax = axis % v.ndim
+        n = v.shape[ax]
+        if frame_length > n:
+            raise ValueError(
+                f"frame_length {frame_length} > signal length {n}")
+        vm = jnp.moveaxis(v, ax, -1)
+        frames, _ = _frame_gather(vm, frame_length, hop_length)
+        # paddle: axis=-1 -> (..., frame_length, num); axis=0 -> (num, fl, ...)
+        if ax == v.ndim - 1:
+            return jnp.swapaxes(frames, -1, -2)
+        return jnp.moveaxis(jnp.swapaxes(frames, -1, -2), -1, 0)
+
+    return apply(fn, _t(x), op_name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of :func:`frame`: (..., frame_length, num_frames) -> (..., N)
+    with N = (num_frames - 1) * hop_length + frame_length (axis=-1)."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+
+    def fn(v):
+        if axis % v.ndim == v.ndim - 1:
+            fr = jnp.swapaxes(v, -1, -2)      # (..., num, fl)
+        else:
+            fr = jnp.moveaxis(v, (0, 1), (-2, -1))
+        out, _ = _ola_scatter(fr, hop_length)
+        if axis % v.ndim != v.ndim - 1:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply(fn, _t(x), op_name="overlap_add")
+
+
+def _window_array(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if w.shape[-1] != n_fft:
+        raise ValueError(f"window length {w.shape[-1]} != n_fft {n_fft}")
+    return w.astype(dtype)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """paddle.signal.stft parity: (B?, N) real/complex -> (B?, F, num_frames)
+    complex spectrogram."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if wl > n_fft:
+        raise ValueError("win_length must be <= n_fft")
+
+    def fn(v, *rest):
+        is_complex = jnp.iscomplexobj(v)
+        if onesided and is_complex:
+            raise ValueError("onesided is not supported for complex inputs")
+        if rest:
+            w = rest[0].astype(jnp.float32)
+        else:
+            w = jnp.ones((wl,), jnp.float32)
+        # center-pad the window to n_fft (paddle semantics)
+        lp = (n_fft - wl) // 2
+        w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        if v.shape[-1] < n_fft:
+            raise ValueError(
+                f"stft: signal length {v.shape[-1]} "
+                f"{'(after center padding) ' if center else ''}is shorter "
+                f"than n_fft {n_fft}")
+        frames, _ = _frame_gather(v, n_fft, hop)      # (..., num, n_fft)
+        frames = frames * w
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)             # (..., F, num)
+
+    args = [_t(x)] + ([_t(window)] if window is not None else [])
+    return apply(fn, *args, op_name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """paddle.signal.istft parity: inverse with window-envelope
+    normalization (COLA division)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False "
+            "(a onesided spectrum reconstructs a real signal)")
+
+    def fn(v, *rest):
+        if rest:
+            w = rest[0].astype(jnp.float32)
+        else:
+            w = jnp.ones((wl,), jnp.float32)
+        lp = (n_fft - wl) // 2
+        w = jnp.pad(w, (lp, n_fft - wl - lp))
+        spec = jnp.swapaxes(v, -1, -2)                # (..., num, F)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = jnp.real(frames)
+        frames = frames * w
+        num = frames.shape[-2]
+        out, idx = _ola_scatter(frames, hop)
+        n = out.shape[-1]
+        env = jnp.zeros((n,), jnp.float32).at[idx.reshape(-1)].add(
+            jnp.tile(w * w, (num,)))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [_t(x)] + ([_t(window)] if window is not None else [])
+    return apply(fn, *args, op_name="istft")

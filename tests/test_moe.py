@@ -266,3 +266,32 @@ def test_moe_layer_expert_parallel_matches_dense():
             np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
     finally:
         pmesh.set_global_mesh(old)
+
+
+def test_index_dispatch_matches_mask_dispatch():
+    """Round-3 index-based dispatch/combine must equal the dense (N,E,C)
+    mask einsums it replaced, for identical routing."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import moe_ops
+
+    rng = np.random.RandomState(0)
+    N, E, C, d, K = 24, 4, 5, 8, 2
+    idx = rng.randint(-1, E, (N, K)).astype(np.int32)
+    probs = rng.rand(N, K).astype(np.float32)
+    x = rng.randn(N, d).astype(np.float32)
+
+    masks = moe_ops.dispatch_masks_topk(jnp.asarray(idx), E, C)
+    disp_sum = sum(masks)
+    ref_in = np.asarray(jnp.einsum("nec,nd->ecd", disp_sum, jnp.asarray(x)))
+    routes = moe_ops.dispatch_indices_topk(jnp.asarray(idx), E, C)
+    got_in = np.asarray(moe_ops.moe_dispatch_indices(
+        jnp.asarray(x), routes, E, C))
+    np.testing.assert_allclose(got_in, ref_in, rtol=1e-6)
+
+    eo = rng.randn(E, C, d).astype(np.float32)
+    comb = sum(m * jnp.asarray(probs)[:, k][:, None, None]
+               for k, m in enumerate(masks))
+    ref_out = np.asarray(jnp.einsum("nec,ecd->nd", comb, jnp.asarray(eo)))
+    got_out = np.asarray(moe_ops.moe_combine_indices(
+        jnp.asarray(eo), routes, jnp.asarray(probs)))
+    np.testing.assert_allclose(got_out, ref_out, rtol=1e-6, atol=1e-6)

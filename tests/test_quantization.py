@@ -144,3 +144,65 @@ def test_int4_stacked_dequant_matches_per_layer():
                                            else s, "weight_only_int4"))
         np.testing.assert_allclose(stacked[i], one, rtol=1e-6)
         assert one.shape == (8, 6)
+
+
+class TestFusedMultiTransformerInt8:
+    """A8W8 fused encoder (reference fused_multi_transformer_int8_op.cu:§0):
+    int8 weights + quantized activations must track the float stack."""
+
+    def _float_stack(self, L=2, H=32, F=64, heads=4):
+        paddle.seed(0)
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        m = FusedMultiTransformer(H, heads, F, num_layers=L)
+        # give the projections non-trivial weights
+        rs = np.random.RandomState(0)
+        for plist in (m.qkv_weights, m.linear_weights, m.ffn1_weights,
+                      m.ffn2_weights):
+            for p in plist:
+                p._value = jnp.asarray(
+                    rs.randn(*p.shape) * 0.05, jnp.float32)
+        return m
+
+    def test_prefill_tracks_float_stack(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformerInt8
+        m = self._float_stack()
+        q = FusedMultiTransformerInt8.from_float(m)
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(2, 8, 32).astype(np.float32))
+        ref = np.asarray(m(x)._value)
+        got = np.asarray(q(x)._value)
+        # int8 quantization error: ~1% relative of the activation scale
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+        # and the outputs are NOT identical (the int8 path really ran)
+        assert not np.allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+    def test_decode_path_consistent_with_prefill(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformerInt8
+        m = self._float_stack()
+        q = FusedMultiTransformerInt8.from_float(m)
+        rs = np.random.RandomState(2)
+        S = 6
+        x = paddle.to_tensor(rs.randn(1, S, 32).astype(np.float32))
+        full = np.asarray(q(x)._value)
+        # prefill S-1 tokens with a cache, then decode token S-1
+        out, cache = q(paddle.to_tensor(np.asarray(x._value)[:, :S - 1]),
+                       gen_cache_len=S)
+        step, _ = q(paddle.to_tensor(np.asarray(x._value)[:, S - 1:]),
+                    caches=cache, time_step=S - 1)
+        np.testing.assert_allclose(np.asarray(step._value)[:, 0],
+                                   full[:, -1], rtol=2e-2, atol=2e-2)
+
+    def test_calibrated_in_scales_used(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformerInt8
+        m = self._float_stack(L=1)
+        # absurdly small calibrated scale clips activations -> output departs
+        q_dyn = FusedMultiTransformerInt8.from_float(m)
+        q_cal = FusedMultiTransformerInt8.from_float(
+            m, qkv_in_scale=[1e-6], linear_in_scale=[1e-6],
+            ffn1_in_scale=[1e-6], ffn2_in_scale=[1e-6])
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(2, 4, 32).astype(np.float32))
+        a = np.asarray(q_dyn(x)._value)
+        b = np.asarray(q_cal(x)._value)
+        assert not np.allclose(a, b, rtol=1e-3, atol=1e-3)

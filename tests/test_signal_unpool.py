@@ -1,0 +1,102 @@
+"""Round-3 long-tail families: paddle.signal (frame/overlap_add/stft/istft)
+and MaxUnPool (reference phi frame/overlap_add/unpool kernels:§0)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, signal
+from paddle_tpu.nn import functional as F
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 40).astype(np.float32))
+        fr = signal.frame(x, frame_length=8, hop_length=8)   # non-overlap
+        assert tuple(fr.shape) == (2, 8, 5)
+        back = signal.overlap_add(fr, hop_length=8)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x._value), rtol=1e-6)
+
+    def test_frame_matches_manual_strides(self):
+        rs = np.random.RandomState(1)
+        xv = rs.randn(30).astype(np.float32)
+        fr = np.asarray(signal.frame(paddle.to_tensor(xv), 10, 5)._value)
+        assert fr.shape == (10, 5)
+        for j in range(5):
+            np.testing.assert_allclose(fr[:, j], xv[j * 5:j * 5 + 10])
+
+    def test_stft_matches_numpy_oracle(self):
+        rs = np.random.RandomState(2)
+        xv = rs.randn(2, 64).astype(np.float32)
+        n_fft, hop = 16, 4
+        win = np.hanning(n_fft).astype(np.float32)
+        out = np.asarray(signal.stft(
+            paddle.to_tensor(xv), n_fft, hop_length=hop,
+            window=paddle.to_tensor(win), center=False)._value)
+        # manual oracle
+        num = 1 + (64 - n_fft) // hop
+        ref = np.stack([np.fft.rfft(xv[:, i * hop:i * hop + n_fft] * win)
+                        for i in range(num)], axis=-1)
+        assert out.shape == (2, n_fft // 2 + 1, num)
+        np.testing.assert_allclose(out, ref.transpose(0, 1, 2)
+                                   if ref.shape == out.shape else
+                                   np.swapaxes(ref, 1, 2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_stft_istft_roundtrip(self):
+        rs = np.random.RandomState(3)
+        xv = rs.randn(1, 128).astype(np.float32)
+        n_fft, hop = 32, 8
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(xv), n_fft, hop_length=hop,
+                           window=paddle.to_tensor(win))
+        back = signal.istft(spec, n_fft, hop_length=hop,
+                            window=paddle.to_tensor(win), length=128)
+        np.testing.assert_allclose(np.asarray(back._value), xv,
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestMaxUnPool:
+    def test_unpool_inverts_pool_positions(self):
+        rs = np.random.RandomState(4)
+        xv = rs.randn(2, 3, 8, 8).astype(np.float32)
+        x = paddle.to_tensor(xv)
+        pooled, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+        up = F.max_unpool2d(pooled, mask, 2, stride=2)
+        upv = np.asarray(up._value)
+        assert upv.shape == (2, 3, 8, 8)
+        # every pooled max lands at its original position
+        pv = np.asarray(pooled._value)
+        mv = np.asarray(mask._value)
+        for n in range(2):
+            for c in range(3):
+                flat = upv[n, c].reshape(-1)
+                for i in range(4):
+                    for j in range(4):
+                        assert flat[mv[n, c, i, j]] == pv[n, c, i, j]
+        # non-max positions are zero
+        assert (upv != 0).sum() == 2 * 3 * 16
+
+    def test_unpool_layer_and_1d(self):
+        rs = np.random.RandomState(5)
+        x = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))
+        pool = nn.MaxPool2D(2, stride=2, return_mask=True)
+        unpool = nn.MaxUnPool2D(2, stride=2)
+        y, mask = pool(x)
+        up = unpool(y, mask)
+        assert tuple(up.shape) == (1, 2, 6, 6)
+
+        x1 = paddle.to_tensor(rs.randn(1, 2, 10).astype(np.float32))
+        p1, m1 = F.max_pool1d(x1, 2, stride=2, return_mask=True)
+        u1 = F.max_unpool1d(p1, m1, 2, stride=2)
+        assert tuple(u1.shape) == (1, 2, 10)
+
+    def test_unpool_rejects_out_of_range_indices(self):
+        rs = np.random.RandomState(6)
+        x = paddle.to_tensor(rs.randn(1, 1, 8, 8).astype(np.float32))
+        pooled, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+        with pytest.raises(ValueError, match="out of range"):
+            F.max_unpool2d(pooled, mask, 2, stride=2, output_size=(6, 6))
