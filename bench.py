@@ -98,6 +98,14 @@ def main():
                 num_hidden_layers=4, num_attention_heads=32,
                 num_key_value_heads=32, max_position_embeddings=2048,
                 dtype=jnp.bfloat16)
+        elif model == "llama13b_layer":
+            # Llama-2-13B layer geometry (h=5120, ff=13824, 40 heads) at a
+            # one-chip depth — the 13B sibling of llama7b_layer
+            cfg = L.LlamaConfig(
+                vocab_size=8192, hidden_size=5120, intermediate_size=13824,
+                num_hidden_layers=3, num_attention_heads=40,
+                num_key_value_heads=40, max_position_embeddings=2048,
+                dtype=jnp.bfloat16)
         elif model == "wide3072":
             cfg = L.LlamaConfig(
                 vocab_size=32000, hidden_size=3072, intermediate_size=8192,

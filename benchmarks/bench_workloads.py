@@ -184,6 +184,11 @@ def bench_decode():
         B, T, new = 8, 512, 128
 
     params = L.init_stacked_params(cfg, seed=0)
+    if os.environ.get("BENCH_DECODE_INT8") == "1":
+        # weight-only int8 serving: halves the bytes each decode step
+        # streams (models/llama._dense dequantizes inside the layer scan)
+        from paddle_tpu.quantization import quantize_stacked_params
+        params = quantize_stacked_params(params)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
 
@@ -200,9 +205,22 @@ def bench_decode():
     decode_s = max(t_full - t_prefill, 1e-9)
     decode_tok_s = B * (new - 1) / decode_s
     # bandwidth ceiling note: every decode step streams the full weight set
-    n_params = sum(int(np.prod(v.shape)) for v in params.values())
-    bytes_per_tok = n_params * 2 / B              # bf16, amortised over batch
-    return {"metric": "llama_876M_serving_decode",
+    def leaf_bytes(v):
+        # int8-quantized leaves stream 1 byte + their f32 scales; dense
+        # leaves (embed, norms — NOT quantized) stream their own itemsize
+        if isinstance(v, dict):
+            return (int(np.prod(v["q"].shape))
+                    + 4 * int(np.prod(v["scale"].shape)))
+        return int(np.prod(v.shape)) * v.dtype.itemsize
+
+    int8_mode = os.environ.get("BENCH_DECODE_INT8") == "1"
+    n_params = sum(
+        int(np.prod(v["q"].shape)) if isinstance(v, dict)
+        else int(np.prod(v.shape)) for v in params.values())
+    total_bytes = sum(leaf_bytes(v) for v in params.values())
+    bytes_per_tok = total_bytes / B               # amortised over batch
+    return {"metric": "llama_876M_serving_decode"
+            + ("_int8" if int8_mode else ""),
             "prefill_ms": round(t_prefill * 1e3, 1),
             "decode_tokens_per_sec": round(decode_tok_s, 1),
             "per_seq_tokens_per_sec": round(decode_tok_s / B, 1),
@@ -257,10 +275,103 @@ def bench_encoder_int8():
             "geometry": f"L{L} h{H} ff{F} B{B} S{S}"}
 
 
+def bench_vit():
+    """Workload #5a: ViT-L/16 supervised training step (conv/attn mix)."""
+    jax, smoke = _setup()
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.models.vit import (vit_large_patch16_224,
+                                              vit_tiny_test)
+
+    if smoke:
+        B, side, steps, warm = 2, 16, 2, 1
+    else:
+        B, side, steps, warm = 32, 224, 10, 2
+
+    paddle.seed(0)
+    net = vit_tiny_test() if smoke else vit_large_patch16_224(class_num=1000)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
+    if not smoke:
+        amp.decorate(models=net, optimizers=opt, level="O2",
+                     dtype="bfloat16")
+
+    def loss_fn(model, x, y):
+        return F.cross_entropy(model(x).astype("float32"), y)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(B, 3, side, side).astype(np.float32))
+    if not smoke:
+        x = x.astype("bfloat16")
+    y = paddle.to_tensor(rng.randint(0, 10 if smoke else 1000, (B,)).astype(np.int64))
+    for _ in range(warm):
+        loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    img_s = B * steps / dt
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    # ViT train flops/img ~= 6 * matmul params * tokens + attention
+    tokens = (side // 16) ** 2 + 1
+    flops_img = 6.0 * (n_params - 1000 * 1024) * tokens if not smoke else 0
+    mfu = flops_img * img_s / PEAK_V5E if not smoke else 0.0
+    return {"metric": "vit_large_train", "img_per_sec": round(img_s, 1),
+            "step_ms": round(dt / steps * 1e3, 1), "mfu": round(mfu, 4),
+            "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
+
+
+def bench_ppyoloe():
+    """Workload #5b: PP-YOLOE-s detection training step."""
+    jax, smoke = _setup()
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models.ppyoloe import PPYOLOE
+
+    if smoke:
+        B, side, steps, warm = 1, 64, 2, 1
+        net = PPYOLOE(num_classes=4, width_mult=0.25, depth_mult=0.33)
+    else:
+        B, side, steps, warm = 16, 320, 10, 2
+        net = PPYOLOE(num_classes=80, width_mult=0.5, depth_mult=0.33)
+
+    paddle.seed(0)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
+
+    def loss_fn(model, x, gb, gl):
+        return model.compute_loss(x, gb, gl)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(B, 3, side, side).astype(np.float32))
+    G = 8
+    gb = rng.rand(B, G, 4).astype(np.float32) * side
+    gb[..., 2:] = np.maximum(gb[..., 2:], gb[..., :2] + 4)
+    gl = rng.randint(0, 4 if smoke else 80, (B, G))
+    gb_t = paddle.to_tensor(gb)
+    gl_t = paddle.to_tensor(gl.astype(np.int32))
+    for _ in range(warm):
+        loss = step(x, gb_t, gl_t)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, gb_t, gl_t)
+    float(loss)
+    dt = time.perf_counter() - t0
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    return {"metric": "ppyoloe_s_train", "img_per_sec": round(B * steps / dt, 1),
+            "step_ms": round(dt / steps * 1e3, 1),
+            "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     benches = {"bert": bench_bert, "moe": bench_moe, "decode": bench_decode,
-               "encoder_int8": bench_encoder_int8}
+               "encoder_int8": bench_encoder_int8, "vit": bench_vit,
+               "ppyoloe": bench_ppyoloe}
     if which != "all" and which not in benches:
         sys.exit(f"unknown bench {which!r}; pick from "
                  f"{['all'] + sorted(benches)}")

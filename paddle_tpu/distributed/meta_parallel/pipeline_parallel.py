@@ -76,6 +76,20 @@ class PipelineParallel(Layer):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """GPipe-equivalent gradient accumulation over microbatches.
         Reference: forward_backward_pipeline + 1F1B (SURVEY.md §3.2)."""
+        import os
+        if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+            # the eager microbatch loop depends on in-process activations;
+            # across OS processes it would need the eager p2p mailbox, which
+            # is single-process by design (communication/p2p.py). Fail HERE
+            # with the route out instead of deep inside a send().
+            raise RuntimeError(
+                "PipelineParallel.train_batch is a single-process "
+                "(single-controller) engine; under a multi-process launcher "
+                "use the compiled pipeline instead — "
+                "models.llama.build_hybrid_train_step(pipeline_schedule="
+                "'fill_drain'|'1f1b') or parallel.pipeline.pipeline_spmd — "
+                "which runs the whole pipeline as ONE XLA program with "
+                "ppermute over ICI (SURVEY.md §2.4 PP row).")
         assert self._layers._loss_fn is not None, "PipelineLayer needs loss_fn"
         micro = self._split_micro(data)
         total = None

@@ -197,8 +197,9 @@ def forward_stacked(params: Dict[str, Any], ids, config: LlamaConfig):
     x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
 
     def body(carry, lp):
-        return _decoder_layer_manual(lp, carry, cos, sin, config=config,
-                                     mp_axis=None, fsdp_axis=None), None
+        out = _decoder_layer_manual(lp, carry, cos, sin, config=config,
+                                    mp_axis=None, fsdp_axis=None)
+        return out.astype(carry.dtype), None
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, _ = lax.scan(body, x, layer_params)
@@ -800,7 +801,8 @@ def prefill_stacked(params, ids, cache, config: LlamaConfig):
         lp, kc, vc = lp_kv
         xo, kc, vc = _decoder_layer_cached(lp, xc, cos_full[:t], sin_full[:t],
                                            kc, vc, kv_len, config)
-        return xo, (kc, vc)
+        # int8-quantized weights dequantize to f32; keep the carry dtype
+        return xo.astype(xc.dtype), (kc, vc)
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
@@ -825,7 +827,7 @@ def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
         lp, kc, vc = lp_kv
         xo, kc, vc = _decoder_layer_cached(lp, xc, cos, sin, kc, vc,
                                            kv_len, config)
-        return xo, (kc, vc)
+        return xo.astype(xc.dtype), (kc, vc)
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
     x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))

@@ -43,40 +43,49 @@ def _ola_scatter(frames, hop: int):
 
 
 def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
-    """Slice overlapping frames: (..., N) -> (..., frame_length, num_frames)
-    for axis=-1 (paddle layout; axis=0 gives (num_frames, frame_length, ...))."""
+    """Slice overlapping frames (paddle layout, axis must be 0 or -1):
+    axis=-1: (..., N) -> (..., frame_length, num_frames);
+    axis=0:  (N, ...) -> (num_frames, frame_length, ...)."""
     if frame_length <= 0 or hop_length <= 0:
         raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("frame: axis must be 0 or -1 (paddle semantics)")
 
     def fn(v):
-        ax = axis % v.ndim
-        n = v.shape[ax]
+        # branch on the USER-CHOSEN layout: for 1-D input axis 0 and -1
+        # name the same dimension but paddle's output layouts differ
+        sig_ax = 0 if axis == 0 else v.ndim - 1
+        n = v.shape[sig_ax]
         if frame_length > n:
             raise ValueError(
                 f"frame_length {frame_length} > signal length {n}")
-        vm = jnp.moveaxis(v, ax, -1)
+        vm = jnp.moveaxis(v, sig_ax, -1)
         frames, _ = _frame_gather(vm, frame_length, hop_length)
-        # paddle: axis=-1 -> (..., frame_length, num); axis=0 -> (num, fl, ...)
-        if ax == v.ndim - 1:
-            return jnp.swapaxes(frames, -1, -2)
-        return jnp.moveaxis(jnp.swapaxes(frames, -1, -2), -1, 0)
+        # frames: (..., num, frame_length)
+        if axis == 0:
+            return jnp.moveaxis(jnp.moveaxis(frames, -2, 0), -1, 1)
+        return jnp.swapaxes(frames, -1, -2)
 
     return apply(fn, _t(x), op_name="frame")
 
 
 def overlap_add(x, hop_length: int, axis: int = -1, name=None):
-    """Inverse of :func:`frame`: (..., frame_length, num_frames) -> (..., N)
-    with N = (num_frames - 1) * hop_length + frame_length (axis=-1)."""
+    """Inverse of :func:`frame` (axis 0 or -1): axis=-1 consumes
+    (..., frame_length, num_frames), axis=0 consumes
+    (num_frames, frame_length, ...); N = (num-1)*hop + frame_length."""
     if hop_length <= 0:
         raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
 
     def fn(v):
-        if axis % v.ndim == v.ndim - 1:
-            fr = jnp.swapaxes(v, -1, -2)      # (..., num, fl)
+        if axis == 0:
+            # v: (num, fl, ...) -> (..., num, fl)
+            fr = jnp.moveaxis(jnp.moveaxis(v, 1, -1), 0, -2)
         else:
-            fr = jnp.moveaxis(v, (0, 1), (-2, -1))
+            fr = jnp.swapaxes(v, -1, -2)      # (..., num, fl)
         out, _ = _ola_scatter(fr, hop_length)
-        if axis % v.ndim != v.ndim - 1:
+        if axis == 0:
             out = jnp.moveaxis(out, -1, 0)
         return out
 

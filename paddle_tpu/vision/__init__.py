@@ -9,6 +9,7 @@ framework's nn layers so they run through the same jax/XLA compute path
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
 
 from .models import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,

@@ -259,6 +259,41 @@ class PPYOLOE(nn.Layer):
         return apply(fn, scores, boxes, op_name="ppyoloe_predict",
                      n_outputs=4)
 
+    def predict_with_nms(self, images, score_threshold=0.25, top_k=100,
+                         nms_threshold=0.6, keep_top_k=30):
+        """Full detection postprocess — the reference pipeline's
+        multiclass_nms3 tail (ppdet post_process:§0): static top-k anchor
+        selection on device, then per-image class-aware NMS
+        (vision/ops.py). Returns per-image lists of
+        (boxes (M,4), scores (M,), labels (M,)) numpy arrays."""
+        from ...vision import ops as vops
+
+        val, sel, lab, keep = self.predict(images, score_threshold, top_k)
+        val_np = np.asarray(val._value)
+        sel_np = np.asarray(sel._value)
+        lab_np = np.asarray(lab._value)
+        keep_np = np.asarray(keep._value)
+        results = []
+        for b in range(val_np.shape[0]):
+            m = keep_np[b]
+            if not m.any():
+                results.append((np.zeros((0, 4), np.float32),
+                                np.zeros((0,), np.float32),
+                                np.zeros((0,), np.int64)))
+                continue
+            boxes = sel_np[b][m]
+            scores = val_np[b][m]
+            labels = lab_np[b][m]
+            kept = np.asarray(vops.nms(
+                Tensor(jnp.asarray(boxes)), nms_threshold,
+                Tensor(jnp.asarray(scores)),
+                Tensor(jnp.asarray(labels.astype(np.int32))),
+                categories=list(range(self.num_classes)),
+                top_k=keep_top_k)._value)
+            results.append((boxes[kept], scores[kept],
+                            labels[kept].astype(np.int64)))
+        return results
+
     def predict_bucketed(self, images, score_threshold=0.25, top_k=100,
                          batch_buckets=(1, 2, 4, 8)):
         """Ragged-batch eval with shape bucketing — the workload-#5

@@ -1,0 +1,275 @@
+"""``paddle.vision.ops`` — detection operators.
+
+Rebuild of python/paddle/vision/ops.py over the phi detection kernels
+(nms, roi_align, yolo_box, distribute_fpn_proposals — SURVEY.md §2.1
+kernel corpus; workload #5's serving tail). TPU-first: everything is
+STATIC-shape — NMS returns a fixed-size keep mask ordered by score (the
+caller slices by the returned count), roi_align is a bilinear gather XLA
+fuses, and IoU matrices are one broadcasted elementwise block.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["box_iou", "nms", "roi_align", "yolo_box", "box_coder"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _iou_matrix(a, b):
+    """(N,4),(M,4) xyxy -> (N,M) IoU (fp32)."""
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU of two xyxy box sets (paddle.vision.ops.box_iou... the
+    reference iou_similarity surface)."""
+    return apply(_iou_matrix, _t(boxes1), _t(boxes2), op_name="box_iou")
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None,
+        name=None):
+    """paddle.vision.ops.nms — greedy IoU suppression.
+
+    TPU-native formulation: sort by score, compute the (N,N) IoU matrix
+    once, then one ``lax.scan`` pass marks each box suppressed iff an
+    earlier KEPT box overlaps it beyond the threshold — the same greedy
+    result as the reference's sequential CUDA kernel, with static shapes.
+    With ``category_idxs``/``categories`` suppression is per-class
+    (batched NMS via the coordinate-offset trick). Returns the kept box
+    indices sorted by descending score (eager: 1-D int array of the kept
+    count, truncated to ``top_k`` when given — matching paddle).
+    """
+    def fn(bx, *rest):
+        n = bx.shape[0]
+        if rest:
+            sc = rest[0].astype(jnp.float32)
+        else:
+            sc = -jnp.arange(n, dtype=jnp.float32)  # document order
+        work = bx.astype(jnp.float32)
+        if len(rest) > 1:
+            # per-class suppression: shift each class to a disjoint tile
+            cat = rest[1].astype(jnp.float32)[:, None]
+            span = jnp.max(work) - jnp.min(work) + 1.0
+            work = work + cat * span
+        order = jnp.argsort(-sc)
+        sorted_boxes = work[order]
+        iou = _iou_matrix(sorted_boxes, sorted_boxes)
+
+        def step(kept, i):
+            # suppressed iff any higher-scoring KEPT box overlaps > thr
+            over = (iou[i] > iou_threshold) & kept & \
+                (jnp.arange(n) < i)
+            keep_i = ~jnp.any(over)
+            return kept.at[i].set(keep_i), keep_i
+
+        kept0 = jnp.zeros((n,), bool)
+        _, keep_sorted = lax.scan(step, kept0, jnp.arange(n))
+        return order, keep_sorted
+
+    args = [_t(boxes)]
+    if scores is not None:
+        args.append(_t(scores))
+        if category_idxs is not None:
+            args.append(_t(category_idxs))
+    elif category_idxs is not None:
+        raise ValueError("category_idxs requires scores")
+    order, keep = apply(fn, *args, op_name="nms", n_outputs=2)
+    # eager tail: materialize the ragged index list the reference returns
+    order_np = np.asarray(order._value)
+    keep_np = np.asarray(keep._value).astype(bool)
+    kept_idx = order_np[keep_np]
+    if top_k is not None:
+        kept_idx = kept_idx[:top_k]
+    return Tensor(jnp.asarray(kept_idx.astype(np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """paddle.vision.ops.roi_align: (N,C,H,W) features + per-image xyxy
+    rois -> (total_rois, C, oh, ow) via bilinear sampling (reference phi
+    roi_align kernel:§0)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def fn(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        total = rois.shape[0]
+        # roi -> image index from boxes_num prefix sums
+        starts = jnp.cumsum(rois_num) - rois_num
+        img_of = jnp.searchsorted(jnp.cumsum(rois_num),
+                                  jnp.arange(total), side="right")
+        del starts
+        off = 0.5 if aligned else 0.0
+        rb = rois.astype(jnp.float32) * spatial_scale - off
+        x1, y1, x2, y2 = rb[:, 0], rb[:, 1], rb[:, 2], rb[:, 3]
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w = rw / ow
+        bin_h = rh / oh
+        # sample grid: (total, oh, ow, ratio, ratio) bilinear points
+        gy = (y1[:, None, None] + (jnp.arange(oh)[None, :, None] +
+              (jnp.arange(ratio)[None, None, :] + 0.5) / ratio)
+              * bin_h[:, None, None])            # (T, oh, ratio)
+        gx = (x1[:, None, None] + (jnp.arange(ow)[None, :, None] +
+              (jnp.arange(ratio)[None, None, :] + 0.5) / ratio)
+              * bin_w[:, None, None])            # (T, ow, ratio)
+
+        def bilinear(ix, iy, t_img):
+            x0 = jnp.floor(ix)
+            y0 = jnp.floor(iy)
+            wx = ix - x0
+            wy = iy - y0
+            x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+            x1i = jnp.clip(x0i + 1, 0, w - 1)
+            y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+            y1i = jnp.clip(y0i + 1, 0, h - 1)
+            fm = feat[t_img]                         # (C, H, W)
+            v00 = fm[:, y0i, x0i]
+            v01 = fm[:, y0i, x1i]
+            v10 = fm[:, y1i, x0i]
+            v11 = fm[:, y1i, x1i]
+            return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                    + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+        def per_roi(t):
+            # (oh, ratio) x (ow, ratio) grid -> mean over samples
+            yy = gy[t][:, None, :, None]             # (oh,1,ratio,1)
+            xx = gx[t][None, :, None, :]             # (1,ow,1,ratio)
+            yb = jnp.broadcast_to(yy, (oh, ow, ratio, ratio)).reshape(-1)
+            xb = jnp.broadcast_to(xx, (oh, ow, ratio, ratio)).reshape(-1)
+            vals = bilinear(xb, yb, img_of[t])       # (C, oh*ow*r*r)
+            vals = vals.reshape(c, oh, ow, ratio * ratio)
+            return vals.mean(axis=-1)
+
+        return jax.vmap(per_roi)(jnp.arange(total))
+
+    return apply(fn, _t(x), _t(boxes), _t(boxes_num), op_name="roi_align")
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float = 0.005, downsample_ratio: int = 32,
+             clip_bbox: bool = True, scale_x_y: float = 1.0,
+             iou_aware: bool = False, iou_aware_factor: float = 0.5,
+             name=None):
+    """paddle.vision.ops.yolo_box: raw YOLO head (N, A*(5+cls), H, W) ->
+    decoded boxes (N, A*H*W, 4) xyxy in image pixels + scores
+    (N, A*H*W, cls). Static shapes; conf_thresh zeroes scores (the
+    reference's filtering semantics without ragged output)."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def fn(xv, imgs):
+        n, ch, h, w = xv.shape
+        v = xv.reshape(n, A, 5 + class_num, h, w).astype(jnp.float32)
+        tx, ty, tw, th, obj = (v[:, :, 0], v[:, :, 1], v[:, :, 2],
+                               v[:, :, 3], v[:, :, 4])
+        cls = v[:, :, 5:]                         # (N, A, cls, H, W)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        alpha = scale_x_y
+        beta = -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * alpha + beta + gx) / w
+        cy = (jax.nn.sigmoid(ty) * alpha + beta + gy) / h
+        aw = anchors[:, 0][None, :, None, None]
+        ah = anchors[:, 1][None, :, None, None]
+        bw = jnp.exp(tw) * aw / (w * downsample_ratio)
+        bh = jnp.exp(th) * ah / (h * downsample_ratio)
+        im_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * im_w
+        y1 = (cy - bh / 2) * im_h
+        x2 = (cx + bw / 2) * im_w
+        y2 = (cy + bh / 2) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, im_w - 1)
+            y1 = jnp.clip(y1, 0.0, im_h - 1)
+            x2 = jnp.clip(x2, 0.0, im_w - 1)
+            y2 = jnp.clip(y2, 0.0, im_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (N,A,H,W,4)
+        conf = jax.nn.sigmoid(obj)
+        conf = jnp.where(conf > conf_thresh, conf, 0.0)
+        scores = jax.nn.sigmoid(cls) * conf[:, :, None]
+        boxes = boxes.reshape(n, A * h * w, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            n, A * h * w, class_num)
+        return boxes, scores
+
+    return apply(fn, _t(x), _t(img_size), op_name="yolo_box", n_outputs=2)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0, name=None):
+    """paddle.vision.ops.box_coder (SSD-style box encode/decode)."""
+    def fn(prior, var, target):
+        prior = prior.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        norm = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + norm
+        ph = prior[:, 3] - prior[:, 1] + norm
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if var is not None:
+            var = var.astype(jnp.float32)
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + norm
+            th = target[:, 3] - target[:, 1] + norm
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            if var is not None:
+                out = out / var[None, :, :]
+            return out
+        # decode_center_size: target (N, M, 4) deltas over priors
+        t = target
+        if var is not None:
+            t = t * (var[None, :, :] if var.ndim == 2 else var)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+        ocx = t[..., 0] * pw_ + pcx_
+        ocy = t[..., 1] * ph_ + pcy_
+        ow_ = jnp.exp(t[..., 2]) * pw_
+        oh_ = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([ocx - ow_ * 0.5, ocy - oh_ * 0.5,
+                          ocx + ow_ * 0.5 - norm,
+                          ocy + oh_ * 0.5 - norm], axis=-1)
+
+    pv = _t(prior_box_var) if prior_box_var is not None else None
+    if pv is None:
+        def fn2(prior, target):
+            return fn(prior, None, target)
+        return apply(fn2, _t(prior_box), _t(target_box), op_name="box_coder")
+    return apply(fn, _t(prior_box), pv, _t(target_box), op_name="box_coder")

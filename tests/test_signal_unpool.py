@@ -100,3 +100,30 @@ class TestMaxUnPool:
         pooled, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
         with pytest.raises(ValueError, match="out of range"):
             F.max_unpool2d(pooled, mask, 2, stride=2, output_size=(6, 6))
+
+
+class TestFrameAxis0:
+    def test_axis0_layout_and_roundtrip(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(40, 2).astype(np.float32)
+        fr = signal.frame(paddle.to_tensor(x), 8, 8, axis=0)
+        assert tuple(fr.shape) == (5, 8, 2)      # (num, fl, ...)
+        for j in range(5):
+            np.testing.assert_allclose(np.asarray(fr._value)[j],
+                                       x[j * 8:(j + 1) * 8])
+        back = signal.overlap_add(fr, 8, axis=0)
+        np.testing.assert_allclose(np.asarray(back._value), x, rtol=1e-6)
+
+    def test_1d_axis0_vs_axis_minus1(self):
+        x = np.arange(30, dtype=np.float32)
+        f0 = np.asarray(signal.frame(paddle.to_tensor(x), 10, 5,
+                                     axis=0)._value)
+        f1 = np.asarray(signal.frame(paddle.to_tensor(x), 10, 5,
+                                     axis=-1)._value)
+        assert f0.shape == (5, 10) and f1.shape == (10, 5)
+        np.testing.assert_allclose(f0, f1.T)
+
+    def test_invalid_axis_rejected(self):
+        x = paddle.to_tensor(np.zeros((4, 40), np.float32))
+        with pytest.raises(ValueError, match="axis"):
+            signal.frame(x, 8, 4, axis=1)

@@ -1,0 +1,187 @@
+"""Detection operators (paddle.vision.ops over phi detection kernels:§0):
+box_iou / nms / roi_align / yolo_box / box_coder — workload #5's serving
+tail. NMS oracle: plain-python greedy suppression."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _py_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        lt = np.maximum(boxes[i, :2], boxes[rest, :2])
+        rb = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        a = np.prod(boxes[i, 2:] - boxes[i, :2])
+        b = np.prod(boxes[rest, 2:] - boxes[rest, :2], axis=1)
+        iou = inter / np.maximum(a + b - inter, 1e-9)
+        order = rest[iou <= thr]
+    return np.asarray(keep)
+
+
+def _rand_boxes(rng, n, size=100.0):
+    xy = rng.rand(n, 2) * size
+    wh = rng.rand(n, 2) * 30 + 2
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+class TestNMS:
+    def test_matches_python_oracle(self):
+        rng = np.random.RandomState(0)
+        boxes = _rand_boxes(rng, 60)
+        scores = rng.rand(60).astype(np.float32)
+        for thr in (0.1, 0.3, 0.6):
+            got = np.asarray(V.nms(paddle.to_tensor(boxes), thr,
+                                   paddle.to_tensor(scores))._value)
+            ref = _py_nms(boxes, scores, thr)
+            np.testing.assert_array_equal(got, ref)
+
+    def test_categorical_nms_is_per_class(self):
+        rng = np.random.RandomState(1)
+        # two identical boxes in different classes both survive
+        boxes = np.tile(_rand_boxes(rng, 1), (2, 1))
+        scores = np.asarray([0.9, 0.8], np.float32)
+        cats = np.asarray([0, 1], np.int32)
+        got = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                               paddle.to_tensor(scores),
+                               paddle.to_tensor(cats),
+                               categories=[0, 1])._value)
+        assert set(got.tolist()) == {0, 1}
+
+    def test_top_k(self):
+        rng = np.random.RandomState(2)
+        boxes = _rand_boxes(rng, 30)
+        scores = rng.rand(30).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.99,
+                    paddle.to_tensor(scores), top_k=5)
+        assert got.shape[0] == 5
+
+
+class TestBoxOps:
+    def test_box_iou_oracle(self):
+        rng = np.random.RandomState(3)
+        a = _rand_boxes(rng, 5)
+        b = _rand_boxes(rng, 7)
+        got = np.asarray(V.box_iou(paddle.to_tensor(a),
+                                   paddle.to_tensor(b))._value)
+        assert got.shape == (5, 7)
+        # diag-free oracle spot check
+        for i in range(5):
+            for j in range(7):
+                lt = np.maximum(a[i, :2], b[j, :2])
+                rb = np.minimum(a[i, 2:], b[j, 2:])
+                wh = np.clip(rb - lt, 0, None)
+                inter = wh[0] * wh[1]
+                u = (np.prod(a[i, 2:] - a[i, :2])
+                     + np.prod(b[j, 2:] - b[j, :2]) - inter)
+                np.testing.assert_allclose(got[i, j], inter / max(u, 1e-9),
+                                           rtol=1e-5)
+        self_iou = np.asarray(V.box_iou(paddle.to_tensor(a),
+                                        paddle.to_tensor(a))._value)
+        np.testing.assert_allclose(np.diag(self_iou), 1.0, rtol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(4)
+        priors = _rand_boxes(rng, 6)
+        targets = _rand_boxes(rng, 6)
+        enc = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(targets))
+        # decode the DIAGONAL (each target against its own prior)
+        deltas = np.stack([np.asarray(enc._value)[i, i]
+                           for i in range(6)])[None].transpose(1, 0, 2)
+        dec = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(deltas.astype(np.float32)
+                                           .reshape(6, 1, 4)),
+                          code_type="decode_center_size", axis=1)
+        np.testing.assert_allclose(np.asarray(dec._value)[:, 0],
+                                   targets, rtol=1e-4, atol=1e-3)
+
+
+class TestRoiAlign:
+    def test_constant_feature_map(self):
+        # constant features -> every roi pools to that constant
+        feat = np.full((1, 3, 16, 16), 2.5, np.float32)
+        rois = np.asarray([[2.0, 2.0, 10.0, 10.0],
+                           [0.0, 0.0, 15.0, 15.0]], np.float32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                          paddle.to_tensor(np.asarray([2], np.int32)),
+                          output_size=4)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        np.testing.assert_allclose(np.asarray(out._value), 2.5, rtol=1e-5)
+
+    def test_linear_ramp_center_sampling(self):
+        # f(x,y) = x: pooled value of each bin ~= bin center x coordinate
+        w = 32
+        feat = np.tile(np.arange(w, dtype=np.float32)[None, None, None, :],
+                       (1, 1, w, 1))
+        rois = np.asarray([[4.0, 4.0, 20.0, 20.0]], np.float32)
+        out = np.asarray(V.roi_align(
+            paddle.to_tensor(feat), paddle.to_tensor(rois),
+            paddle.to_tensor(np.asarray([1], np.int32)),
+            output_size=4)._value)
+        bin_w = 16.0 / 4
+        centers = 4.0 + bin_w * (np.arange(4) + 0.5) - 0.5
+        np.testing.assert_allclose(out[0, 0, 0], centers, rtol=1e-3,
+                                   atol=1e-2)
+
+    def test_multi_image_batch(self):
+        rng = np.random.RandomState(5)
+        feat = rng.randn(2, 2, 8, 8).astype(np.float32)
+        rois = np.asarray([[0, 0, 7, 7], [1, 1, 6, 6], [0, 0, 7, 7]],
+                          np.float32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                          paddle.to_tensor(np.asarray([2, 1], np.int32)),
+                          output_size=2)
+        # roi 0 (image 0) and roi 2 (image 1) share coords; different images
+        a = np.asarray(out._value)
+        assert not np.allclose(a[0], a[2])
+
+
+class TestYoloBox:
+    def test_shapes_and_grid_decode(self):
+        rng = np.random.RandomState(6)
+        A, C, H, W = 3, 4, 5, 5
+        x = rng.randn(2, A * (5 + C), H, W).astype(np.float32)
+        img = np.asarray([[320, 320], [416, 320]], np.int32)
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img),
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   class_num=C, downsample_ratio=32)
+        assert tuple(boxes.shape) == (2, A * H * W, 4)
+        assert tuple(scores.shape) == (2, A * H * W, C)
+        b = np.asarray(boxes._value)
+        assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+        assert (b[0] <= 320).all() and (b[0] >= 0).all()   # clipped
+        s = np.asarray(scores._value)
+        assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_ppyoloe_predict_with_nms_end_to_end():
+    """Workload #5 serving tail: predict -> class-aware NMS postprocess."""
+    from paddle_tpu.vision.models.ppyoloe import PPYOLOE
+
+    paddle.seed(0)
+    net = PPYOLOE(num_classes=4, width_mult=0.25, depth_mult=0.33)
+    net.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 64, 64).astype(np.float32))
+    results = net.predict_with_nms(x, score_threshold=0.0, top_k=20,
+                                   nms_threshold=0.5, keep_top_k=10)
+    assert len(results) == 2
+    for boxes, scores, labels in results:
+        assert boxes.shape[1] == 4 and boxes.shape[0] <= 10
+        assert scores.shape[0] == boxes.shape[0]
+        assert labels.shape[0] == boxes.shape[0]
+        # scores sorted descending (NMS keep order)
+        if scores.size > 1:
+            assert (np.diff(scores) <= 1e-6).all()
