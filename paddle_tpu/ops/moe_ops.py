@@ -158,10 +158,13 @@ def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
     if act is None:
         act = jax.nn.gelu
 
-    disp, comb = dispatch_combine_topk(gate_idx_local, gate_prob_local,
-                                       num_experts, capacity)
+    # round 3: index-based dispatch (O(N·d) scatter) builds the same dense
+    # (E, C, d) slot layout the all_to_all needs, without the (N,E,C)
+    # one-hot einsum
+    routes = dispatch_indices_topk(gate_idx_local, num_experts, capacity)
     in_dtype = x_local.dtype
-    slots = moe_dispatch(x_local.astype(jnp.float32), disp)  # (E, C, d)
+    slots = moe_dispatch_indices(x_local.astype(jnp.float32), routes,
+                                 num_experts, capacity)   # (E, C, d)
 
     d_model = x_local.shape[-1]
     z = slots.reshape(n, e_local, capacity, d_model)
@@ -181,7 +184,8 @@ def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
     y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
     y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
     y = y.reshape(num_experts, capacity, d_model)
-    return moe_combine(y.astype(jnp.float32), comb).astype(in_dtype)
+    return moe_combine_indices(y.astype(jnp.float32), routes,
+                               gate_prob_local).astype(in_dtype)
 
 
 def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
