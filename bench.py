@@ -136,13 +136,17 @@ def main():
     # chip (374M, B=8 S=2048): remat OFF out-of-memories; the "dots" policy
     # (save matmul outputs) reached only 34.3% MFU vs full remat's 37.6% —
     # the saved activations raise HBM pressure more than the skipped
-    # recompute saves. Full remat stays default; BENCH_REMAT=full|dots|off.
+    # recompute saves. Round 3 also tried BENCH_REMAT=attn (save only the
+    # flash-attention outputs): 51.4% vs full remat's 52.0% at the 7B
+    # geometry — same verdict. Full remat stays default;
+    # BENCH_REMAT=full|dots|attn|off.
     remat_mode = os.environ.get("BENCH_REMAT", "full")
     # legacy knob values from earlier rounds: 1 = full remat, 0 = off
     remat_mode = {"1": "full", "0": "off"}.get(remat_mode, remat_mode)
     step, init_fn = L.build_hybrid_train_step(
         cfg, mesh, learning_rate=1e-4, remat=remat_mode != "off",
-        remat_policy=remat_mode if remat_mode in ("full", "dots") else "full")
+        remat_policy=remat_mode if remat_mode in ("full", "dots", "attn")
+        else "full")
     params, opt_state = init_fn(seed=0)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (1, B, S)).astype(np.int32)

@@ -335,6 +335,13 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
         if sep_axis is not None:
             attn = lax.all_to_all(attn, sep_axis, split_axis=1, concat_axis=2,
                                   tiled=True)
+    # named for the selective remat policy (remat_policy="attn"). NOTE the
+    # measured verdict (BASELINE.md): the flash custom_vjp still replays
+    # its forward to rematerialize the unsaved LSE, so saving these
+    # outputs buys little and the extra live memory made it SLOWER than
+    # full remat (51.4% vs 52.0% at the 7B geometry) — kept as a knob
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+    attn = _ckpt_name(attn, "attn_out")
     attn = attn.reshape(b, s, -1)
     out = jnp.einsum("bsd,dh->bsh", attn, gather_out(_dense(p["wo"])))
     if mp_axis is not None:
@@ -389,6 +396,9 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
     if pipeline_schedule not in ("fill_drain", "1f1b"):
         raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+    if remat_policy not in ("full", "dots", "attn"):
+        raise ValueError(f"unknown remat_policy {remat_policy!r} "
+                         "(expected 'full', 'dots' or 'attn')")
     if pipeline_schedule == "1f1b":
         if mesh.shape.get("pp", 1) <= 1:
             raise ValueError("pipeline_schedule='1f1b' needs a pp axis > 1")
@@ -481,6 +491,12 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                         # remat at a modest activation-memory cost
                         fn = jax.checkpoint(
                             fn, policy=jax.checkpoint_policies.dots_saveable)
+                    elif remat_policy == "attn":
+                        # save only the flash-attention outputs: the one
+                        # recompute with superlinear (S^2) cost
+                        fn = jax.checkpoint(
+                            fn, policy=jax.checkpoint_policies
+                            .save_only_these_names("attn_out"))
                     else:
                         fn = jax.checkpoint(fn)
                 return fn(lp, carry, cos, sin), None
