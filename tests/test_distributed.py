@@ -183,6 +183,7 @@ class MLPWithSameInit(nn.Layer):
         return self.fc2(F.relu(self.fc1(x)))
 
 
+@pytest.mark.slow
 def test_zero_stage1_opt_state_sharded():
     def loss_fn(model, x, y):
         return F.mse_loss(model(x), y)

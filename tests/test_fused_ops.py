@@ -82,6 +82,7 @@ class TestFusedMultiTransformer:
         self.x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 32),
                              jnp.float32)
 
+    @pytest.mark.slow
     def test_prefill_matches_reference_loop(self):
         out, kv = ftb.fused_multi_transformer_array(
             self.x, self.params, num_heads=4)
@@ -169,6 +170,7 @@ class TestReviewRegressions:
         y_b = mha(x, causal=False).numpy()
         assert np.abs(y_c - y_b).max() > 1e-5
 
+    @pytest.mark.slow
     def test_ragged_decode_ignores_padded_cache(self):
         """Two sequences, prefill lens 3 and 5: the short one's decode must
         equal its own standalone decode (no attention to pad slots)."""

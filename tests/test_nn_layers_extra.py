@@ -107,6 +107,7 @@ class TestTransformer:
         p1 = model.encoder.layers[1].linear1.weight
         assert p0 is not p1
 
+    @pytest.mark.slow
     def test_causal_mask_blocks_future(self):
         paddle.seed(2)
         layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
@@ -232,6 +233,7 @@ class TestReviewRegressions:
             x[:, :1], cache=dec.gen_cache(x[:, :0]))[1]
         assert cache.k.shape[1] == 1  # accumulated one step
 
+    @pytest.mark.slow
     def test_ctc_mean_divides_by_label_length(self):
         import jax
         import paddle_tpu.nn.functional as F

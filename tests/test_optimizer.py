@@ -97,6 +97,7 @@ def test_lr_scheduler():
     np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_training_converges():
     paddle.seed(0)
     net = nn.Linear(4, 1)

@@ -91,6 +91,7 @@ def test_group_sharded_parity(level):
                                    rtol=2e-4, atol=2e-5, err_msg=n1)
 
 
+@pytest.mark.slow
 def test_stage1_state_is_sharded():
     pmesh.set_global_mesh(pmesh.build_mesh({"sharding": 4}))
     model = _mlp()
@@ -140,6 +141,7 @@ def test_save_group_sharded_model(tmp_path):
                                    err_msg=n)
 
 
+@pytest.mark.slow
 def test_fleet_wraps_sharding_optimizer():
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}

@@ -80,6 +80,7 @@ class TestMaxUnPool:
         # non-max positions are zero
         assert (upv != 0).sum() == 2 * 3 * 16
 
+    @pytest.mark.slow
     def test_unpool_layer_and_1d(self):
         rs = np.random.RandomState(5)
         x = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))

@@ -119,6 +119,7 @@ class TestRoiAlign:
         assert tuple(out.shape) == (2, 3, 4, 4)
         np.testing.assert_allclose(np.asarray(out._value), 2.5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_linear_ramp_center_sampling(self):
         # f(x,y) = x: pooled value of each bin ~= bin center x coordinate
         w = 32
@@ -134,6 +135,7 @@ class TestRoiAlign:
         np.testing.assert_allclose(out[0, 0, 0], centers, rtol=1e-3,
                                    atol=1e-2)
 
+    @pytest.mark.slow
     def test_multi_image_batch(self):
         rng = np.random.RandomState(5)
         feat = rng.randn(2, 2, 8, 8).astype(np.float32)
@@ -166,6 +168,7 @@ class TestYoloBox:
         assert (s >= 0).all() and (s <= 1).all()
 
 
+@pytest.mark.slow  # builds the full detector: full-suite tier
 def test_ppyoloe_predict_with_nms_end_to_end():
     """Workload #5 serving tail: predict -> class-aware NMS postprocess."""
     from paddle_tpu.vision.models.ppyoloe import PPYOLOE
