@@ -468,6 +468,11 @@ def convert_control_flow(fn: Callable) -> Callable:
         if conv is fn.__func__:
             return fn
         return types.MethodType(conv, fn.__self__)
+    # operate on the innermost function of a wraps-style decorator chain:
+    # its source carries the decorator lines, and its closure/globals are
+    # the ones the rewritten body must see (ADVICE r3 #5)
+    orig = fn
+    fn = inspect.unwrap(fn)
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         filename = inspect.getsourcefile(fn) or "<dy2static>"
@@ -476,15 +481,21 @@ def convert_control_flow(fn: Callable) -> Callable:
             f"dy2static: source of {getattr(fn, '__name__', fn)!r} is "
             "unavailable; data-dependent control flow will fail under jit",
             stacklevel=2)
-        return fn
+        return orig
     tree = ast.parse(src)
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        return fn
-    # drop decorators (to_static etc.) so exec doesn't re-apply them
-    fdef.decorator_list = []
+        return orig
     if _has(fdef.body, (ast.If, ast.While)) is None:
-        return fn  # nothing to rewrite
+        return orig  # nothing to rewrite
+    # Decorators are NEVER re-executed (re-exec'ing decorator source would
+    # re-run registration side effects, recurse through aliased to_static,
+    # and NameError on def-time-local arguments). Wrapper behavior from
+    # decorators BELOW the conversion entry is preserved instead by
+    # re-binding the live wrapper chain's closure cell onto the converted
+    # function after the rewrite — see the `orig is not fn` tail
+    # (ADVICE r3 #5).
+    fdef.decorator_list = []
     new_tree = _RewriteControlFlow(filename).visit(tree)
     ast.fix_missing_locations(new_tree)
     glb = dict(fn.__globals__)
@@ -517,4 +528,36 @@ def convert_control_flow(fn: Callable) -> Callable:
         loc = {}
         exec(code, glb, loc)
         new_fn = loc[fdef.name]
-    return functools.wraps(fn)(new_fn)
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__dy2st_source__ = fn
+    if orig is not fn:
+        # ``orig`` is a live wraps-style wrapper chain around ``fn`` (user
+        # decorators below the conversion entry). Preserve their per-call
+        # behavior by pointing the wrapper that calls ``fn`` at the
+        # converted function: find its closure cell holding ``fn`` and
+        # re-bind it. The converted body is semantically identical eagerly,
+        # so mutating the shared cell is safe. If no such cell exists (the
+        # decorator stashed ``fn`` somewhere opaque), warn — never drop
+        # silently (ADVICE r3 #5).
+        link = orig
+        while link is not None and link is not fn:
+            for cell in (getattr(link, "__closure__", None) or ()):
+                try:
+                    held = cell.cell_contents
+                except ValueError:   # empty cell
+                    continue
+                # match the raw fn OR a previous conversion of it, so
+                # converting the same decorated function twice stays
+                # idempotent instead of spuriously warning
+                if held is fn or getattr(held, "__dy2st_source__",
+                                         None) is fn:
+                    cell.cell_contents = new_fn
+                    return orig
+            link = getattr(link, "__wrapped__", None)
+        warnings.warn(
+            f"dy2static: {getattr(orig, '__name__', orig)!r} is wrapped by "
+            "a decorator whose reference to the original function cannot "
+            "be re-bound; the decorator's per-call behavior is dropped "
+            "from the converted path (the original object keeps it)",
+            stacklevel=2)
+    return new_fn

@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -512,33 +513,43 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
 
     use_kernel = _pallas_ok(qt, kt)
 
+    # seg ids are explicit custom_vjp arguments (NOT closure captures) so
+    # grad(jax.jit(fn)) works when cu_seqlens is traced — a closure-captured
+    # tracer escapes its trace and fails with "No constant handler for type
+    # DynamicJaxprTracer" (ADVICE r3 #3). Their cotangents are float0
+    # (integer-typed primals).
     @jax.custom_vjp
-    def run(qq, kk, vv):
-        out, _ = run_fwd(qq, kk, vv)
+    def run(qq, kk, vv, sq_ids, sk_ids):
+        out, _ = run_fwd(qq, kk, vv, sq_ids, sk_ids)
         return out
 
-    def run_fwd(qq, kk, vv):
+    def run_fwd(qq, kk, vv, sq_ids, sk_ids):
         if use_kernel:
             bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
             out, lse = _flash_fwd_pallas(qq, kk, vv, sc, causal, bq, bk,
-                                         seg_q=seg_qp, seg_k=seg_kp)
-            return out, (qq, kk, vv, out, lse)
-        return _varlen_ref(qq, kk, vv, seg_qp, seg_kp, sc, causal), \
-            (qq, kk, vv, None, None)
+                                         seg_q=sq_ids, seg_k=sk_ids)
+            return out, (qq, kk, vv, sq_ids, sk_ids, out, lse)
+        return _varlen_ref(qq, kk, vv, sq_ids, sk_ids, sc, causal), \
+            (qq, kk, vv, sq_ids, sk_ids, None, None)
 
     def run_bwd(res, g):
-        qq, kk, vv, out, lse = res
+        qq, kk, vv, sq_ids, sk_ids, out, lse = res
+        zq = np.zeros(sq_ids.shape, jax.dtypes.float0)
+        zk = np.zeros(sk_ids.shape, jax.dtypes.float0)
         if lse is not None:
             bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
-            return _flash_bwd_pallas(qq, kk, vv, out, lse, g, sc, causal,
-                                     bq, bk, seg_q=seg_qp, seg_k=seg_kp)
+            dq, dk, dv = _flash_bwd_pallas(qq, kk, vv, out, lse, g, sc,
+                                           causal, bq, bk, seg_q=sq_ids,
+                                           seg_k=sk_ids)
+            return dq, dk, dv, zq, zk
         _, vjp = jax.vjp(
-            lambda a, b, c: _varlen_ref(a, b, c, seg_qp, seg_kp, sc, causal),
+            lambda a, b, c: _varlen_ref(a, b, c, sq_ids, sk_ids, sc, causal),
             qq, kk, vv)
-        return vjp(g)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, zq, zk
 
     run.defvjp(run_fwd, run_bwd)
-    out = run(qt, kt, vt)                             # (H, Tq_pad, D)
+    out = run(qt, kt, vt, seg_qp, seg_kp)             # (H, Tq_pad, D)
     return jnp.moveaxis(out, 0, 1)[:tq]
 
 

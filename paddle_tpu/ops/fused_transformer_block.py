@@ -123,7 +123,11 @@ def _int8_mm(x, wq, w_scale, in_scale=None):
         amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
         xs = jnp.maximum(amax, 1e-6) / 127.0           # (..., 1)
     else:
-        xs = jnp.asarray(in_scale, jnp.float32)        # calibrated scalar
+        # Calibrated in_scale follows the reference convention: the scale is
+        # the max-abs RANGE (q = round(127*x/in_scale)), so the quantization
+        # STEP is in_scale/127 — a calibrated scale equal to the observed
+        # amax must reproduce the dynamic path exactly.
+        xs = jnp.asarray(in_scale, jnp.float32) / 127.0
     xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
     y = lax.dot_general(xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
                         preferred_element_type=jnp.int32)

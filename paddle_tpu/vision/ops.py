@@ -109,7 +109,17 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
               sampling_ratio: int = -1, aligned: bool = True, name=None):
     """paddle.vision.ops.roi_align: (N,C,H,W) features + per-image xyxy
     rois -> (total_rois, C, oh, ow) via bilinear sampling (reference phi
-    roi_align kernel:§0)."""
+    roi_align kernel:§0).
+
+    Deviation from the reference (documented per ADVICE r3 #4): with
+    ``sampling_ratio=-1`` the reference derives an adaptive per-RoI grid
+    (``ceil(roi_size / pooled_size)`` samples per bin), which is a
+    data-dependent shape XLA cannot compile statically. This implementation
+    uses a fixed 2×2 grid per bin instead — exact for RoIs up to 2× the
+    pooled size per bin and a bounded-error approximation for larger RoIs
+    (bilinear sampling at bin centers, tolerance-tested in
+    tests/test_vision_ops.py). Pass an explicit ``sampling_ratio`` to match
+    the reference on large RoIs."""
     if isinstance(output_size, int):
         oh = ow = output_size
     else:
@@ -247,12 +257,20 @@ def box_coder(prior_box, prior_box_var, target_box,
                 jnp.log(tw[:, None] / pw[None, :]),
                 jnp.log(th[:, None] / ph[None, :])], axis=-1)
             if var is not None:
-                out = out / var[None, :, :]
+                # (M, 4) var pairs rows with priors; a (4,) var applies to
+                # every prior (same handling as the decode branch)
+                out = out / (var[None, :, :] if var.ndim == 2 else var)
             return out
         # decode_center_size: target (N, M, 4) deltas over priors
         t = target
         if var is not None:
-            t = t * (var[None, :, :] if var.ndim == 2 else var)
+            if var.ndim == 2:
+                # var rows pair with priors, so they broadcast on the same
+                # dim the prior statistics use: dim 1 when axis==0, dim 0
+                # when axis==1 (ADVICE r3 #2).
+                t = t * (var[None, :, :] if axis == 0 else var[:, None, :])
+            else:
+                t = t * var
         if axis == 0:
             pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
                                     pcx[None, :], pcy[None, :])

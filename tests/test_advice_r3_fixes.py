@@ -1,0 +1,221 @@
+"""Regression tests for the round-3 ADVICE findings.
+
+Covers: int8 calibrated in_scale convention (fixed in
+test_quantization.py::test_calibrated_scale_convention_matches_dynamic),
+box_coder prior_box_var broadcast with axis=1, flash_attention_varlen
+composing with grad(jax.jit(fn)), roi_align sampling_ratio=-1 documented
+deviation tolerance, and dy2static decorator preservation.
+"""
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def test_box_coder_var_broadcast_axis1():
+    """ADVICE r3 #2: decode with prior_box_var and axis=1 must scale the
+    deltas with var rows paired to priors on dim 0 (same dim as the prior
+    statistics), not dim 1."""
+    rs = np.random.RandomState(0)
+    N, M = 3, 3  # N == M so the old bug was silent, not a shape error
+    prior = np.abs(rs.rand(N, 4).astype(np.float32)) + 0.5
+    prior[:, 2:] += prior[:, :2] + 0.5  # valid xyxy
+    var = np.abs(rs.rand(N, 4).astype(np.float32)) + 0.1
+    deltas = rs.randn(N, M, 4).astype(np.float32) * 0.1
+
+    got = np.asarray(vops.box_coder(
+        paddle.to_tensor(prior), paddle.to_tensor(var),
+        paddle.to_tensor(deltas), code_type="decode_center_size",
+        axis=1)._value)
+
+    # reference decode, axis=1: prior i pairs with row i of deltas
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    t = deltas * var[:, None, :]          # var follows priors on dim 0
+    ocx = t[..., 0] * pw[:, None] + pcx[:, None]
+    ocy = t[..., 1] * ph[:, None] + pcy[:, None]
+    ow = np.exp(t[..., 2]) * pw[:, None]
+    oh = np.exp(t[..., 3]) * ph[:, None]
+    ref = np.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                    ocx + ow * 0.5, ocy + oh * 0.5], axis=-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_varlen_flash_grad_of_jit():
+    """ADVICE r3 #3: grad(jax.jit(fn)) over flash_attention_varlen with
+    traced cu_seqlens must not fail with an escaped-tracer error."""
+    from paddle_tpu.ops.flash_attention import flash_attention_varlen
+
+    rs = np.random.RandomState(1)
+    T, H, D = 24, 2, 8
+    q = jnp.asarray(rs.randn(T, H, D).astype(np.float32))
+    k = jnp.asarray(rs.randn(T, H, D).astype(np.float32))
+    v = jnp.asarray(rs.randn(T, H, D).astype(np.float32))
+    cu = jnp.asarray([0, 10, 24], jnp.int32)
+
+    def loss(qq):
+        return flash_attention_varlen(qq, k, v, cu, cu).sum()
+
+    g_eager = jax.grad(loss)(q)
+    g_jit_of_grad = jax.jit(jax.grad(loss))(q)
+    g_grad_of_jit = jax.grad(jax.jit(loss))(q)   # the failing composition
+    np.testing.assert_allclose(np.asarray(g_jit_of_grad),
+                               np.asarray(g_eager), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_grad_of_jit),
+                               np.asarray(g_eager), rtol=1e-4, atol=1e-4)
+
+
+def test_roi_align_adaptive_ratio_tolerance():
+    """ADVICE r3 #4: sampling_ratio=-1 uses a fixed 2x2 grid (documented
+    deviation). For a large RoI the result must still track a dense
+    explicit-ratio reference within a loose tolerance."""
+    rs = np.random.RandomState(2)
+    # smooth feature map so coarse sampling stays close to dense sampling
+    base = rs.randn(1, 1, 4, 4).astype(np.float32)
+    import jax.image
+    feat = np.asarray(jax.image.resize(jnp.asarray(base), (1, 1, 32, 32),
+                                       "linear"))
+    boxes = np.array([[2.0, 2.0, 30.0, 30.0]], np.float32)  # 28x28 RoI
+    num = np.array([1], np.int32)
+    coarse = np.asarray(vops.roi_align(
+        paddle.to_tensor(feat), paddle.to_tensor(boxes),
+        paddle.to_tensor(num), output_size=7, sampling_ratio=-1)._value)
+    dense = np.asarray(vops.roi_align(
+        paddle.to_tensor(feat), paddle.to_tensor(boxes),
+        paddle.to_tensor(num), output_size=7, sampling_ratio=4)._value)
+    scale = np.abs(dense).max() + 1e-6
+    assert np.abs(coarse - dense).max() / scale < 0.15
+
+
+_DECO_CALLS = []
+
+
+def _counting(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        _DECO_CALLS.append(1)
+        return fn(*a, **kw)
+    return wrapper
+
+
+def test_dy2static_preserves_user_decorator():
+    """ADVICE r3 #5: a wraps-style user decorator on a to_static target
+    must still run on the compiled path (not be silently stripped). The
+    decorator lives at module scope so conversion can resolve and
+    re-apply it."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    @_counting
+    def f(x):
+        if (x.sum() > 0):
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    _DECO_CALLS.clear()
+    conv = convert_control_flow(f)
+    out = jax.jit(conv)(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert _DECO_CALLS, "user decorator was stripped from the compiled path"
+
+    # converting the SAME decorated function again must stay idempotent:
+    # no spurious warning, decorator still live
+    _DECO_CALLS.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        conv2 = convert_control_flow(f)
+    assert not any("re-bound" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+    out2 = conv2(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(out2), 2.0)
+    assert _DECO_CALLS, "decorator lost on second conversion"
+
+
+def test_dy2static_decorator_above_to_static_fires_once():
+    """A decorator ABOVE @to_static stays live in the caller's chain and
+    must not be re-applied to the compiled path (double-fire)."""
+    from paddle_tpu.jit import to_static
+
+    _DECO_CALLS.clear()
+
+    @_counting
+    @to_static
+    def f(x):
+        if (x.sum() > 0):
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    out = f(paddle.to_tensor(jnp.ones((3,))))
+    np.testing.assert_allclose(np.asarray(out._value), 2.0)
+    assert len(_DECO_CALLS) == 1, \
+        f"decorator above to_static fired {len(_DECO_CALLS)}x"
+
+
+def test_int8_encoder_calibrated_range_scales_end_to_end():
+    """FusedMultiTransformerInt8.from_float with range-convention
+    calibrated scales must track the float stack closely (the pre-fix
+    convention collapsed activations to a few int8 levels)."""
+    from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                        FusedMultiTransformerInt8)
+    paddle.seed(0)
+    m = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    rs = np.random.RandomState(0)
+    for plist in (m.qkv_weights, m.linear_weights, m.ffn1_weights,
+                  m.ffn2_weights):
+        for p in plist:
+            p._value = jnp.asarray(rs.randn(*p.shape) * 0.05, jnp.float32)
+    x = paddle.to_tensor(rs.randn(2, 8, 32).astype(np.float32))
+    ref = np.asarray(m(x)._value)
+    q = FusedMultiTransformerInt8.from_float(
+        m, qkv_in_scale=[3.0, 3.0], linear_in_scale=[3.0, 3.0],
+        ffn1_in_scale=[3.0, 3.0], ffn2_in_scale=[3.0, 3.0])
+    got = np.asarray(q(x)._value)
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.1, f"calibrated int8 encoder drifted: {err}"
+
+
+def _opaque_deco(fn):
+    """Wrapper that hides its reference to fn inside a list so the
+    conversion-time cell re-bind cannot find it."""
+    import functools
+    box = [fn]
+
+    def wrapper(*a, **kw):
+        return box[0](*a, **kw)
+    functools.update_wrapper(wrapper, fn)
+    return wrapper
+
+
+def test_dy2static_warns_when_wrapper_cannot_be_rebound():
+    """A wrapper whose reference to the original function can't be
+    re-bound loses its per-call behavior on the converted path — that must
+    raise a warning, never happen silently."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    @_opaque_deco
+    def g(x):
+        if (x.sum() > 0):
+            y = x * 2
+        else:
+            y = x * 3
+        return y
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        conv = convert_control_flow(g)
+        out = jax.jit(conv)(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert any("dropped" in str(x.message) for x in w), \
+        [str(x.message) for x in w]

@@ -206,3 +206,17 @@ class TestFusedMultiTransformerInt8:
         a = np.asarray(q_dyn(x)._value)
         b = np.asarray(q_cal(x)._value)
         assert not np.allclose(a, b, rtol=1e-3, atol=1e-3)
+
+    def test_calibrated_scale_convention_matches_dynamic(self):
+        """Reference convention (ADVICE r3 #1): in_scale is the max-abs
+        RANGE, q = round(127*x/in_scale). A calibrated scale equal to the
+        observed activation amax must reproduce the dynamic-amax path."""
+        from paddle_tpu.ops.fused_transformer_block import _int8_mm
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.randn(1, 32).astype(np.float32))
+        wq = jnp.asarray(rs.randint(-127, 128, (32, 16)), jnp.int8)
+        ws = jnp.asarray(np.abs(rs.randn(16)).astype(np.float32) * 0.01)
+        amax = float(jnp.max(jnp.abs(x)))
+        dyn = np.asarray(_int8_mm(x, wq, ws))
+        cal = np.asarray(_int8_mm(x, wq, ws, in_scale=amax))
+        np.testing.assert_allclose(cal, dyn, rtol=1e-6, atol=1e-6)
