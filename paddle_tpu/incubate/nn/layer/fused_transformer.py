@@ -151,8 +151,14 @@ class FusedMultiHeadAttention(Layer):
             (H,), default_initializer=I.Constant(1.0))
         self.ln_bias = self.create_parameter((H,), is_bias=True)
 
-    def forward(self, x, attn_mask=None, causal=True):
+    def forward(self, x, attn_mask=None, causal=True, seg_ids=None):
+        """``seg_ids`` (B, S) int32 enables the sequence-packed mode:
+        tokens attend only within their own segment (negative = padding),
+        via the segment-masked Pallas flash kernel — the encoder-packing
+        path the reference reaches through flash_attn_varlen glue
+        (paddle/phi/kernels/gpu/flash_attn_kernel.cu:§0)."""
         mask = attn_mask._value if hasattr(attn_mask, "_value") else attn_mask
+        seg = seg_ids._value if hasattr(seg_ids, "_value") else seg_ids
         nh = self.num_heads
         eps = self.epsilon
         pre = self.normalize_before
@@ -162,7 +168,8 @@ class FusedMultiHeadAttention(Layer):
             xn = ftb.layer_norm_array(xv, pls, plb, eps) if pre else xv
             qkv = xn @ qkvw + qkvb
             q, k, v = ftb._split_heads(qkv, nh)
-            attn = ftb._prefill_attention(q, k, v, mask, causal=causal)
+            attn = ftb._prefill_attention(q, k, v, mask, causal=causal,
+                                          seg_ids=seg)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h)
             y = xv + (attn @ ow + ob).astype(xv.dtype)
             if not pre:

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -61,11 +62,18 @@ class ErnieEmbeddings(Layer):
         self.layer_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        pos = (position_ids._value if hasattr(position_ids, "_value")
+               else position_ids)
+
         def fn(ids, tt, we, pe, te):
             s = ids.shape[-1]
+            if pos is None:
+                p = pe[None, :s]
+            else:
+                p = jnp.take(pe, pos.astype(jnp.int32), axis=0)
             return (jnp.take(we, ids.astype(jnp.int32), axis=0)
-                    + pe[None, :s]
+                    + p
                     + jnp.take(te, tt.astype(jnp.int32), axis=0))
 
         if token_type_ids is None:
@@ -90,8 +98,9 @@ class ErnieEncoderLayer(Layer):
             activation=config.activation, normalize_before=False,
             epsilon=config.layer_norm_epsilon)
 
-    def forward(self, x, attn_mask=None):
-        x = self.self_attn(x, attn_mask=attn_mask, causal=False)
+    def forward(self, x, attn_mask=None, seg_ids=None):
+        x = self.self_attn(x, attn_mask=attn_mask, causal=False,
+                           seg_ids=seg_ids)
         return self.ffn(x)
 
 
@@ -112,6 +121,21 @@ def _attention_mask_from_ids(input_ids, pad_token_id: int):
     return apply(fn, input_ids, op_name="ernie_attn_mask")
 
 
+def packed_position_ids(segment_ids):
+    """(B, S) segment ids -> (B, S) positions restarting at 0 per segment
+    (pads get 0). The packed-batch analogue of the reference's implicit
+    arange positions."""
+    def fn(seg):
+        s = seg.shape[-1]
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        is_start = jnp.concatenate(
+            [jnp.ones_like(seg[:, :1], bool),
+             seg[:, 1:] != seg[:, :-1]], axis=-1)
+        start = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=seg.ndim - 1)
+        return jnp.where(seg < 0, 0, idx - start).astype(jnp.int32)
+    return apply(fn, segment_ids, op_name="packed_position_ids")
+
+
 class ErnieModel(Layer):
     def __init__(self, config: ErnieConfig):
         super().__init__()
@@ -121,7 +145,22 @@ class ErnieModel(Layer):
                                   for _ in range(config.num_hidden_layers)])
         self.pooler = ErniePooler(config)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                segment_ids=None):
+        """``segment_ids`` (B, S) turns on sequence-packed mode: each row
+        holds several sequences back to back (negative ids = pad), tokens
+        attend only within their own segment via the segment-masked flash
+        kernel, and positions restart per segment. Mutually exclusive with
+        ``attention_mask``."""
+        if segment_ids is not None:
+            if attention_mask is not None:
+                raise ValueError(
+                    "segment_ids and attention_mask are mutually exclusive")
+            pos = packed_position_ids(segment_ids)
+            x = self.embeddings(input_ids, token_type_ids, position_ids=pos)
+            for layer in self.encoder:
+                x = layer(x, seg_ids=segment_ids)
+            return x, self.pooler(x)
         if attention_mask is None:
             attention_mask = _attention_mask_from_ids(
                 input_ids, self.config.pad_token_id)
@@ -155,16 +194,20 @@ class ErnieForMaskedLM(Layer):
                                       epsilon=config.layer_norm_epsilon)
         self.bias = self.create_parameter((config.vocab_size,), is_bias=True)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                segment_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask,
+                            segment_ids=segment_ids)
         h = self.transform_ln(F.gelu(self.transform(seq)))
         from ..core import math_ops as M
         return M.matmul(h, self.ernie.embeddings.word_embeddings,
                         transpose_y=True) + self.bias
 
-    def compute_loss(self, input_ids, labels, token_type_ids=None):
-        """labels: -100 at unmasked positions (ignore_index)."""
-        logits = self(input_ids, token_type_ids)
+    def compute_loss(self, input_ids, labels, token_type_ids=None,
+                     segment_ids=None):
+        """labels: -100 at unmasked positions (ignore_index). In packed
+        mode pass ``segment_ids`` and set labels=-100 at pads."""
+        logits = self(input_ids, token_type_ids, segment_ids=segment_ids)
         return F.cross_entropy(
             logits.reshape([-1, self.config.vocab_size]),
             labels.reshape([-1]), ignore_index=-100)

@@ -81,6 +81,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nkv,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if causal or kv_valid is not None or has_seg:
+            # exp(s - m) degenerates to 1 when EVERY entry of the block is
+            # masked (m == s == -inf); zero masked probabilities explicitly
+            # so fully-masked rows (in-row padding) produce 0, not mean(v)
+            p = jnp.where(keep, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -100,8 +105,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nkv,
         lse_ref[0, 0] = (m + jnp.log(safe_l))[:, 0]
 
 
+def _seg3(seg, bh):
+    """Normalize segment ids for the kernels: (S,) -> shared (1, 1, S);
+    (R, S) -> per-row (R, 1, S) with bh = R * rep heads per row. Returns
+    (array, row_of_bh) where row_of_bh maps grid index b -> seg row."""
+    if seg.ndim == 1:
+        return seg[None, None, :], (lambda b: 0)
+    rep = bh // seg.shape[0]
+    return seg[:, None, :], (lambda b: b // rep)
+
+
 def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk, seg_q=None, seg_k=None,
-                      kv_valid=None, causal_offset=0):
+                      kv_valid=None, causal_offset=0, interpret=False,
+                      kv_rep=1):
+    """``kv_rep`` implements GQA without materializing repeated KV: q has
+    B*Hq rows, k/v have B*Hk rows (Hq = Hk*kv_rep, heads consecutive per
+    batch entry), and the k/v BlockSpec index map shares each KV row across
+    its kv_rep query heads."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nkv = sq // bq, sk // bk
@@ -109,15 +129,19 @@ def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk, seg_q=None, seg_k=None,
     has_seg = seg_q is not None
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // kv_rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // kv_rep, j, 0)),
     ]
     args = [q, k, v]
     if has_seg:
-        # segment ids travel lane-major as (1, 1, S); shared by every bh
-        in_specs += [pl.BlockSpec((1, 1, bq), lambda b, i, j: (0, 0, i)),
-                     pl.BlockSpec((1, 1, bk), lambda b, i, j: (0, 0, j))]
-        args += [seg_q[None, None, :], seg_k[None, None, :]]
+        # segment ids travel lane-major as (R, 1, S): one row shared by
+        # every bh (packed varlen) or one per batch row (packed batches)
+        sq3, rowq = _seg3(seg_q, bh)
+        sk3, rowk = _seg3(seg_k, bh)
+        in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (rowq(b), 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (rowk(b), 0, j))]
+        args += [sq3, sk3]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nkv=nkv, has_seg=has_seg,
@@ -137,6 +161,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, bq, bk, seg_q=None, seg_k=None,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+        interpret=interpret,
     )(*args)
     return out, lse[:, 0]
 
@@ -253,7 +278,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
-                      seg_q=None, seg_k=None, kv_valid=None, causal_offset=0):
+                      seg_q=None, seg_k=None, kv_valid=None, causal_offset=0,
+                      interpret=False, kv_rep=1):
+    """With ``kv_rep`` > 1 (GQA), k/v carry B*Hk rows shared across query
+    heads via index maps; dk/dv are reduced over each KV row's kv_rep query
+    heads before returning, so the caller always gets KV-shaped grads."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nkv = sq // bq, sk // bk
@@ -265,18 +294,19 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
 
     dq_in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // kv_rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // kv_rep, j, 0)),
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
     ]
     dq_args = [q, k, v, g, lse3, delta]
     if has_seg:
-        sq3 = seg_q[None, None, :]
-        sk3 = seg_k[None, None, :]
-        dq_in_specs += [pl.BlockSpec((1, 1, bq), lambda b, i, j: (0, 0, i)),
-                        pl.BlockSpec((1, 1, bk), lambda b, i, j: (0, 0, j))]
+        sq3, rowq = _seg3(seg_q, bh)
+        sk3, rowk = _seg3(seg_k, bh)
+        dq_in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (rowq(b), 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (rowk(b), 0, j))]
         dq_args += [sq3, sk3]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -287,20 +317,22 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
     )(*dq_args)
 
     dkv_in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // kv_rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // kv_rep, j, 0)),
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
     ]
     dkv_args = [q, k, v, g, lse3, delta]
     if has_seg:
-        dkv_in_specs += [pl.BlockSpec((1, 1, bq), lambda b, j, i: (0, 0, i)),
-                         pl.BlockSpec((1, 1, bk), lambda b, j, i: (0, 0, j))]
+        dkv_in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (rowq(b), 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (rowk(b), 0, j))]
         dkv_args += [sq3, sk3]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -320,7 +352,13 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, bq, bk,
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
+        interpret=interpret,
     )(*dkv_args)
+    if kv_rep != 1:
+        # per-query-head dk/dv partials -> reduce over each KV row's group
+        # (consecutive q heads share a KV head)
+        dk = dk.reshape(bh // kv_rep, kv_rep, sk, d).sum(axis=1)
+        dv = dv.reshape(bh // kv_rep, kv_rep, sk, d).sum(axis=1)
     return dq, dk, dv
 
 
@@ -361,7 +399,13 @@ def _flash_bhsd_inner(q, k, v, scale, causal, kv_valid, causal_offset):
 
 def _pallas_ok(q, k):
     bq, bk = _pick_blocks(q.shape[1], k.shape[1])
-    return use_pallas() and bq is not None and bk is not None and _mult(q.shape[2], 128)
+    # d=64 compiles cleanly under Mosaic (verified on chip: fwd+bwd parity
+    # 4e-3 bf16) — required for the encoder family, whose hd = 1024/16 =
+    # 64. Other non-128 multiples (192, 320, ...) stay on the fallback
+    # until verified.
+    d = q.shape[2]
+    return use_pallas() and bq is not None and bk is not None and \
+        (_mult(d, 128) or d == 64)
 
 
 def _fa_fwd(q, k, v, scale, causal, kv_valid, causal_offset):
@@ -551,6 +595,98 @@ def flash_attention_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
     run.defvjp(run_fwd, run_bwd)
     out = run(qt, kt, vt, seg_qp, seg_kp)             # (H, Tq_pad, D)
     return jnp.moveaxis(out, 0, 1)[:tq]
+
+
+def _seg_ref_batched(q, k, v, seg, scale, causal):
+    """(B, nh, S, D) reference path with per-row segment mask."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    keep = seg[:, None, :, None] == seg[:, None, None, :]
+    keep &= (seg >= 0)[:, None, :, None]     # pads attend to nothing
+    if causal:
+        sq = q.shape[2]
+        keep &= (jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+                 )[None, None]
+    s = jnp.where(keep, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    any_keep = jnp.any(keep, axis=-1)
+    p = jnp.where(any_keep[..., None], p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention_segmented(q, k, v, seg_ids, scale=None, causal=False):
+    """Sequence-packed batched attention: (B, nh, S, D) q/k/v with per-row
+    segment ids (B, S) — tokens attend only within their own segment
+    (negative ids = padding, attend to nothing). The TPU-native encoder
+    packing path (reference: varlen glue in
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu:§0 feeding
+    fused_multi_transformer's packed ERNIE pretraining batches): one
+    Pallas flash invocation over the whole batch, segment mask applied
+    in-kernel — no (B, H, S, S) score materialization, no per-sequence
+    padding beyond the row length.
+    """
+    b, nh, s, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    ps = _pad_to(s)
+    seg = jnp.asarray(seg_ids, jnp.int32)
+    if ps != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, ps - s), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, ps - s), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, ps - s), (0, 0)))
+        seg = jnp.pad(seg, ((0, 0), (0, ps - s)), constant_values=-1)
+    # padded-query rows must never match padded keys: distinct ids per side
+    seg_q = jnp.where(seg < 0, -1, seg)
+    seg_k = jnp.where(seg < 0, -2, seg)
+    qf = q.reshape(b * nh, ps, d)
+    kf = k.reshape(b * nh, ps, d)
+    vf = v.reshape(b * nh, ps, d)
+    use_kernel = _pallas_ok(qf, kf)
+
+    @jax.custom_vjp
+    def run(qq, kk, vv, sq_ids, sk_ids):
+        out, _ = run_fwd(qq, kk, vv, sq_ids, sk_ids)
+        return out
+
+    def run_fwd(qq, kk, vv, sq_ids, sk_ids):
+        if use_kernel:
+            bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
+            out, lse = _flash_fwd_pallas(qq, kk, vv, sc, causal, bq, bk,
+                                         seg_q=sq_ids, seg_k=sk_ids)
+            return out, (qq, kk, vv, sq_ids, sk_ids, out, lse)
+        ref = _seg_ref_batched(qq.reshape(b, nh, ps, d),
+                               kk.reshape(b, nh, ps, d),
+                               vv.reshape(b, nh, ps, d),
+                               jnp.where(sq_ids < 0, -1, sq_ids), sc, causal)
+        return ref.reshape(b * nh, ps, d), \
+            (qq, kk, vv, sq_ids, sk_ids, None, None)
+
+    def run_bwd(res, g):
+        qq, kk, vv, sq_ids, sk_ids, out, lse = res
+        zq = np.zeros(sq_ids.shape, jax.dtypes.float0)
+        zk = np.zeros(sk_ids.shape, jax.dtypes.float0)
+        if lse is not None:
+            bq, bk = _pick_blocks(qq.shape[1], kk.shape[1])
+            dq, dk, dv = _flash_bwd_pallas(qq, kk, vv, out, lse, g, sc,
+                                           causal, bq, bk, seg_q=sq_ids,
+                                           seg_k=sk_ids)
+            return dq, dk, dv, zq, zk
+
+        def ref_flat(a, bb, c):
+            r = _seg_ref_batched(a.reshape(b, nh, ps, d),
+                                 bb.reshape(b, nh, ps, d),
+                                 c.reshape(b, nh, ps, d),
+                                 jnp.where(sq_ids < 0, -1, sq_ids), sc,
+                                 causal)
+            return r.reshape(b * nh, ps, d)
+
+        _, vjp = jax.vjp(ref_flat, qq, kk, vv)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, zq, zk
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(qf, kf, vf, seg_q, seg_k)
+    return out.reshape(b, nh, ps, d)[:, :, :s]
 
 
 # ===========================================================================

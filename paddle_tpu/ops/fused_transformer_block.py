@@ -66,9 +66,14 @@ def _split_heads(qkv, num_heads):
     return q, k, v
 
 
-def _prefill_attention(q, k, v, attn_mask, causal=True):
+def _prefill_attention(q, k, v, attn_mask, causal=True, seg_ids=None):
     b, nh, s, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
+    if seg_ids is not None:
+        if attn_mask is not None:
+            raise ValueError("seg_ids and attn_mask are mutually exclusive")
+        return fa.flash_attention_segmented(q, k, v, seg_ids, scale=scale,
+                                            causal=causal)
     if attn_mask is None:
         out = fa.flash_attention_bhsd(
             q.reshape(b * nh, s, hd), k.reshape(b * nh, s, hd),

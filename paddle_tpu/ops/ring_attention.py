@@ -1,18 +1,27 @@
-"""Ring attention — blockwise attention with KV rotation over a context
-(``sep``) mesh axis.
+"""Ring attention — blockwise flash attention with KV rotation over a
+context (``sep``) mesh axis.
 
 Rebuild of the reference's ring-flash-attention layer (model-zoo
 ring_flash_attention.py consuming core sep groups + batch_isend_irecv —
-SURVEY.md §5.7 mechanism 3), designed TPU-first: the KV block rotates around
-the ICI ring via ``lax.ppermute`` (XLA double-buffers the permute against the
-block computation), and per-block results merge with online-softmax (log-sum-
-exp) rescaling, so memory stays O(S_local) per device while attending to the
-full sequence. Complements the Ulysses all_to_all variant (models/llama.py);
-pick per config (`sep_mode`).
+SURVEY.md §5.7 mechanism 3), designed TPU-first:
 
-Causality uses *global* positions: device i holds contiguous chunk i, so a KV
-block that originated at chunk j is fully visible when j < i, causal when
-j == i, and fully masked when j > i.
+* the KV block rotates around the ICI ring via ``lax.ppermute`` (XLA
+  double-buffers the permute against the block computation);
+* each ring step's inner block runs the **Pallas flash kernel**
+  (``_flash_fwd_pallas``) — no (B, H, S_local, S_local) score
+  materialization, GQA KV heads shared through kernel index maps
+  (``kv_rep``) instead of ``jnp.repeat``;
+* per-block results merge with online-softmax (log-sum-exp) rescaling, so
+  memory stays O(S_local) per device while attending to the full sequence;
+* the ring is a ``lax.scan`` (compile size independent of the sep degree)
+  with a **custom VJP**: the backward replays the ring, recomputing each
+  block's probabilities from the saved global LSE (flash-style recompute —
+  activations are never stored per block) while dK/dV partials travel
+  around the ring with their KV chunk and arrive home after a full cycle.
+
+Causality uses *global* positions: device i holds contiguous chunk i, so a
+KV block that originated at chunk j is fully visible when j < i, causal
+when j == i, and fully masked when j > i.
 """
 
 from __future__ import annotations
@@ -21,35 +30,87 @@ import math
 from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
+from ._common import use_pallas
 from ..core.dispatch import apply
 from ..parallel import mesh as _mesh
+from . import flash_attention as fa
 
 _NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, bias, scale):
-    """(B,H,Sq,D)x(B,H,Sk,D) -> normalized out (B,H,Sq,D), lse (B,H,Sq).
-    fp32 softmax accumulation; bias is additive (0 / -inf mask)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    s = s + bias
-    m = jnp.max(s, axis=-1)
-    # fully-masked rows: keep m finite so exp() stays 0 without NaNs
+# ===========================================================================
+# Inner block: (BHq, S, D) x (BHk, S, D) -> normalized out + lse
+# ===========================================================================
+def _block_ref(q3, k3, v3, scale, causal_blk, kv_rep):
+    """XLA fallback with the same contract as the kernel: q3 (B*Hq, S, D),
+    k3/v3 (B*Hk, S, D); GQA via reshape-grouping, not repeat."""
+    bhq, s, d = q3.shape
+    bhk = k3.shape[0]
+    qg = q3.reshape(bhk, kv_rep, s, d)
+    sc = jnp.einsum("grsd,gtd->grst", qg.astype(jnp.float32),
+                    k3.astype(jnp.float32)) * scale
+    if causal_blk:
+        keep = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        sc = jnp.where(keep[None, None], sc, _NEG_INF)
+    m = jnp.max(sc, axis=-1)
     m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
-    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.exp(sc - m_safe[..., None])
+    if causal_blk:
+        p = jnp.where(keep[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    # floor keeps 1/l^2 in the divide's gradient finite in fp32 (a 1e-30
-    # floor overflows to inf and poisons the backward with 0*inf NaNs)
+    out = jnp.einsum("grst,gtd->grsd", p, v3.astype(jnp.float32))
     l_safe = jnp.maximum(l, 1e-12)
     lse = jnp.where(l > 0, m_safe + jnp.log(l_safe), _NEG_INF)
-    out = out / l_safe[..., None]
-    return out, lse
+    return (out / l_safe[..., None]).reshape(bhq, s, d), \
+        lse.reshape(bhq, s)
+
+
+def _block_fwd(q3, k3, v3, scale, causal_blk, kv_rep):
+    if fa._pallas_ok(q3, k3):
+        bq, bk = fa._pick_blocks(q3.shape[1], k3.shape[1])
+        out, lse = fa._flash_fwd_pallas(q3, k3, v3, scale, causal_blk,
+                                        bq, bk, kv_rep=kv_rep)
+        return out.astype(jnp.float32), lse
+    return _block_ref(q3, k3, v3, scale, causal_blk, kv_rep)
+
+
+def _block_bwd(q3, k3, v3, out, lse, g, scale, causal_blk, kv_rep):
+    """Per-block grads of the GLOBAL softmax: p = exp(s - lse_global).
+    Returns (dq, dk, dv) with dk/dv already reduced to KV heads."""
+    if fa._pallas_ok(q3, k3):
+        bq, bk = fa._pick_blocks(q3.shape[1], k3.shape[1])
+        return fa._flash_bwd_pallas(q3, k3, v3, out, lse, g, scale,
+                                    causal_blk, bq, bk, kv_rep=kv_rep)
+    bhq, s, d = q3.shape
+    bhk = k3.shape[0]
+    qg = q3.reshape(bhk, kv_rep, s, d).astype(jnp.float32)
+    gg = g.reshape(bhk, kv_rep, s, d).astype(jnp.float32)
+    og = out.reshape(bhk, kv_rep, s, d).astype(jnp.float32)
+    lseg = lse.reshape(bhk, kv_rep, s)
+    k32 = k3.astype(jnp.float32)
+    v32 = v3.astype(jnp.float32)
+    sc = jnp.einsum("grsd,gtd->grst", qg, k32) * scale
+    if causal_blk:
+        keep = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :])[None, None]
+        sc = jnp.where(keep, sc, _NEG_INF)
+    p = jnp.exp(sc - lseg[..., None])
+    if causal_blk:
+        p = jnp.where(keep, p, 0.0)
+    delta = jnp.sum(gg * og, axis=-1)
+    dv = jnp.einsum("grst,grsd->gtd", p, gg)
+    dp = jnp.einsum("grsd,gtd->grst", gg, v32)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("grst,gtd->grsd", ds, k32) * scale
+    dk = jnp.einsum("grst,grsd->gtd", ds, qg) * scale
+    return (dq.reshape(bhq, s, d).astype(q3.dtype),
+            dk.astype(k3.dtype), dv.astype(v3.dtype))
 
 
 def _merge(out1, lse1, out2, lse2):
@@ -62,66 +123,124 @@ def _merge(out1, lse1, out2, lse2):
     return out1 * w1[..., None] + out2 * w2[..., None], lse_new
 
 
+# ===========================================================================
+# The ring (per-device program, runs inside shard_map)
+# ===========================================================================
 def ring_attention_array(q, k, v, axis_name: str, causal: bool = True,
                          scale: Optional[float] = None):
     """Per-device blockwise ring attention, called inside shard_map.
 
     q, k, v: (B, S_local, H, D) paddle layout (GQA: H_kv may divide H).
-    Returns (B, S_local, H, D).
+    Returns (B, S_local, H, D). Differentiable via a ring-replay custom
+    VJP; per-device live memory is O(S_local) in both passes.
     """
     b, s_loc, hq, d = q.shape
     hk = k.shape[2]
     rep = hq // hk
-    if scale is None:
-        scale = 1.0 / math.sqrt(d)
-
-    # (B, H, S, D) internal layout; KV rotates with its ORIGINAL hk heads —
-    # the GQA head repeat happens per-round after the permute, so ring ICI
-    # traffic is not inflated by hq/hk
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
     p_size = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
 
-    q_pos = my * s_loc + jnp.arange(s_loc)
-    acc = jnp.zeros((b, hq, s_loc, d), jnp.float32)
-    lse = jnp.full((b, hq, s_loc), _NEG_INF, jnp.float32)
+    # flattened internal layout: q (B*Hq, S, D); k/v (B*Hk, S, D)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * hq, s_loc, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * hk, s_loc, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * hk, s_loc, d)
 
-    kv = (kt, vt)
-    for r in range(p_size):
-        src = (my - r) % p_size  # chunk id currently held
+    # NOTE: lax.axis_index is evaluated INSIDE each custom_vjp function —
+    # a closure-captured index tracer escapes its trace under
+    # jit(grad(shard_map(...))) (UnexpectedTracerError in the dryrun)
 
-        def compute(kv_pair):
-            kr, vr = kv_pair
-            if rep != 1:
-                kr = jnp.repeat(kr, rep, axis=1)
-                vr = jnp.repeat(vr, rep, axis=1)
-            if causal:
-                k_pos = src * s_loc + jnp.arange(s_loc)
-                bias = jnp.where(k_pos[None, :] <= q_pos[:, None],
-                                 0.0, _NEG_INF)[None, None]
-            else:
-                bias = jnp.zeros((1, 1, s_loc, s_loc), jnp.float32)
-            return _block_attn(qt, kr, vr, bias, scale)
+    def block_cases(my, src, qq, kr, vr):
+        """(out, lse) for the chunk currently held, by visibility case."""
+        def full(_):
+            return _block_fwd(qq, kr, vr, sc, False, rep)
 
-        def skip(kv_pair):
-            return (jnp.zeros((b, hq, s_loc, d), jnp.float32),
-                    jnp.full((b, hq, s_loc), _NEG_INF, jnp.float32))
+        def diag(_):
+            return _block_fwd(qq, kr, vr, sc, True, rep)
 
-        if causal:
-            # chunks strictly ahead of this device are fully masked: skip
-            # both matmuls (their result is all-zero / -inf anyway)
-            out_r, lse_r = lax.cond(src > my, skip, compute, kv)
-        else:
-            out_r, lse_r = compute(kv)
+        def skip(_):
+            return (jnp.zeros((b * hq, s_loc, d), jnp.float32),
+                    jnp.full((b * hq, s_loc), _NEG_INF, jnp.float32))
+
+        if not causal:
+            return full(None)
+        idx = jnp.where(src > my, 0, jnp.where(src == my, 1, 2))
+        return lax.switch(idx, [skip, diag, full], None)
+
+    @jax.custom_vjp
+    def ring(qq, kk, vv):
+        out, _ = ring_fwd(qq, kk, vv)
+        return out
+
+    def ring_fwd(qq, kk, vv):
+        my = lax.axis_index(axis_name)
+
+        def step(carry, r):
+            acc, lse, kr, vr = carry
+            src = (my - r) % p_size
+            out_r, lse_r = block_cases(my, src, qq, kr, vr)
+            acc, lse = _merge(acc, lse, out_r, lse_r)
+            kr, vr = (lax.ppermute(t, axis_name, perm) for t in (kr, vr))
+            return (acc, lse, kr, vr), None
+
+        init = (jnp.zeros((b * hq, s_loc, d), jnp.float32),
+                jnp.full((b * hq, s_loc), _NEG_INF, jnp.float32),
+                kk, vv)
+        # scan p_size-1 steps, fold the LAST block outside: its trailing
+        # ppermute would be dead work (the backward, by contrast, needs the
+        # full cycle to bring dK/dV home)
+        (acc, lse, kr, vr), _ = lax.scan(step, init,
+                                         jnp.arange(p_size - 1))
+        last = p_size - 1
+        out_r, lse_r = block_cases(my, (my - last) % p_size, qq, kr, vr)
         acc, lse = _merge(acc, lse, out_r, lse_r)
-        if r + 1 < p_size:
-            kv = tuple(lax.ppermute(t, axis_name, perm) for t in kv)
+        out = acc.astype(qq.dtype)
+        return out, (qq, kk, vv, out, lse)
 
-    return acc.transpose(0, 2, 1, 3).astype(q.dtype)
+    def ring_bwd(res, g):
+        qq, kk, vv, out, lse = res
+        g = g.astype(qq.dtype)
+        my = lax.axis_index(axis_name)
+
+        def step(carry, r):
+            dq, kr, vr, dkr, dvr = carry
+            src = (my - r) % p_size
+
+            def full(_):
+                return _block_bwd(qq, kr, vr, out, lse, g, sc, False, rep)
+
+            def diag(_):
+                return _block_bwd(qq, kr, vr, out, lse, g, sc, True, rep)
+
+            def skip(_):
+                return (jnp.zeros_like(qq), jnp.zeros_like(kr),
+                        jnp.zeros_like(vr))
+
+            if causal:
+                idx = jnp.where(src > my, 0, jnp.where(src == my, 1, 2))
+                dq_r, dk_r, dv_r = lax.switch(idx, [skip, diag, full], None)
+            else:
+                dq_r, dk_r, dv_r = full(None)
+            dq = dq + dq_r.astype(jnp.float32)
+            # dK/dV partials travel WITH their KV chunk: after the full
+            # cycle each chunk is home with every device's contribution
+            dkr = dkr + dk_r.astype(jnp.float32)
+            dvr = dvr + dv_r.astype(jnp.float32)
+            kr, vr, dkr, dvr = (lax.ppermute(t, axis_name, perm)
+                                for t in (kr, vr, dkr, dvr))
+            return (dq, kr, vr, dkr, dvr), None
+
+        init = (jnp.zeros((b * hq, s_loc, d), jnp.float32), kk, vv,
+                jnp.zeros((b * hk, s_loc, d), jnp.float32),
+                jnp.zeros((b * hk, s_loc, d), jnp.float32))
+        (dq, _, _, dk, dv), _ = lax.scan(step, init, jnp.arange(p_size))
+        return (dq.astype(qq.dtype), dk.astype(kk.dtype),
+                dv.astype(vv.dtype))
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    out = ring(q3, k3, v3)
+    return out.reshape(b, hq, s_loc, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ring_flash_attention(query, key, value, group=None, causal: bool = True,
@@ -135,7 +254,6 @@ def ring_flash_attention(query, key, value, group=None, causal: bool = True,
 
     def fn(qv, kv, vv):
         if deg <= 1:
-            from . import flash_attention as fa
             return fa._sdpa_array(qv, kv, vv, scale=scale or
                                   1.0 / math.sqrt(qv.shape[-1]), causal=causal)
         prog = shard_map(
