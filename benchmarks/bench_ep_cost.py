@@ -1,0 +1,121 @@
+"""Expert-parallel path cost characterization (VERDICT r4 item 3):
+compile the all_to_all EP MoE FFN (fwd + bwd) on the 8-virtual-device CPU
+mesh and report the compiled HLO's collective volume — bytes moved per
+device per step by all-to-all (dispatch/return and their transposes) and
+any other collectives. Single-chip hardware cannot time the EP path; this
+makes its cost visible (on a pod the same program's all_to_all rides ICI).
+
+Run: python benchmarks/bench_ep_cost.py   (forces the 8-device CPU mesh)
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(shape_str):
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shape_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for x in dims.split(","):
+            if x:
+                n *= int(x)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def collective_volume(hlo_text):
+    """Per-collective-kind byte volume: sum of RESULT shapes of each
+    collective instruction (per-replica program => per-device bytes)."""
+    kinds = ("all-to-all", "all-reduce", "all-gather", "reduce-scatter",
+             "collective-permute")
+    agg = {k: {"count": 0, "bytes": 0} for k in kinds}
+    pat = re.compile(
+        r"=\s*((?:\([^)]*\)|\S+))\s+(all-to-all|all-reduce|all-gather"
+        r"|reduce-scatter|collective-permute)(?:-start)?\(")
+    for line in hlo_text.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        agg[m.group(2)]["count"] += 1
+        agg[m.group(2)]["bytes"] += _shape_bytes(m.group(1))
+    return agg
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from functools import partial
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.ops import moe_ops
+    from jax.sharding import Mesh
+
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+
+    # per-device config mirroring the bench workload's layer shape
+    T_local, d_model, ff = 1024, 1024, 4096
+    E = 8
+    topk = 2
+    capacity = int(np.ceil(1.2 * topk * T_local / E))
+
+    def per_device(x, gl, w1, w2):
+        y = moe_ops.expert_parallel_ffn(x, gl, w1, w2, "expert", E,
+                                        capacity, topk=topk)
+        return jnp.sum(y.astype(jnp.float32))
+
+    prog = shard_map(per_device, mesh=mesh,
+                     in_specs=(P("expert"), P("expert"), P("expert"),
+                               P("expert")),
+                     out_specs=P(), check_vma=False)
+
+    def loss(x, gl, w1, w2):
+        return prog(x, gl, w1, w2) / n
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n * T_local, d_model).astype(np.float32))
+    gl = jnp.asarray(rng.randn(n * T_local, E).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(E, d_model, ff).astype(np.float32) * 0.02)
+    w2 = jnp.asarray(rng.randn(E, ff, d_model).astype(np.float32) * 0.02)
+
+    f = jax.jit(jax.grad(loss, argnums=(0, 2, 3)))
+    text = "\n".join(m.to_string() for m in
+                     f.lower(x, gl, w1, w2).compile()
+                     .runtime_executable().hlo_modules())
+    agg = collective_volume(text)
+    out = {"metric": "ep_alltoall_cost",
+           "config": {"mesh_expert": n, "tokens_per_device": T_local,
+                      "d_model": d_model, "ff": ff, "experts": E,
+                      "topk": topk, "capacity": capacity},
+           "collectives_per_device_per_layer_step(fwd+bwd)": {
+               k: {"count": v["count"],
+                   "mbytes": round(v["bytes"] / 1e6, 2)}
+               for k, v in agg.items() if v["count"]},
+           "analytic_a2a_mbytes": round(
+               4 * E * capacity * d_model * 4 / 1e6, 2),
+           "note": "result-shape bytes per device; fwd dispatch+return "
+                   "a2a plus their backward transposes = 4 x (E,C,d)"}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

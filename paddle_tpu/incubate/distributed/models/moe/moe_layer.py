@@ -183,38 +183,38 @@ class MoELayer(Layer):
             out = self._forward_expert_parallel(xf, idx, probs, capacity)
             return pm.reshape(out, orig_shape)
 
-        # index-based dispatch (round 3): the dense (N,E,C) one-hot einsums
-        # cost O(N·E·C·d) — at training scale far more FLOPs than the
-        # experts themselves. Scatter tokens into their (expert, slot)
-        # positions and gather back instead; routing stays identical
-        # (dispatch_indices_topk shares dispatch_masks_topk's joint
-        # capacity ordering — parity-tested in test_moe).
+        # gather-based dispatch (round 4): the dense (N,E,C) one-hot
+        # einsums cost O(N·E·C·d); the round-3 index dispatch removed that
+        # but SCATTERED the (N,d) activations into slots — a measured +8%
+        # step-time regression on TPU. With the inverse slot->token map
+        # (one N-element int32 scatter) every float movement in dispatch,
+        # combine AND their gradients is a gather — the fast path on TPU.
+        # Routing is unchanged (dispatch_indices_topk keeps
+        # dispatch_masks_topk's joint capacity ordering — parity-tested in
+        # test_moe).
         from .....core.dispatch import apply as _apply
 
         routes = moe_ops.dispatch_indices_topk(idx, self.num_expert,
                                                capacity)
-        route_args = []
-        for flat, ok in routes:
-            route_args += [Tensor(flat), Tensor(ok)]
         E, C = self.num_expert, capacity
+        tfs, cfs, flats, oks = moe_ops.dispatch_plan(routes, E, C, n)
+        plan = [Tensor(tfs), Tensor(cfs), Tensor(flats), Tensor(oks)]
 
-        def fn_dispatch(xv, *rs):
-            rts = [(rs[i], rs[i + 1]) for i in range(0, len(rs), 2)]
-            return moe_ops.moe_dispatch_indices(xv, rts, E, C)
+        def fn_dispatch(xv, t, fl, ok):
+            return moe_ops.moe_dispatch_gather(xv, t, fl, ok, E, C)
 
-        expert_in = _apply(fn_dispatch, xf, *route_args,
-                           op_name="moe_dispatch")
+        expert_in = _apply(fn_dispatch, xf, Tensor(tfs), Tensor(flats),
+                           Tensor(oks), op_name="moe_dispatch")
 
         # run experts on their capacity slots (static python loop: E is small
         # and each expert owns distinct parameters)
         outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
         expert_out = pm.stack(outs, axis=0)  # (E, C, d)
 
-        def fn_combine(eo, pv, *rs):
-            rts = [(rs[i], rs[i + 1]) for i in range(0, len(rs), 2)]
-            return moe_ops.moe_combine_indices(eo, rts, pv)
+        def fn_combine(eo, pv, t, c, fl, ok):
+            return moe_ops.moe_combine_gather(eo, pv, fl, ok, t, c)
 
-        out = _apply(fn_combine, expert_out, probs, *route_args,
+        out = _apply(fn_combine, expert_out, probs, *plan,
                      op_name="moe_combine")
         return pm.reshape(out, orig_shape)
 

@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def number_count(gate_idx, upper_range: int):
@@ -158,13 +159,15 @@ def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
     if act is None:
         act = jax.nn.gelu
 
-    # round 3: index-based dispatch (O(N·d) scatter) builds the same dense
-    # (E, C, d) slot layout the all_to_all needs, without the (N,E,C)
-    # one-hot einsum
+    # round 4: gather-based dispatch builds the same dense (E, C, d) slot
+    # layout the all_to_all needs; all float movement is gathers (see
+    # dispatch_plan)
     routes = dispatch_indices_topk(gate_idx_local, num_experts, capacity)
     in_dtype = x_local.dtype
-    slots = moe_dispatch_indices(x_local.astype(jnp.float32), routes,
-                                 num_experts, capacity)   # (E, C, d)
+    tfs, cfs, flats, oks = dispatch_plan(routes, num_experts, capacity,
+                                         x_local.shape[0])
+    slots = moe_dispatch_gather(x_local.astype(jnp.float32), tfs, flats,
+                                oks, num_experts, capacity)   # (E, C, d)
 
     d_model = x_local.shape[-1]
     z = slots.reshape(n, e_local, capacity, d_model)
@@ -184,8 +187,8 @@ def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
     y = jnp.swapaxes(y.reshape(e_local, n, capacity, d_model), 0, 1)
     y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
     y = y.reshape(num_experts, capacity, d_model)
-    return moe_combine_indices(y.astype(jnp.float32), routes,
-                               gate_prob_local).astype(in_dtype)
+    return moe_combine_gather(y.astype(jnp.float32), gate_prob_local,
+                              flats, oks, tfs, cfs).astype(in_dtype)
 
 
 def expert_parallel_ffn(x_local, gate_logits_local, w1_local, w2_local,
@@ -256,6 +259,119 @@ def moe_dispatch_indices(x, routes, num_experts: int, capacity: int):
         out = out.at[jnp.where(ok, flat, 0)].add(
             jnp.where(ok[:, None], x, jnp.zeros_like(x)))
     return out.reshape(num_experts, capacity, x.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# Gather-based dispatch (round 4): the round-3 index dispatch scatters the
+# full (N, d) activations into slots — TPU scatter of d-wide rows is the
+# measured +8% step-time regression (BASELINE.md moe row). Both dispatch
+# AND its gradient are expressible as gathers once the inverse slot->token
+# map exists, and that map costs one N-element int32 scatter. custom_vjp
+# keeps every float movement a gather (the fast path on TPU), mirroring
+# what the reference's global_scatter CUDA kernel achieves with direct
+# addressed writes (global_scatter_op:§0).
+# ---------------------------------------------------------------------------
+def dispatch_plan(routes, num_experts: int, capacity: int, n_tokens: int):
+    """Invert routes into the full gather plan. Returns
+    token_for_slot (E*C,) int32 (-1 = empty slot),
+    choice_for_slot (E*C,) int32 (which top-k choice filled it),
+    flats (N, K) int32 and oks (N, K) bool (the routes, stacked)."""
+    ec = num_experts * capacity
+    tfs = jnp.full((ec + 1,), -1, jnp.int32)     # +1 sentinel dump slot
+    cfs = jnp.zeros((ec + 1,), jnp.int32)
+    tok = jnp.arange(n_tokens, dtype=jnp.int32)
+    for k, (flat, ok) in enumerate(routes):
+        idx = jnp.where(ok, flat, ec)
+        tfs = tfs.at[idx].set(jnp.where(ok, tok, -1))
+        cfs = cfs.at[idx].set(k)
+    flats = jnp.stack([f for f, _ in routes], axis=1)
+    oks = jnp.stack([o for _, o in routes], axis=1)
+    return tfs[:ec], cfs[:ec], flats, oks
+
+
+def moe_dispatch_gather(x, token_for_slot, flats, oks, num_experts: int,
+                        capacity: int):
+    """(N,d) -> (E,C,d) where slot s holds x[token_for_slot[s]] (0 when
+    empty). flats/oks: (N,K) flat slot per (token, choice) + admitted
+    flags — used only by the backward gather."""
+    d = x.shape[-1]
+
+    @jax.custom_vjp
+    def run(xv, tfs, fl, ok):
+        valid = tfs >= 0
+        slots = jnp.take(xv, jnp.clip(tfs, 0, None), axis=0)
+        slots = jnp.where(valid[:, None], slots, 0)
+        return slots.reshape(num_experts, capacity, d)
+
+    def run_fwd(xv, tfs, fl, ok):
+        return run(xv, tfs, fl, ok), (tfs, fl, ok)
+
+    def run_bwd(res, g):
+        tfs, fl, ok = res
+        gf = g.reshape(num_experts * capacity, d)
+        dx = 0.0
+        for k in range(fl.shape[1]):
+            rows = jnp.take(gf, fl[:, k], axis=0)
+            dx = dx + jnp.where(ok[:, k][:, None], rows, 0)
+        return (dx, np.zeros(tfs.shape, jax.dtypes.float0),
+                np.zeros(fl.shape, jax.dtypes.float0),
+                np.zeros(ok.shape, jax.dtypes.float0))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(x, token_for_slot, flats, oks)
+
+
+def moe_combine_gather(expert_out, probs, flats, oks, token_for_slot,
+                       choice_for_slot):
+    """(E,C,d) + (N,K) probs -> (N,d): out[n] = sum_k ok*p_k*eo[slot(n,k)].
+    Backward for expert_out/probs is gather-only via the slot->token maps."""
+    e, c, d = expert_out.shape
+    n, K = flats.shape
+
+    @jax.custom_vjp
+    def run(eo, pv, fl, ok, tfs, cfs):
+        flat = eo.reshape(e * c, d)
+        out = 0.0
+        for k in range(K):
+            vals = jnp.take(flat, fl[:, k], axis=0)
+            w = pv[:, k] * ok[:, k].astype(pv.dtype)
+            out = out + vals * w[:, None].astype(vals.dtype)
+        return out
+
+    def run_fwd(eo, pv, fl, ok, tfs, cfs):
+        return run(eo, pv, fl, ok, tfs, cfs), (eo, pv, fl, ok, tfs, cfs)
+
+    def run_bwd(res, g):
+        eo, pv, fl, ok, tfs, cfs = res
+        flat = eo.reshape(e * c, d)
+        valid = tfs >= 0
+        tok = jnp.clip(tfs, 0, None)
+        # d_eo[s] = valid * g[token(s)] * p[token(s), choice(s)]
+        g_rows = jnp.take(g, tok, axis=0)
+        p_slot = jnp.take_along_axis(
+            jnp.take(pv, tok, axis=0), cfs[:, None], axis=1)[:, 0]
+        ok_slot = jnp.take_along_axis(
+            jnp.take(ok, tok, axis=0), cfs[:, None], axis=1)[:, 0]
+        w = p_slot * ok_slot.astype(p_slot.dtype)
+        d_eo = jnp.where(valid[:, None],
+                         g_rows * w[:, None].astype(g_rows.dtype), 0)
+        # d_p[n,k] = ok * <g[n], eo[slot(n,k)]>
+        dps = []
+        for k in range(K):
+            vals = jnp.take(flat, fl[:, k], axis=0)
+            dp = jnp.sum(g.astype(jnp.float32) * vals.astype(jnp.float32),
+                         axis=-1) * ok[:, k].astype(jnp.float32)
+            dps.append(dp)
+        d_pv = jnp.stack(dps, axis=1).astype(pv.dtype)
+        return (d_eo.reshape(e, c, d).astype(eo.dtype), d_pv,
+                np.zeros(fl.shape, jax.dtypes.float0),
+                np.zeros(ok.shape, jax.dtypes.float0),
+                np.zeros(tfs.shape, jax.dtypes.float0),
+                np.zeros(cfs.shape, jax.dtypes.float0))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(expert_out, probs, flats, oks, token_for_slot,
+               choice_for_slot)
 
 
 def moe_combine_indices(expert_out, routes, gate_prob):
