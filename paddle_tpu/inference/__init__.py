@@ -15,5 +15,6 @@ from .config import Config  # noqa: F401
 from .predictor import Predictor, create_predictor  # noqa: F401
 from . import decoding  # noqa: F401
 from .decoding import (  # noqa: F401
-    GenerationConfig, GenerationEngine, PagedGenerationEngine, KVCache,
+    ContinuousBatchingEngine, GenerationConfig, GenerationEngine,
+    PagedGenerationEngine, KVCache,
 )

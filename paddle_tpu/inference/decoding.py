@@ -259,3 +259,248 @@ class PagedGenerationEngine:
             params, jnp.asarray(ids), jnp.asarray(seq_lens, jnp.int32),
             mgr.k_pages, mgr.v_pages, jnp.asarray(bt), rng)
         return np.asarray(toks)
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching (round 4): a fixed-slot serving loop
+# ---------------------------------------------------------------------------
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray
+    tokens: list = field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatchingEngine:
+    """Fixed-slot continuous batching over the paged KV cache — the
+    *service* engine the reference exposes through AnalysisPredictor's
+    serving surface (paddle/fluid/inference/api/analysis_predictor.cc:§0;
+    vLLM-style continuous batching over the paged pool, PAPERS.md ragged
+    paged attention).
+
+    ``num_slots`` sequences decode together in one compiled step; when a
+    sequence hits EOS (or its token budget) its pages return to the pool
+    and a queued request is prefilled INTO the freed slot while the other
+    slots keep decoding. Admission control is host metadata only — device
+    shapes (slots, page pool, block-table width) never change, so nothing
+    recompiles at runtime.
+
+    Host-fence discipline (the axon tunnel makes every device->host value
+    dependency a full round trip): the ONLY transfer per round is the
+    decode chunk's emitted tokens. Slot tokens live on device (admission
+    writes the prefill's sampled token with a lazy ``.at[s].set``), the
+    decode scan emits each step's INPUT token — so chunk outputs chain
+    across chunks without overlap and the prefill token arrives with the
+    slot's first chunk — and positions are mirrored host-side
+    analytically instead of being read back.
+
+    Service API:
+      ``submit(prompt) -> rid``; ``step(params)`` runs one admit+decode
+      chunk; ``collect()`` drains finished requests; ``serve(params,
+      prompts)`` streams a whole list through the engine.
+    """
+
+    def __init__(self, model_config,
+                 generation_config: Optional[GenerationConfig] = None,
+                 num_slots: int = 8, page_size: int = 16,
+                 max_seq_len: int = 2048, num_pages: Optional[int] = None,
+                 chunk: int = 16):
+        from ..models import llama as L
+        from ..ops.paged_attention import PagedKVCacheManager
+        self._L = L
+        self.model_config = model_config
+        self.config = generation_config or GenerationConfig()
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.chunk = chunk
+        self.max_seq_len = max_seq_len
+        self._table_width = (max_seq_len + page_size - 1) // page_size
+        # pool sized for every slot at max length unless told otherwise
+        pool = num_pages or (num_slots * self._table_width + 1)
+        mcfg = model_config
+        self.mgr = PagedKVCacheManager(
+            mcfg.num_hidden_layers, pool, page_size,
+            mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+        # host slot state
+        self._slot_rid = [None] * num_slots       # rid occupying each slot
+        self._queue: list = []                    # pending _Request
+        self._live: Dict[int, _Request] = {}      # rid -> request (slotted)
+        self._finished: Dict[int, list] = {}
+        self._next_rid = 0
+        # slot tokens stay ON DEVICE (no per-admit readback); positions
+        # are host-mirrored analytically
+        self._tok_dev = jnp.zeros((num_slots,), jnp.int32)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._bt = np.zeros((num_slots, self._table_width), np.int32)
+        self._rng = jax.random.key(self.config.seed)
+        self._compiled_prefill: Dict[int, Callable] = {}
+        self._decode_chunk = None
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build_prefill(self, bucket: int):
+        L = self._L
+        mcfg = self.model_config
+        cfg = self.config
+
+        def run(params, ids, seq_len, k_pages, v_pages, bt, key):
+            logits, k_pages, v_pages = L.prefill_paged(
+                params, ids, seq_len, k_pages, v_pages, bt, mcfg)
+            last = jnp.take_along_axis(
+                logits, (seq_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            tok = _sample(last, key, cfg)
+            return tok, k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(3, 4))
+
+    def _build_decode_chunk(self):
+        L = self._L
+        mcfg = self.model_config
+        cfg = self.config
+        K = self.chunk
+
+        def run(params, tok, pos, k_pages, v_pages, bt, key):
+            def step(carry, _):
+                tok, pos, kp, vp, key = carry
+                lg, kp, vp = L.decode_step_paged(params, tok, pos, kp, vp,
+                                                 bt, mcfg)
+                key, sub = jax.random.split(key)
+                nxt = _sample(lg, sub, cfg)
+                # emit the INPUT token: chunk outputs then chain across
+                # chunks (and deliver each admission's prefill token)
+                return (nxt, pos + 1, kp, vp, key), tok
+
+            (tok, pos, k_pages, v_pages, _), toks = jax.lax.scan(
+                step, (tok, pos, k_pages, v_pages, key), None, length=K)
+            return (jnp.swapaxes(toks, 0, 1),       # (S, K)
+                    tok, k_pages, v_pages)
+
+        return jax.jit(run, donate_argnums=(3, 4))
+
+    # -- service API --------------------------------------------------------
+
+    def submit(self, prompt) -> int:
+        prompt = np.asarray(prompt, np.int32)
+        if len(prompt) + self.config.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens + max_new_tokens="
+                f"{self.config.max_new_tokens} exceeds the engine's "
+                f"max_seq_len={self.max_seq_len}; raise max_seq_len or "
+                "truncate the prompt (silent page clamping would corrupt "
+                "the sequence's KV)")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt))
+        return rid
+
+    def _admit(self, params):
+        """Fill free slots from the queue: allocate pages, prefill into the
+        slot, record the first generated token."""
+        cfg = self.config
+        for s in range(self.num_slots):
+            if self._slot_rid[s] is not None or not self._queue:
+                continue
+            req = self._queue[0]
+            lp = len(req.prompt)
+            total = lp + cfg.max_new_tokens      # submit() bounds this
+            if not self.mgr.can_allocate(total):
+                if not self._live:
+                    raise MemoryError(
+                        f"request {req.rid} needs "
+                        f"{self.mgr._pages_for(total)} pages but the pool "
+                        f"has {self.mgr.num_free_pages} free and no live "
+                        "sequence will release any; enlarge num_pages")
+                break                    # pool full: wait for a completion
+            self._queue.pop(0)
+            pages = self.mgr.allocate(req.rid, total)
+            self.mgr._lens[req.rid] = lp
+            bucket = _bucket(lp)
+            ids = np.full((1, bucket), cfg.pad_token_id, np.int32)
+            ids[0, :lp] = req.prompt
+            row = np.zeros((1, self._table_width), np.int32)
+            row[0, :len(pages)] = pages
+            if bucket not in self._compiled_prefill:
+                self._compiled_prefill[bucket] = self._build_prefill(bucket)
+            self._rng, sub = jax.random.split(self._rng)
+            tok, self.mgr.k_pages, self.mgr.v_pages = \
+                self._compiled_prefill[bucket](
+                    params, jnp.asarray(ids),
+                    jnp.asarray([lp], jnp.int32), self.mgr.k_pages,
+                    self.mgr.v_pages, jnp.asarray(row), sub)
+            # NO host readback: the prefill token is written into the slot
+            # lazily and reaches the host with the next chunk's emissions
+            self._tok_dev = self._tok_dev.at[s].set(tok[0])
+            self._slot_rid[s] = req.rid
+            self._live[req.rid] = req
+            self._pos[s] = lp
+            self._bt[s] = row[0]
+
+    def _complete(self, req) -> bool:
+        cfg = self.config
+        if len(req.tokens) >= cfg.max_new_tokens:
+            return True
+        return (cfg.eos_token_id is not None
+                and req.tokens and req.tokens[-1] == cfg.eos_token_id)
+
+    def _retire(self, s):
+        """Free a finished slot: pages back to the pool, output to the
+        finished map, slot table pointed at the reserved garbage page."""
+        rid = self._slot_rid[s]
+        req = self._live.pop(rid)
+        req.done = True
+        self._finished[rid] = req.tokens[:self.config.max_new_tokens]
+        self.mgr.free(rid)
+        self._slot_rid[s] = None
+        self._bt[s] = 0
+        self._pos[s] = 0
+
+    def step(self, params) -> int:
+        """One admit + decode-chunk round (ONE device->host transfer: the
+        chunk's emitted tokens). Returns the live count after the round."""
+        self._admit(params)
+        if not self._live:
+            return 0
+        if self._decode_chunk is None:
+            self._decode_chunk = self._build_decode_chunk()
+        self._rng, sub = jax.random.split(self._rng)
+        toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
+            self._decode_chunk(params, self._tok_dev,
+                               jnp.asarray(self._pos), self.mgr.k_pages,
+                               self.mgr.v_pages, jnp.asarray(self._bt), sub)
+        toks = np.asarray(toks)                    # the one fence
+        for s in range(self.num_slots):
+            rid = self._slot_rid[s]
+            if rid is None:
+                continue
+            req = self._live[rid]
+            for t in toks[s]:
+                req.tokens.append(int(t))
+                if self._complete(req):
+                    break
+            if self._complete(req):
+                self._retire(s)
+            else:
+                self._pos[s] += self.chunk
+        # idle slots decode into the garbage page; their host positions
+        # stay pinned at 0 so they never run past the rope cache
+        return len(self._live)
+
+    def collect(self) -> Dict[int, list]:
+        out = self._finished
+        self._finished = {}
+        return out
+
+    def serve(self, params, prompts) -> list:
+        """Stream a list of prompts through the fixed slots; returns the
+        generated token lists in submission order."""
+        rids = [self.submit(p) for p in prompts]
+        results: Dict[int, list] = {}
+        while len(results) < len(rids):
+            self.step(params)
+            results.update(self.collect())
+            if not self._live and not self._queue and \
+                    len(results) < len(rids):
+                raise RuntimeError("serve stalled with pending requests")
+        return [results[r] for r in rids]

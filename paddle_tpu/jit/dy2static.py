@@ -118,8 +118,9 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = ""):
             f"different variable structures ({t_struct} vs {f_struct}); "
             "assign the same variables (with the same nesting) in both "
             "branches")
-    for a, b in zip(jax.tree_util.tree_leaves(tu),
-                    jax.tree_util.tree_leaves(fu)):
+    t_leaves, treedef = jax.tree_util.tree_flatten(tu)
+    f_leaves = jax.tree_util.tree_leaves(fu)
+    for i, (a, b) in enumerate(zip(t_leaves, f_leaves)):
         if isinstance(a, _Undefined) or isinstance(b, _Undefined):
             raise ConversionError(
                 f"{loc}: a variable assigned in only one branch of a "
@@ -127,13 +128,106 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = ""):
                 "it before the `if`")
         sa = getattr(a, "shape", None)
         sb = getattr(b, "shape", None)
+        # one branch assigned a Python scalar (bool/int/float) while the
+        # other carries a traced () array — e.g. the break-lowering's
+        # `brk = True` against a carried flag; promote the Python side
+        if sa is None and sb == ():
+            t_leaves[i] = jnp.asarray(a, getattr(b, "dtype", None))
+            continue
+        if sb == None and sa == ():  # noqa: E711  (symmetric case)
+            f_leaves[i] = jnp.asarray(b, getattr(a, "dtype", None))
+            continue
         if sa != sb:
             raise ConversionError(
                 f"{loc}: branch outputs disagree on shape ({sa} vs {sb}); "
                 "lax.cond requires both branches to produce identical "
                 "shapes/dtypes")
+    tu = jax.tree_util.tree_unflatten(treedef, t_leaves)
+    fu = jax.tree_util.tree_unflatten(treedef, f_leaves)
     out = jax.lax.cond(pb.astype(bool), lambda: tu, lambda: fu)
     return _wrap_like(out, t_out)
+
+
+def convert_bool_op(op: str, loc: str, *thunks):
+    """Runtime dispatch for ``a and b`` / ``a or b``.
+
+    Operands arrive as thunks so concrete values keep Python's exact
+    short-circuit semantics (including returning the operand itself, not a
+    bool). The first TRACED operand ends short-circuiting: the remaining
+    operands are evaluated and folded with logical_and/or into a boolean
+    tensor (the reference SOT's behaviour for tensor predicates)."""
+    val = thunks[0]()
+    for i, t in enumerate(thunks[1:], 1):
+        raw = val._value if isinstance(val, Tensor) else val
+        if not isinstance(raw, jax.core.Tracer):
+            if op == "and":
+                if not raw:
+                    return val
+            else:
+                if raw:
+                    return val
+            val = t()
+            continue
+        acc = jnp.asarray(raw).astype(bool)
+        for t2 in thunks[i:]:
+            v2 = t2()
+            v2 = v2._value if isinstance(v2, Tensor) else v2
+            nxt = jnp.asarray(v2).astype(bool)
+            acc = (jnp.logical_and(acc, nxt) if op == "and"
+                   else jnp.logical_or(acc, nxt))
+        return Tensor(acc)
+    return val
+
+
+def convert_not(value, loc: str = ""):
+    """``not x``: Python semantics for concrete x, logical_not for traced."""
+    raw = value._value if isinstance(value, Tensor) else value
+    if isinstance(raw, jax.core.Tracer):
+        return Tensor(jnp.logical_not(jnp.asarray(raw).astype(bool)))
+    return not raw
+
+
+def convert_range_args(loc: str, *args):
+    """Normalize range(...) arguments to a (start, stop, step) triple."""
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    if len(vals) == 1:
+        start, stop, step = 0, vals[0], 1
+    elif len(vals) == 2:
+        start, stop, step = vals[0], vals[1], 1
+    elif len(vals) == 3:
+        start, stop, step = vals
+    else:
+        raise ConversionError(f"{loc}: range() takes 1-3 arguments")
+    if not isinstance(step, jax.core.Tracer):
+        try:
+            if int(step) == 0:
+                raise ValueError("range() arg 3 must not be zero")
+        except TypeError:
+            pass
+    return start, stop, step
+
+
+def convert_range_cont(i, stop, step):
+    """The for-range continuation predicate: direction-aware i-vs-stop."""
+    vals = [v._value if isinstance(v, Tensor) else v
+            for v in (i, stop, step)]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        iv, ev, sv = (jnp.asarray(v) for v in vals)
+        return Tensor(jnp.where(sv > 0, iv < ev, iv > ev))
+    iv, ev, sv = vals
+    return (sv > 0 and iv < ev) or (sv < 0 and iv > ev)
+
+
+def check_iterable(it, loc: str):
+    """Guard for a ``for`` over a non-range iterable: concrete iterables
+    run the plain Python loop; traced tensors get the actionable error."""
+    raw = it._value if isinstance(it, Tensor) else it
+    if isinstance(raw, jax.core.Tracer):
+        raise ConversionError(
+            f"{loc}: iterating a traced tensor in a `for` loop is not "
+            "convertible; loop over `range(n)` and index, or use a "
+            "tensor op (scan/vmap)")
+    return it
 
 
 def convert_while(cond_fn: Callable, body_fn: Callable, carry, loc: str = ""):
@@ -151,13 +245,28 @@ def convert_while(cond_fn: Callable, body_fn: Callable, carry, loc: str = ""):
             carry = body_fn(carry)
             first = cond_fn(carry)
         return carry
-    for v in jax.tree_util.tree_leaves(_unwrap(carry)):
-        if isinstance(v, _Undefined):
-            raise ConversionError(
-                f"{loc}: a loop-carried variable is undefined before a "
-                "data-dependent `while`; initialise every variable the "
-                "loop assigns")
     ucarry = _unwrap(carry)
+    init_leaves, treedef = jax.tree_util.tree_flatten(ucarry)
+    if any(isinstance(v, _Undefined) for v in init_leaves):
+        # Names assigned in the body but unbound before the loop (a nested
+        # loop's per-iteration locals, e.g. `for ...: acc = 0; ...`).
+        # Their init value is DEAD — the body assigns before reading — so
+        # probe-trace the body once to learn each slot's shape/dtype and
+        # seed it with zeros. A name still UNDEFINED in the probe output
+        # was never assigned-before-read: that is the real user error.
+        probe = jax.tree_util.tree_leaves(_unwrap(body_fn(carry)))
+        for i, v in enumerate(init_leaves):
+            if not isinstance(v, _Undefined):
+                continue
+            p = probe[i]
+            if isinstance(p, _Undefined) or not hasattr(p, "dtype"):
+                raise ConversionError(
+                    f"{loc}: a loop-carried variable is undefined before a "
+                    "data-dependent `while` and the body reads it before "
+                    "assigning; initialise it before the loop")
+            init_leaves[i] = jnp.zeros(jnp.shape(p), p.dtype)
+        ucarry = jax.tree_util.tree_unflatten(treedef, init_leaves)
+        carry = _wrap_like(ucarry, carry)
 
     def cond(u):
         p = _unwrap(cond_fn(_wrap_like(u, carry)))
@@ -212,13 +321,27 @@ def _store_names(nodes) -> set:
     return found
 
 
-def _load_names(node) -> set:
+def _load_names(node, prune_defs: bool = False) -> set:
+    """Names read within ``node``. With ``prune_defs`` nested
+    function/class bodies are skipped — a nested def's closure reads of
+    __dy2st_* names always follow their assignment in the same iteration
+    (the rewriter emits assigns before the defs that read them). Lambdas
+    are NEVER pruned: the bool-op conversion hides predicate reads (e.g.
+    a loop's break flag) inside thunk lambdas, and those are real reads
+    at statement execution time."""
     found = set()
 
     class V(ast.NodeVisitor):
         def visit_Name(self, node):
             if isinstance(node.ctx, ast.Load):
                 found.add(node.id)
+
+        if prune_defs:
+            def visit_FunctionDef(self, node):  # prune
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
 
     V().visit(node)
     return found
@@ -305,7 +428,8 @@ class _RewriteControlFlow(ast.NodeTransformer):
         concrete predicates behave exactly as before."""
         node.test = ast.Call(
             func=ast.Name(id="__dy2st_check_unconvertible", ctx=ast.Load()),
-            args=[node.test, ast.Constant(value=self._loc(node)),
+            args=[self._convert_bool_expr(node.test, self._loc(node)),
+                  ast.Constant(value=self._loc(node)),
                   ast.Constant(value=reason)],
             keywords=[])
         ast.copy_location(node.test, node)
@@ -385,31 +509,155 @@ class _RewriteControlFlow(ast.NodeTransformer):
         ffn = branch(f"__dy2st_false_{n}", orelse)
         call = ast.Call(
             func=ast.Name(id="__dy2st_convert_ifelse", ctx=ast.Load()),
-            args=[node.test,
+            args=[self._convert_bool_expr(node.test, self._loc(node)),
                   ast.Name(id=tfn.name, ctx=ast.Load()),
                   ast.Name(id=ffn.name, ctx=ast.Load()),
                   ast.Constant(value=self._loc(node))],
             keywords=[])
         return [tfn, ffn], call
 
+    # -- break/continue flag lowering ---------------------------------------
+    @staticmethod
+    def _lower_escapes(stmts, brk: str, cont: str):
+        """Rewrite ``break``/``continue`` in a loop body into flag
+        assignments (``brk``/``cont`` = True), guarding every statement
+        that follows a potential escape with ``if not (brk or cont):``
+        (the reference dy2static's break_continue_transformer). Does not
+        descend into nested loops or function defs (their escapes are
+        theirs). Returns the rewritten statement list."""
+        def set_flag(name):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=ast.Constant(value=True))
+
+        def has_escape(nodes):
+            return _has(nodes, (ast.Break, ast.Continue)) is not None
+
+        def guard(rest):
+            """if not (brk or cont): <rest>"""
+            test = ast.UnaryOp(
+                op=ast.Not(),
+                operand=ast.BoolOp(op=ast.Or(), values=[
+                    ast.Name(id=brk, ctx=ast.Load()),
+                    ast.Name(id=cont, ctx=ast.Load())]))
+            return ast.If(test=test, body=rest, orelse=[])
+
+        def rewrite(block):
+            out = []
+            for i, st in enumerate(block):
+                if isinstance(st, ast.Break):
+                    out.append(set_flag(brk))
+                    return out                     # rest of block is dead
+                if isinstance(st, ast.Continue):
+                    out.append(set_flag(cont))
+                    return out
+                if isinstance(st, ast.If) and has_escape([st]):
+                    new_if = ast.If(test=st.test,
+                                    body=rewrite(st.body) or [ast.Pass()],
+                                    orelse=rewrite(st.orelse))
+                    ast.copy_location(new_if, st)
+                    out.append(new_if)
+                    rest = rewrite(block[i + 1:])
+                    if rest:
+                        out.append(ast.copy_location(guard(rest), st))
+                    return out
+                if isinstance(st, (ast.While, ast.For, ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    out.append(st)                 # inner escapes are theirs
+                    continue
+                if isinstance(st, (ast.With, ast.AsyncWith)) and \
+                        has_escape([st]):
+                    # the context manager exits before the escape takes
+                    # effect outside it: rewrite the with-body and guard
+                    # the statements that follow
+                    new_with = type(st)(items=st.items,
+                                        body=rewrite(st.body) or [ast.Pass()])
+                    ast.copy_location(new_with, st)
+                    out.append(new_with)
+                    rest = rewrite(block[i + 1:])
+                    if rest:
+                        out.append(ast.copy_location(guard(rest), st))
+                    return out
+                if isinstance(st, (ast.Try, ast.Match)) and has_escape([st]):
+                    raise ConversionError(
+                        f"break/continue inside {type(st).__name__.lower()} "
+                        "blocks of a converted loop are not supported")
+                out.append(st)
+            return out
+
+        return rewrite(list(stmts))
+
+    def _lower_loop_escapes(self, node):
+        """If the loop body breaks/continues, lower the escapes to flags,
+        fold ``not brk`` into the loop test, and return
+        (node, pre_stmts); otherwise (node, []). The synthetic
+        ``__dy2st_brk/cont`` flags stay bound after an eager loop — a
+        namespaced, harmless residue."""
+        esc = _has(node.body, (ast.Break, ast.Continue))
+        if esc is None:
+            return node, []
+        n = self.counter
+        self.counter += 1
+        brk = f"__dy2st_brk_{n}"
+        cont = f"__dy2st_cont_{n}"
+        body = self._lower_escapes(node.body, brk, cont)
+        reset_cont = ast.Assign(
+            targets=[ast.Name(id=cont, ctx=ast.Store())],
+            value=ast.Constant(value=False))
+        node.body = [reset_cont] + body
+        node.test = ast.BoolOp(op=ast.And(), values=[
+            node.test,
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=brk, ctx=ast.Load()))])
+        # both flags init False BEFORE the loop: they ride the carry, and
+        # a traced while rejects undefined carried variables
+        pre = [ast.Assign(
+            targets=[ast.Name(id=name, ctx=ast.Store())],
+            value=ast.Constant(value=False))
+            for name in (brk, cont)]
+        for s in pre + [node]:
+            ast.copy_location(s, node)
+        # synthetic subtrees (flag tests, guards, assigns) need locations
+        # before any further visiting reads node.lineno
+        ast.fix_missing_locations(node)
+        for s in pre:
+            ast.fix_missing_locations(s)
+        return node, pre
+
     # -- while ---------------------------------------------------------------
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
-        loc = self._loc(node)
-        bad = _has(node.body, (ast.Break, ast.Continue, ast.Return))
-        if bad is not None:
-            kind = type(bad).__name__.lower()
-            return self._guard_test(
-                node,
-                f"`{kind}` (line {bad.lineno}) inside a data-dependent "
-                "`while` is not convertible to lax.while_loop; fold the "
-                "exit condition into the loop predicate")
         if node.orelse:
+            self.generic_visit(node)
             return self._guard_test(
                 node, "`while ... else` is not convertible")
+        node, pre = self._lower_loop_escapes(node)
+        self.generic_visit(node)
+        loc = self._loc(node)
+        bad = _has(node.body, ast.Return)
+        if bad is not None:
+            guarded = self._guard_test(
+                node,
+                f"`return` (line {bad.lineno}) inside a data-dependent "
+                "`while` is not convertible to lax.while_loop; fold the "
+                "exit condition into the loop predicate")
+            return pre + [guarded] if pre else guarded
         # carry = names the body assigns; loop-invariant reads (modules,
-        # helper fns, constants) stay closure-captured
-        carried = sorted(_store_names(node.body))
+        # helper fns, constants) stay closure-captured. Synthetic
+        # __dy2st_* names (nested-loop temporaries, escape flags) are
+        # carried ONLY when their value actually crosses iterations —
+        # i.e. they are read in the test or read before being assigned
+        # within one pass over the body; everything else (an inner loop's
+        # range triple, index var, flags — re-initialised every
+        # iteration) stays body-local, since carrying them would demand
+        # pre-loop definitions that do not exist.
+        stores = _store_names(node.body)
+        user = {a for a in stores if not a.startswith("__dy2st_")}
+        synth = stores - user
+        need = _load_names(node.test, prune_defs=True) & synth
+        definite: set = set()
+        for st in node.body:
+            need |= (_load_names(st, prune_defs=True) & synth) - definite
+            definite |= _store_names([st])
+        carried = sorted(user | need)
         n = self.counter
         self.counter += 1
 
@@ -435,7 +683,8 @@ class _RewriteControlFlow(ast.NodeTransformer):
 
         cond_fn = ast.FunctionDef(
             name=f"__dy2st_cond_{n}", args=arg(),
-            body=[unpack(), ast.Return(value=node.test)],
+            body=[unpack(), ast.Return(value=self._convert_bool_expr(
+                node.test, loc))],
             decorator_list=[], type_params=[])
         body_fn = ast.FunctionDef(
             name=f"__dy2st_body_{n}", args=arg(),
@@ -451,9 +700,103 @@ class _RewriteControlFlow(ast.NodeTransformer):
             keywords=[])
         assign = ast.Assign(targets=[carry_tuple_s], value=call)
         return [ast.copy_location(s, node)
-                for s in (self._undef_preamble(carried)
+                for s in (pre + self._undef_preamble(carried)
                           + [cond_fn, body_fn, assign]
                           + self._undef_cleanup(carried))]
+
+    # -- for -----------------------------------------------------------------
+    def visit_For(self, node: ast.For):
+        """``for <target> in range(...)`` desugars to the while form (the
+        loop variable advances at body start, so break/continue lowering
+        cannot skip the increment) and rides the existing while
+        conversion. Non-range iterables stay Python loops with a runtime
+        guard that raises the actionable error on traced tensors."""
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords)
+        if not is_range or node.orelse or not isinstance(node.target,
+                                                         ast.Name):
+            self.generic_visit(node)
+            guard = ast.Call(
+                func=ast.Name(id="__dy2st_check_iterable", ctx=ast.Load()),
+                args=[node.iter, ast.Constant(value=self._loc(node))],
+                keywords=[])
+            node.iter = ast.copy_location(guard, node.iter)
+            return node
+        n = self.counter
+        self.counter += 1
+        start, stop, step = (f"__dy2st_start_{n}", f"__dy2st_stop_{n}",
+                             f"__dy2st_step_{n}")
+        ivar = f"__dy2st_i_{n}"
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store())
+                      for v in (start, stop, step)], ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__dy2st_range_args", ctx=ast.Load()),
+                args=[ast.Constant(value=self._loc(node))]
+                + list(node.iter.args), keywords=[]))
+        init = ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                          value=ast.Name(id=start, ctx=ast.Load()))
+        # pre-bind the loop target (it rides the while carry; Python leaves
+        # it unbound on zero trips — here it holds `start`, documented)
+        init_target = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=ast.Name(id=start, ctx=ast.Load()))
+        set_target = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=ast.Name(id=ivar, ctx=ast.Load()))
+        advance = ast.Assign(
+            targets=[ast.Name(id=ivar, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step, ctx=ast.Load())))
+        test = ast.Call(
+            func=ast.Name(id="__dy2st_range_cont", ctx=ast.Load()),
+            args=[ast.Name(id=ivar, ctx=ast.Load()),
+                  ast.Name(id=stop, ctx=ast.Load()),
+                  ast.Name(id=step, ctx=ast.Load())],
+            keywords=[])
+        while_node = ast.While(
+            test=test, body=[set_target, advance] + list(node.body),
+            orelse=[])
+        for s in (unpack, init, init_target, while_node):
+            ast.copy_location(s, node)
+        ast.fix_missing_locations(while_node)
+        converted = self.visit(while_node)
+        if not isinstance(converted, list):
+            converted = [converted]
+        return [unpack, init, init_target] + converted
+
+    # -- boolean operators in PREDICATE position -----------------------------
+    def _convert_bool_expr(self, expr, loc: str):
+        """Rewrite and/or/not in a test expression. Only boolean CONTEXT
+        propagates the rewrite: recursion descends through BoolOp operands
+        and Not operands, never into arbitrary sub-expressions — a
+        value-position `x or default` keeps exact Python semantics (and
+        fails loudly on tracers), because convert_bool_op collapses traced
+        operands to a boolean tensor."""
+        if isinstance(expr, ast.BoolOp):
+            op = "and" if isinstance(expr.op, ast.And) else "or"
+            thunks = [ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=self._convert_bool_expr(v, loc)) for v in expr.values]
+            call = ast.Call(
+                func=ast.Name(id="__dy2st_bool_op", ctx=ast.Load()),
+                args=[ast.Constant(value=op), ast.Constant(value=loc)]
+                + thunks,
+                keywords=[])
+            return ast.copy_location(call, expr)
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            call = ast.Call(
+                func=ast.Name(id="__dy2st_not", ctx=ast.Load()),
+                args=[self._convert_bool_expr(expr.operand, loc),
+                      ast.Constant(value=loc)],
+                keywords=[])
+            return ast.copy_location(call, expr)
+        return expr
 
 
 def convert_control_flow(fn: Callable) -> Callable:
@@ -486,7 +829,7 @@ def convert_control_flow(fn: Callable) -> Callable:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return orig
-    if _has(fdef.body, (ast.If, ast.While)) is None:
+    if _has(fdef.body, (ast.If, ast.While, ast.For, ast.BoolOp)) is None:
         return orig  # nothing to rewrite
     # Decorators are NEVER re-executed (re-exec'ing decorator source would
     # re-run registration side effects, recurse through aliased to_static,
@@ -503,6 +846,11 @@ def convert_control_flow(fn: Callable) -> Callable:
     glb["__dy2st_convert_while"] = convert_while
     glb["__dy2st_check_unconvertible"] = check_unconvertible
     glb["__dy2st_UNDEFINED"] = UNDEFINED
+    glb["__dy2st_bool_op"] = convert_bool_op
+    glb["__dy2st_not"] = convert_not
+    glb["__dy2st_range_args"] = convert_range_args
+    glb["__dy2st_range_cont"] = convert_range_cont
+    glb["__dy2st_check_iterable"] = check_iterable
     freevars = fn.__code__.co_freevars
     if freevars:
         # re-bind the original closure: wrap the rewritten def in a factory

@@ -774,11 +774,14 @@ def _cached_attention(q, k_cache, v_cache, kv_len, config: LlamaConfig):
     return out
 
 
-def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
-                          config: LlamaConfig):
-    """One decoder layer with cache write + cached attention.
-    x: (B, T, H); cos/sin: (T, d) rope rows for these positions;
-    caches: (B, S_max, nkv, d). Returns (x', k_cache', v_cache')."""
+def _decoder_layer_cached_full(lp, l, x, cos, sin, kf, vf, kv_len,
+                               config: LlamaConfig):
+    """One cached decoder layer operating on the FULL stacked cache
+    (L, B, S_max, nkv, d): the new tokens write a (1, B, T, nkv, d) slab at
+    layer ``l`` and attention reads the layer slice (the slice read fuses
+    into the attention matmuls). This keeps the caches in the scan CARRY —
+    scanning them as xs/ys (the old structure) made XLA write fresh ys
+    cache buffers, a full cache copy per decode step."""
     b, t, h = x.shape
     d = config.head_dim
     xn = _rms(x, lp["ln1"], config.rms_norm_eps)
@@ -787,17 +790,19 @@ def _decoder_layer_cached(lp, x, cos, sin, k_cache, v_cache, kv_len,
     v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
     q, k = rope_ops.apply_rope_array(q, k, cos, sin)
     start = kv_len - t
-    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
-                                       (0, start, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
-                                       (0, start, 0, 0))
-    attn = _cached_attention(q, k_cache, v_cache, kv_len, config)
+    kf = lax.dynamic_update_slice(kf, k.astype(kf.dtype)[None],
+                                  (l, 0, start, 0, 0))
+    vf = lax.dynamic_update_slice(vf, v.astype(vf.dtype)[None],
+                                  (l, 0, start, 0, 0))
+    kc = lax.dynamic_index_in_dim(kf, l, 0, keepdims=False)
+    vc = lax.dynamic_index_in_dim(vf, l, 0, keepdims=False)
+    attn = _cached_attention(q, kc, vc, kv_len, config)
     x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
     xn = _rms(x, lp["ln2"], config.rms_norm_eps)
     g = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_gate"]))
     u = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_up"]))
     x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
-    return x, k_cache, v_cache
+    return x, kf, vf
 
 
 def prefill_stacked(params, ids, cache, config: LlamaConfig):
@@ -812,16 +817,18 @@ def prefill_stacked(params, ids, cache, config: LlamaConfig):
     x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
     kv_len = jnp.asarray(t, jnp.int32)
 
-    def body(carry, lp_kv):
-        xc = carry
-        lp, kc, vc = lp_kv
-        xo, kc, vc = _decoder_layer_cached(lp, xc, cos_full[:t], sin_full[:t],
-                                           kc, vc, kv_len, config)
+    def body(carry, lp_l):
+        xc, kf, vf = carry
+        lp, l = lp_l
+        xo, kf, vf = _decoder_layer_cached_full(
+            lp, l, xc, cos_full[:t], sin_full[:t], kf, vf, kv_len, config)
         # int8-quantized weights dequantize to f32; keep the carry dtype
-        return xo.astype(xc.dtype), (kc, vc)
+        return (xo.astype(xc.dtype), kf, vf), None
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
-    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    (x, k_new, v_new), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (layer_params, jnp.arange(config.num_hidden_layers)))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
     return logits, {"k": k_new, "v": v_new}
@@ -838,15 +845,17 @@ def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
     sin = lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
     kv_len = pos + 1
 
-    def body(carry, lp_kv):
-        xc = carry
-        lp, kc, vc = lp_kv
-        xo, kc, vc = _decoder_layer_cached(lp, xc, cos, sin, kc, vc,
-                                           kv_len, config)
-        return xo.astype(xc.dtype), (kc, vc)
+    def body(carry, lp_l):
+        xc, kf, vf = carry
+        lp, l = lp_l
+        xo, kf, vf = _decoder_layer_cached_full(lp, l, xc, cos, sin, kf, vf,
+                                                kv_len, config)
+        return (xo.astype(xc.dtype), kf, vf), None
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
-    x, (k_new, v_new) = lax.scan(body, x, (layer_params, cache["k"], cache["v"]))
+    (x, k_new, v_new), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (layer_params, jnp.arange(config.num_hidden_layers)))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
     return logits, {"k": k_new, "v": v_new}
@@ -877,9 +886,19 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
     valid = tpos[None, :] < seq_lens[:, None]
     phys = jnp.where(valid, phys, 0)
 
-    def body(carry, lp_kv):
-        xc = carry
-        lp, kp, vp = lp_kv
+    # Pools travel FLAT (L*P, page, nkv, d) in the scan CARRY with
+    # per-layer page-id offsets l*P. Scanning them as xs->ys (the old
+    # structure) forced XLA to write fresh ys pool buffers — a full copy
+    # of both pools per call; carried scatters update in place. The
+    # manager reserves page 0, so every layer slab's page l*P+0 is the
+    # garbage page and padded block-table slots stay safe after offset.
+    n_layers, pool_p = k_pages.shape[0], k_pages.shape[1]
+    kp_flat = k_pages.reshape((n_layers * pool_p,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((n_layers * pool_p,) + v_pages.shape[2:])
+
+    def body(carry, lp_l):
+        xc, kp, vp = carry
+        lp, l = lp_l
         d = config.head_dim
         xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
         q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
@@ -893,16 +912,20 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
         g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
         u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
         xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
-        # scatter this layer's K/V into its pages
-        kp = kp.at[phys, page_off].set(k.astype(kp.dtype))
-        vp = vp.at[phys, page_off].set(v.astype(vp.dtype))
-        return xo, (kp, vp)
+        # scatter this layer's K/V into its slab of the flat pool
+        kp = kp.at[phys + l * pool_p, page_off].set(k.astype(kp.dtype))
+        vp = vp.at[phys + l * pool_p, page_off].set(v.astype(vp.dtype))
+        # int8-quantized weights dequantize to f32; keep the carry dtype
+        return (xo.astype(xc.dtype), kp, vp), None
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
-    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    (x, kp_flat, vp_flat), _ = lax.scan(
+        body, (x, kp_flat, vp_flat),
+        (layer_params, jnp.arange(n_layers)))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
-    return logits, k_new, v_new
+    return (logits, kp_flat.reshape(k_pages.shape),
+            vp_flat.reshape(v_pages.shape))
 
 
 def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
@@ -921,17 +944,25 @@ def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
     sin = jnp.take(sin_full, positions, axis=0)[:, None, :]
     kv_lens = positions + 1
 
-    def body(carry, lp_kv):
-        xc = carry
-        lp, kp, vp = lp_kv
+    # flat-pool carry with per-layer page offsets — see prefill_paged's
+    # structure note (pools as scan xs/ys would copy both pools per STEP,
+    # ~1.5 GB at serving scale; carried scatters are in place)
+    n_layers, pool_p = k_pages.shape[0], k_pages.shape[1]
+    kp_flat = k_pages.reshape((n_layers * pool_p,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((n_layers * pool_p,) + v_pages.shape[2:])
+
+    def body(carry, lp_l):
+        xc, kp, vp = carry
+        lp, l = lp_l
+        bt_l = block_tables + l * pool_p
         xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
         q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, 1, -1, d)
         k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, 1, -1, d)
         v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, 1, -1, d)
         q2, k2 = rope_ops.apply_rope_array(q, k, cos, sin)  # (B,1,d) 3-D form
         kp, vp = pa.paged_write_array(kp, vp, k2[:, 0], v[:, 0],
-                                      block_tables, positions)
-        attn = pa.paged_attention(q2[:, 0], kp, vp, block_tables,
+                                      bt_l, positions)
+        attn = pa.paged_attention(q2[:, 0], kp, vp, bt_l,
                                   kv_lens, scale=1.0 / math.sqrt(d))
         xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
                              _dense(lp["wo"]))[:, None, :]
@@ -939,10 +970,14 @@ def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
         g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
         u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
         xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
-        return xo, (kp, vp)
+        # int8-quantized weights dequantize to f32; keep the carry dtype
+        return (xo.astype(xc.dtype), kp, vp), None
 
     layer_params = {k: params[k] for k in LAYER_KEYS}
-    x, (k_new, v_new) = lax.scan(body, x, (layer_params, k_pages, v_pages))
+    (x, kp_flat, vp_flat), _ = lax.scan(
+        body, (x, kp_flat, vp_flat),
+        (layer_params, jnp.arange(n_layers)))
     x = _rms(x, params["ln_f"], config.rms_norm_eps)
     logits = jnp.einsum("bh,hv->bv", x[:, 0], _dense(params["lm_head"]))
-    return logits, k_new, v_new
+    return (logits, kp_flat.reshape(k_pages.shape),
+            vp_flat.reshape(v_pages.shape))

@@ -178,17 +178,36 @@ class TestWhileConversion:
                                    np.asarray(ref._value))
         assert sf.recompile_count == 0
 
-    def test_while_with_break_diagnostic(self):
+    def test_while_with_break_converts(self):
+        """Round 4: break inside a data-dependent while lowers via flag
+        lowering instead of raising (the round-3 diagnostic is gone)."""
+        def f(x):
+            s = x * 0.0
+            while (s.sum() < 10.0):
+                s = s + x
+                if (s.sum() >= 6.0):
+                    break
+            return s
+
+        sf = jit.to_static(f)
+        out = np.asarray(sf(paddle.to_tensor(
+            np.ones((4,), np.float32)))._value)
+        # 4 per iteration; breaks once the sum reaches >= 6 (two rounds)
+        np.testing.assert_allclose(out, 2.0 * np.ones(4))
+
+    def test_while_with_return_diagnostic(self):
+        """return inside a data-dependent while stays unconvertible with
+        the actionable error."""
         def f(x):
             s = x * 0.0
             while (s.sum() < 10.0):
                 s = s + x
                 if False:
-                    break
+                    return s
             return s
 
         sf = jit.to_static(f)
-        with pytest.raises(ConversionError, match="break"):
+        with pytest.raises(ConversionError, match="return"):
             sf(paddle.to_tensor(np.ones((4,), np.float32)))
 
     def test_concrete_while_unchanged(self):
