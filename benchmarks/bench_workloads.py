@@ -435,41 +435,78 @@ def bench_decode_cb():
 
 
 def bench_vit():
-    """Workload #5a: ViT-L/16 supervised training step (conv/attn mix)."""
+    """Workload #5a: ViT-L/16 supervised training step (conv/attn mix).
+
+    Default is the imperative-module TrainStep path — measured FASTER on
+    chip (225.7 img/s) than the round-4 stacked lax.scan + dots-remat
+    functional step (191.0 img/s; the scan needs remat to fit, and the
+    recompute's extra HBM passes cost more than the per-tensor optimizer
+    fusions it saves — PROFILE_vit_r4.md). BENCH_VIT_STACKED=1 runs the
+    stacked path (parity-tested in test_vit)."""
     jax, smoke = _setup()
+    import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu import amp, optimizer
     from paddle_tpu.nn import functional as F
-    from paddle_tpu.vision.models.vit import (vit_large_patch16_224,
-                                              vit_tiny_test)
+    from paddle_tpu.vision.models.vit import (
+        vit_large_patch16_224, vit_tiny_test, stacked_params_from_module,
+        build_vit_train_step)
 
     if smoke:
         B, side, steps, warm = 2, 16, 2, 1
     else:
-        B, side, steps, warm = 32, 224, 10, 2
+        B = int(os.environ.get("BENCH_VIT_BATCH", "32"))
+        side, steps, warm = 224, 10, 2
 
     paddle.seed(0)
     net = vit_tiny_test() if smoke else vit_large_patch16_224(class_num=1000)
-    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
-    if not smoke:
-        amp.decorate(models=net, optimizers=opt, level="O2",
-                     dtype="bfloat16")
-
-    def loss_fn(model, x, y):
-        return F.cross_entropy(model(x).astype("float32"), y)
-
-    step = paddle.jit.TrainStep(net, loss_fn, opt)
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randn(B, 3, side, side).astype(np.float32))
-    if not smoke:
-        x = x.astype("bfloat16")
-    y = paddle.to_tensor(rng.randint(0, 10 if smoke else 1000, (B,)).astype(np.int64))
+    heads = 4 if smoke else 16
+    patch = 4 if smoke else 16
+
+    if os.environ.get("BENCH_VIT_STACKED") != "1":
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=net.parameters())
+        if not smoke:
+            amp.decorate(models=net, optimizers=opt, level="O2",
+                         dtype="bfloat16")
+
+        def loss_fn(model, x, y):
+            return F.cross_entropy(model(x).astype("float32"), y)
+
+        tstep = paddle.jit.TrainStep(net, loss_fn, opt)
+        x = paddle.to_tensor(rng.randn(B, 3, side, side).astype(np.float32))
+        if not smoke:
+            x = x.astype("bfloat16")
+        y = paddle.to_tensor(rng.randint(0, 10 if smoke else 1000,
+                                         (B,)).astype(np.int64))
+        run = lambda: tstep(x, y)
+    else:
+        params = stacked_params_from_module(net)
+        dt_ = jnp.float32 if smoke else jnp.bfloat16
+        if not smoke:
+            params = {k: (v.astype(jnp.bfloat16)
+                          if v.dtype == jnp.float32 and v.ndim > 1 else v)
+                      for k, v in params.items()}
+        sstep, init_opt = build_vit_train_step(
+            num_heads=heads, patch=patch, learning_rate=1e-4, dtype=dt_)
+        ostate = init_opt(params)
+        xj = jnp.asarray(rng.randn(B, 3, side, side).astype(np.float32))
+        yj = jnp.asarray(rng.randint(0, 10 if smoke else 1000, (B,)),
+                         jnp.int32)
+        state = {"p": params, "o": ostate}
+
+        def run():
+            loss, state["p"], state["o"] = sstep(state["p"], state["o"],
+                                                 xj, yj)
+            return loss
+
     for _ in range(warm):
-        loss = step(x, y)
+        loss = run()
     float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step(x, y)
+        loss = run()
     float(loss)
     dt = time.perf_counter() - t0
     img_s = B * steps / dt

@@ -16,7 +16,41 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import detect_peak
-from profile_flagship import _parse_trace
+
+
+def _parse_trace(path):
+    import gzip, json as _json, collections
+    with gzip.open(path, "rt") as f:
+        data = _json.load(f)
+    events = data.get("traceEvents", [])
+    pid_names = {e.get("pid"): str(e.get("args", {}).get("name", ""))
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    device_pids = {p for p, n in pid_names.items()
+                   if any(s in n.lower() for s in ("tpu", "device", "xla"))}
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    step_ms = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        name = str(e.get("name", "?"))
+        if name.startswith("jit_"):
+            step_ms = max(step_ms, dur / 1e3)
+            continue
+        if name.isdigit():
+            continue
+        # group fusion.1234 -> fusion, cluster repeated per-layer ops
+        base = name.split(".")[0]
+        agg[base] += dur
+        cnt[base] += 1
+    top = [(f"{n} x{cnt[n]}", d / 1e3) for n, d in agg.most_common(25)]
+    total = sum(agg.values()) / 1e3
+    top.append(("TOTAL-device-op-time", total))
+    return top, step_ms
 
 HBM_GBPS = {"v5e": 819, "v5p": 2765, "v4": 1228, "v6e": 1640}
 

@@ -27,6 +27,9 @@ from .core.extra_ops import (  # noqa: F401
     is_complex, is_floating_point, is_empty, rank, tolist, broadcast_shape,
     clone, view, broadcast_tensors, unstack, hsplit, vsplit, dsplit, slice,
     shard_index, unique_consecutive, inverse, poisson, hstack,
+    vstack, row_stack, column_stack, dstack, atleast_1d, atleast_2d,
+    atleast_3d, tensor_split, mode, masked_scatter, diagonal_scatter,
+    select_scatter, slice_scatter, histogramdd,
 )
 from .core import op_schema as _op_schema  # noqa: E402
 _op_schema.install(globals())  # schema-generated ops (only missing names)

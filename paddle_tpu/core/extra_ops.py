@@ -185,3 +185,166 @@ def poisson(x) -> Tensor:
     key = _random.next_key()
     return apply(lambda v: jax.random.poisson(key, v, v.shape).astype(
         v.dtype), _t(x), op_name="poisson")
+
+
+# -- round-4 API audit: stacks / splits / scatter views ----------------------
+def vstack(x, name=None) -> Tensor:
+    """paddle.vstack (row_stack): stack along dim 0, 1-D inputs become
+    rows (numpy vstack semantics)."""
+    return apply(lambda *vs: jnp.vstack(vs), *[_t(t) for t in x],
+                 op_name="vstack")
+
+
+def row_stack(x, name=None) -> Tensor:
+    return vstack(x, name)
+
+
+def column_stack(x, name=None) -> Tensor:
+    """paddle.column_stack: 1-D inputs become columns."""
+    return apply(lambda *vs: jnp.column_stack(vs), *[_t(t) for t in x],
+                 op_name="column_stack")
+
+
+def dstack(x, name=None) -> Tensor:
+    return apply(lambda *vs: jnp.dstack(vs), *[_t(t) for t in x],
+                 op_name="dstack")
+
+
+def _atleast(nd, inputs):
+    f = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}[nd]
+    outs = [apply(lambda v, f=f: f(v), _t(t), op_name=f"atleast_{nd}d")
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_1d(*inputs, name=None):
+    return _atleast(1, inputs)
+
+
+def atleast_2d(*inputs, name=None):
+    return _atleast(2, inputs)
+
+
+def atleast_3d(*inputs, name=None):
+    return _atleast(3, inputs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None) -> List[Tensor]:
+    """paddle.tensor_split: like numpy array_split — uneven splits allowed
+    for an int count; a list gives explicit cut indices."""
+    v = _v(x)
+    dim = v.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        cuts = np.cumsum([0] + sizes)
+    else:
+        idx = [int(i) for i in num_or_indices]
+        cuts = np.asarray([0] + idx + [dim])
+    outs = []
+    for s, e in zip(cuts[:-1], cuts[1:]):
+        outs.append(apply(
+            lambda vv, s=int(s), e=int(e): jax.lax.slice_in_dim(
+                vv, s, e, axis=axis), _t(x), op_name="tensor_split"))
+    return outs
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """paddle.mode: most frequent value (+ its last index) along ``axis``."""
+    def fn(v):
+        ax = axis % v.ndim
+        mv = jnp.moveaxis(v, ax, -1)
+        n = mv.shape[-1]
+        # count matches per element; the mode maximises the count, ties
+        # resolved toward the LARGEST index (paddle returns the last
+        # occurrence of the modal value)
+        eq = mv[..., :, None] == mv[..., None, :]
+        counts = jnp.sum(eq, axis=-1)
+        best = jnp.max(counts, axis=-1, keepdims=True)
+        is_best = counts == best
+        idx = jnp.arange(n)
+        pick = jnp.max(jnp.where(is_best, idx, -1), axis=-1)
+        vals = jnp.take_along_axis(mv, pick[..., None], axis=-1)[..., 0]
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            pick = jnp.expand_dims(pick, ax)
+        return vals, pick.astype(jnp.int64)
+
+    return apply(fn, _t(x), op_name="mode", n_outputs=2)
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    """paddle.masked_scatter: fill True positions of ``mask`` with
+    consecutive elements of ``value`` (row-major)."""
+    def fn(v, m, val):
+        mb = jnp.broadcast_to(m.astype(bool), v.shape)
+        k = jnp.cumsum(mb.reshape(-1)) - 1          # source index per slot
+        src = val.reshape(-1)[jnp.clip(k, 0, None)].reshape(v.shape)
+        return jnp.where(mb, src.astype(v.dtype), v)
+
+    return apply(fn, _t(x), _t(mask), _t(value), op_name="masked_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    """paddle.diagonal_scatter: write ``y`` (shaped like the diagonal
+    view, diagonal length last) onto the selected diagonal."""
+    def fn(v, yv):
+        vm = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        n1, n2 = vm.shape[-2], vm.shape[-1]
+        if offset >= 0:
+            dlen = min(n1, n2 - offset)
+            r = jnp.arange(dlen)
+            c = r + offset
+        else:
+            dlen = min(n1 + offset, n2)
+            c = jnp.arange(dlen)
+            r = c - offset
+        out = vm.at[..., r, c].set(yv.astype(v.dtype))
+        return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+    return apply(fn, _t(x), _t(y), op_name="diagonal_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None) -> Tensor:
+    """paddle.select_scatter: write ``values`` into position ``index`` of
+    dimension ``axis``."""
+    def fn(v, val):
+        expanded = jnp.expand_dims(val.astype(v.dtype), axis)
+        return jax.lax.dynamic_update_slice_in_dim(
+            v, expanded, index, axis=axis)
+
+    return apply(fn, _t(x), _t(values), op_name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None) -> Tensor:
+    """paddle.slice_scatter: write ``value`` into the strided slice."""
+    import builtins
+
+    def fn(v, val):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+
+    return apply(fn, _t(x), _t(value), op_name="slice_scatter")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """paddle.histogramdd: D-dimensional histogram of an (N, D) sample.
+    Returns (hist, list_of_edges) — numpy.histogramdd semantics."""
+    v = np.asarray(_v(x))
+    w = None if weights is None else np.asarray(_v(weights))
+    if isinstance(bins, (list, tuple)) and len(bins) and \
+            not np.isscalar(bins[0]):
+        bins = [np.asarray(_v(b)) for b in bins]
+    rng = None
+    if ranges is not None:
+        r = list(ranges)
+        rng = [(float(r[2 * i]), float(r[2 * i + 1]))
+               for i in range(len(r) // 2)]
+    hist, edges = np.histogramdd(v, bins=bins, range=rng, density=density,
+                                 weights=w)
+    return (Tensor(jnp.asarray(hist.astype(np.float32))),
+            [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges])

@@ -1695,3 +1695,94 @@ register(OpSpec(
     sample=lambda rng: ((rng.randn(3, 8).astype(np.float32),),
                         {"dx": 0.25, "axis": 1}),
 ))
+
+
+# --- round-4 API audit: remaining elementwise long tail ----------------------
+register(OpSpec(
+    name="i1e",
+    fn=lambda x: jax.scipy.special.i1e(x),
+    oracle=lambda x: _np_i1(x) * np.exp(-np.abs(np.asarray(x, np.float64))),
+    sample=lambda rng: ((rng.randn(8).astype(np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+
+def _np_multigammaln(x, p):
+    from math import lgamma, log, pi
+    flat = np.asarray(x, np.float64).reshape(-1)
+    out = []
+    for v in flat:
+        s = 0.25 * p * (p - 1) * log(pi)
+        s += sum(lgamma(v - 0.5 * j) for j in range(p))
+        out.append(s)
+    return np.asarray(out).reshape(np.shape(x))
+
+
+register(OpSpec(
+    name="multigammaln",
+    fn=lambda x, p=2: (0.25 * p * (p - 1) * jnp.log(jnp.pi)
+                       + sum(jax.scipy.special.gammaln(x - 0.5 * j)
+                             for j in range(p))),
+    oracle=lambda x, p=2: _np_multigammaln(x, p),
+    sample=lambda rng: ((rng.rand(6).astype(np.float32) * 3 + 2.0,),
+                        {"p": 2}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="isneginf",
+    fn=lambda x: jnp.isneginf(x),
+    oracle=lambda x: np.isneginf(x),
+    sample=lambda rng: ((np.asarray([1.0, -np.inf, np.inf, np.nan],
+                                    np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="isposinf",
+    fn=lambda x: jnp.isposinf(x),
+    oracle=lambda x: np.isposinf(x),
+    sample=lambda rng: ((np.asarray([1.0, -np.inf, np.inf, np.nan],
+                                    np.float32),), {}),
+    dtypes=("float32",),
+    grad=False,
+))
+
+register(OpSpec(
+    name="isreal",
+    fn=lambda x: jnp.isreal(x),
+    oracle=lambda x: np.isreal(x),
+    sample=_complex_sample,
+    dtypes=("complex64",),
+    integer_inputs=(0,),
+    grad=False,
+))
+
+register(OpSpec(
+    name="positive",
+    fn=lambda x: jnp.positive(x),
+    oracle=lambda x: np.positive(x),
+    sample=lambda rng: ((rng.randn(6).astype(np.float32),), {}),
+    dtypes=("float32", "float64", "int32"),
+))
+
+register(OpSpec(
+    name="negative",
+    fn=lambda x: jnp.negative(x),
+    oracle=lambda x: np.negative(x),
+    sample=lambda rng: ((rng.randn(6).astype(np.float32),), {}),
+    dtypes=("float32", "float64", "int32"),
+))
+
+register(OpSpec(
+    name="float_power",
+    fn=lambda x, y: jnp.float_power(x, y),
+    oracle=lambda x, y: np.float_power(x, y),
+    sample=lambda rng: ((np.abs(rng.randn(6)).astype(np.float32) + 0.1,
+                         rng.randn(6).astype(np.float32)), {}),
+    dtypes=("float32",),
+    grad=False,
+))

@@ -259,12 +259,14 @@ def convert_while(cond_fn: Callable, body_fn: Callable, carry, loc: str = ""):
             if not isinstance(v, _Undefined):
                 continue
             p = probe[i]
-            if isinstance(p, _Undefined) or not hasattr(p, "dtype"):
+            if isinstance(p, _Undefined):
                 raise ConversionError(
                     f"{loc}: a loop-carried variable is undefined before a "
                     "data-dependent `while` and the body reads it before "
                     "assigning; initialise it before the loop")
-            init_leaves[i] = jnp.zeros(jnp.shape(p), p.dtype)
+            # probe values may be plain Python scalars (a nested concrete
+            # loop's counter) — jnp.asarray gives them an aval too
+            init_leaves[i] = jnp.zeros_like(jnp.asarray(p))
         ucarry = jax.tree_util.tree_unflatten(treedef, init_leaves)
         carry = _wrap_like(ucarry, carry)
 
@@ -347,11 +349,14 @@ def _load_names(node, prune_defs: bool = False) -> set:
     return found
 
 
-def _has(nodes, kinds) -> ast.AST:
+def _has(nodes, kinds, prune_loops: bool = False) -> ast.AST:
     """First node of any of ``kinds`` inside ``nodes``, PRUNING nested
     function/class subtrees (a Return inside a nested def — including the
     __dy2st_* branch helpers an inner rewrite plants — does not belong to
-    the enclosing statement)."""
+    the enclosing statement). ``prune_loops`` additionally skips nested
+    While/For subtrees — a Break/Continue inside an inner loop belongs to
+    THAT loop (a Return, by contrast, escapes every loop, so Return
+    searches must not prune)."""
     hit = []
 
     class V(ast.NodeVisitor):
@@ -361,6 +366,13 @@ def _has(nodes, kinds) -> ast.AST:
         visit_AsyncFunctionDef = visit_FunctionDef
         visit_ClassDef = visit_FunctionDef
         visit_Lambda = visit_FunctionDef
+
+        if prune_loops:
+            def visit_While(self, node):  # inner escapes are theirs
+                pass
+
+            visit_For = visit_While
+            visit_AsyncFor = visit_While
 
         def generic_visit(self, node):
             if not hit and isinstance(node, kinds):
@@ -439,7 +451,8 @@ class _RewriteControlFlow(ast.NodeTransformer):
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
         body, orelse = node.body, node.orelse
-        esc = _has(body + orelse, (ast.Break, ast.Continue))
+        esc = _has(body + orelse, (ast.Break, ast.Continue),
+                   prune_loops=True)
         if esc is not None:
             # cannot pull a loop-escape statement into a branch function;
             # keep Python form, diagnose only if the predicate is traced
@@ -530,7 +543,8 @@ class _RewriteControlFlow(ast.NodeTransformer):
                               value=ast.Constant(value=True))
 
         def has_escape(nodes):
-            return _has(nodes, (ast.Break, ast.Continue)) is not None
+            return _has(nodes, (ast.Break, ast.Continue),
+                        prune_loops=True) is not None
 
         def guard(rest):
             """if not (brk or cont): <rest>"""
@@ -592,7 +606,7 @@ class _RewriteControlFlow(ast.NodeTransformer):
         (node, pre_stmts); otherwise (node, []). The synthetic
         ``__dy2st_brk/cont`` flags stay bound after an eager loop — a
         namespaced, harmless residue."""
-        esc = _has(node.body, (ast.Break, ast.Continue))
+        esc = _has(node.body, (ast.Break, ast.Continue), prune_loops=True)
         if esc is None:
             return node, []
         n = self.counter

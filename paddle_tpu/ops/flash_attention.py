@@ -402,10 +402,15 @@ def _pallas_ok(q, k):
     # d=64 compiles cleanly under Mosaic (verified on chip: fwd+bwd parity
     # 4e-3 bf16) — required for the encoder family, whose hd = 1024/16 =
     # 64. Other non-128 multiples (192, 320, ...) stay on the fallback
-    # until verified.
+    # until verified. Sequence threshold is measured: at S<=256 the XLA
+    # einsum path wins (ViT-L S=197->256: 222 vs 215 img/s end-to-end);
+    # from S=512 up the kernel wins (BERT S=512 d=64: 6.75 vs 10.8 ms;
+    # llama S=2048 d=128: 1.7x) and the score materialization the kernel
+    # avoids grows quadratically.
     d = q.shape[2]
     return use_pallas() and bq is not None and bk is not None and \
-        (_mult(d, 128) or d == 64)
+        (_mult(d, 128) or d == 64) and \
+        max(q.shape[1], k.shape[1]) >= 512
 
 
 def _fa_fwd(q, k, v, scale, causal, kv_valid, causal_offset):

@@ -342,3 +342,36 @@ class TestReviewRepros:
 
         got = _run(f, jnp.asarray([1.0]), jnp.asarray(3))
         np.testing.assert_allclose(got, [3.0])
+
+    def test_nested_concrete_loop_with_local_counter(self):
+        """A nested concrete while whose counter is a Python int local must
+        not trip the undefined-carry probe (it IS assigned before read)."""
+        def f(x):
+            s = x * 0.0
+            while (s.sum() < 10.0):
+                k = 0
+                while k < 3:
+                    k = k + 1
+                s = s + x + (k - 3)
+            return s
+
+        got = _run(f, jnp.asarray(np.ones(2, np.float32)))
+        np.testing.assert_allclose(got, [5.0, 5.0])
+
+    def test_inner_loop_break_not_attributed_to_outer(self):
+        """A break inside an inner CONCRETE for belongs to that loop; the
+        outer while must not grow escape flags or reject try-wrapping."""
+        def f(x):
+            s = x * 0.0
+            while (s.sum() < 6.0):
+                try:
+                    for j in range(5):
+                        if j == 2:
+                            break
+                except ValueError:
+                    pass
+                s = s + x + j - 2
+            return s
+
+        got = _run(f, jnp.asarray(np.ones(2, np.float32)))
+        np.testing.assert_allclose(got, [3.0, 3.0])

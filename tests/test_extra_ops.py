@@ -87,3 +87,83 @@ def test_hstack_list_form_and_unique_consecutive_axis():
     t = paddle.to_tensor(np.asarray([[1, 1, 2], [3, 3, 4]], np.int32))
     out = paddle.unique_consecutive(t, axis=1)
     np.testing.assert_allclose(np.asarray(out._value), [[1, 2], [3, 4]])
+
+
+class TestRound4AuditOps:
+    """Round-4 API-audit additions (SURVEY §8.1)."""
+
+    def test_stacks(self):
+        a = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.asarray([3.0, 4.0], np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.vstack([a, b])._value), [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.row_stack([a, b])._value), [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.column_stack([a, b])._value), [[1, 3], [2, 4]])
+        assert tuple(paddle.dstack([a, b]).shape) == (1, 2, 2)
+
+    def test_atleast(self):
+        s = paddle.to_tensor(np.float32(5.0))
+        assert tuple(paddle.atleast_1d(s).shape) == (1,)
+        assert tuple(paddle.atleast_2d(s).shape) == (1, 1)
+        assert tuple(paddle.atleast_3d(s).shape) == (1, 1, 1)
+        outs = paddle.atleast_2d(s, s)
+        assert isinstance(outs, list) and len(outs) == 2
+
+    def test_tensor_split_matches_numpy(self):
+        x = np.arange(11, dtype=np.float32)
+        got = [np.asarray(t._value)
+               for t in paddle.tensor_split(paddle.to_tensor(x), 3)]
+        want = np.array_split(x, 3)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        got = [np.asarray(t._value)
+               for t in paddle.tensor_split(paddle.to_tensor(x), [2, 7])]
+        for g, w in zip(got, np.split(x, [2, 7])):
+            np.testing.assert_array_equal(g, w)
+
+    def test_mode(self):
+        x = paddle.to_tensor(np.asarray([[2, 2, 3, 1], [9, 9, 9, 1]],
+                                        np.int32))
+        vals, idx = paddle.mode(x)
+        np.testing.assert_array_equal(np.asarray(vals._value), [2, 9])
+        np.testing.assert_array_equal(np.asarray(idx._value), [1, 2])
+
+    def test_masked_scatter(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        mask = paddle.to_tensor(
+            np.asarray([[1, 0, 1], [0, 1, 0]], bool))
+        val = paddle.to_tensor(np.asarray([5.0, 6.0, 7.0, 8.0], np.float32))
+        got = np.asarray(paddle.masked_scatter(x, mask, val)._value)
+        np.testing.assert_array_equal(got, [[5, 0, 6], [0, 7, 0]])
+
+    def test_scatter_views(self):
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        d = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        got = np.asarray(paddle.diagonal_scatter(x, d)._value)
+        np.testing.assert_array_equal(np.diag(got), [1, 2, 3])
+        off = np.asarray(paddle.diagonal_scatter(
+            x, paddle.to_tensor(np.asarray([9.0, 9.0, 9.0], np.float32)),
+            offset=1)._value)
+        np.testing.assert_array_equal([off[0, 1], off[1, 2], off[2, 3]],
+                                      [9, 9, 9])
+
+        row = paddle.to_tensor(np.asarray([7.0, 7.0, 7.0, 7.0], np.float32))
+        got = np.asarray(paddle.select_scatter(x, row, 0, 1)._value)
+        np.testing.assert_array_equal(got[1], [7, 7, 7, 7])
+
+        blk = paddle.to_tensor(np.ones((3, 2), np.float32))
+        got = np.asarray(paddle.slice_scatter(
+            x, blk, axes=[1], starts=[1], ends=[3], strides=[1])._value)
+        np.testing.assert_array_equal(got[:, 1:3], np.ones((3, 2)))
+
+    def test_histogramdd(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(50, 2).astype(np.float32)
+        hist, edges = paddle.histogramdd(paddle.to_tensor(x), bins=5)
+        want, wedges = np.histogramdd(x, bins=5)
+        np.testing.assert_allclose(np.asarray(hist._value), want)
+        assert len(edges) == 2
+        for e, w in zip(edges, wedges):
+            np.testing.assert_allclose(np.asarray(e._value), w, rtol=1e-6)

@@ -223,3 +223,156 @@ def test_launch_jax_distributed_psum(tmp_path):
         data = json.loads(
             (tmp_path / f"jaxdist_rank{rank}.json").read_text())
         assert data == {"rank": rank, "psum": 6.0, "processes": 2}
+
+
+PAYLOAD_ELASTIC_RESUME = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    restart = int(os.environ["PADDLE_RESTART_COUNT"])
+    # fresh jax coordination port per generation (the previous coordinator
+    # socket may sit in TIME_WAIT after the failure)
+    host, _ = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+    port = int(os.environ["JAXDIST_BASE"]) + restart
+    os.environ["PADDLE_MASTER"] = f"{{host}}:{{port}}"
+
+    from paddle_tpu.distributed import env as denv
+    penv = denv.init_parallel_env(timeout_s=90)
+    world = jax.process_count()
+    rank = penv.rank
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                   load_state_dict)
+    from paddle_tpu.core.tensor import Tensor
+
+    D, K, M, LR = 16, 3, 4, 0.1
+    outdir = {outdir!r}
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    shard = NamedSharding(mesh, P("dp"))
+    per = D // world
+
+    def make_w(arr):
+        return jax.make_array_from_process_local_data(
+            shard, arr[rank * per:(rank + 1) * per])
+
+    def step_target(t):
+        return np.random.RandomState(100 + t).randn(D).astype(np.float32)
+
+    @jax.jit
+    def train_step(w, tgt):
+        # dp-sharded parameter: local grad, global (psum) loss
+        def local(wv, tv):
+            g = 2.0 * (wv - tv)
+            loss = jax.lax.psum(jnp.sum((wv - tv) ** 2), "dp")
+            return wv - LR * g, loss
+        return jax.shard_map(local, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                             out_specs=(P("dp"), P()))(w, tgt)
+
+    def tgt_arr(t):
+        return jax.make_array_from_process_local_data(
+            shard, step_target(t)[rank * per:(rank + 1) * per])
+
+    losses = []
+    if restart == 0:
+        w = make_w(np.zeros(D, np.float32))
+        start = 0
+        end = K
+    else:
+        # find the last step whose checkpoint completed
+        done = sorted(int(f.split("_")[1]) for f in os.listdir(outdir)
+                      if f.startswith("done_"))
+        last = done[-1]
+        w = make_w(np.zeros(D, np.float32))
+        sd = {{"w": Tensor(w)}}
+        load_state_dict(sd, os.path.join(outdir, f"ck_{{last}}"))
+        w = sd["w"]._value          # resharded onto the NEW (smaller) mesh
+        start = last + 1
+        end = K + M
+
+    for t in range(start, end):
+        w, loss = train_step(w, tgt_arr(t))
+        losses.append(float(loss))
+        ckdir = os.path.join(outdir, f"ck_{{t}}")
+        save_state_dict({{"w": Tensor(w)}}, ckdir)
+        # psum barrier: every rank's shard is on disk before the step counts
+        one = jax.make_array_from_process_local_data(
+            shard, np.ones(per, np.float32))
+        bar = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(jnp.sum(v), "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P()),
+            out_shardings=NamedSharding(mesh, P()))
+        assert float(bar(one)) == float(D)
+        if rank == 0:
+            open(os.path.join(outdir, f"done_{{t}}"), "w").close()
+
+    if restart == 0:
+        # generation 0: a worker is killed after step K-1; the collective
+        # failure tears down every process (exit 13 -> launcher restarts)
+        sys.exit(13)
+
+    if rank == 0:
+        with open(os.path.join(outdir, "result.json"), "w") as f:
+            json.dump({{"world": world, "resumed_from": start,
+                       "losses": losses}}, f)
+""")
+
+
+def test_elastic_resume_e2e(tmp_path):
+    """VERDICT r4 item 6, the whole §5.3+§5.4 flow in one test: 4-process
+    dp training with per-step sharded checkpoints; the job dies (a worker
+    is killed); the elastic launcher restarts at the SMALLER world (node 2
+    is gone for good); load_state_dict reshards the 4-way checkpoint onto
+    the 2-process mesh; training resumes and the loss sequence continues
+    exactly on the single-process oracle's trajectory."""
+    from paddle_tpu.distributed.launch.context import free_port
+    payload = tmp_path / "payload.py"
+    payload.write_text(PAYLOAD_ELASTIC_RESUME.format(
+        repo=REPO, outdir=str(tmp_path)))
+    master = f"127.0.0.1:{free_port()}"
+    os.environ["JAXDIST_BASE"] = str(free_port())
+    import threading
+    results = {}
+
+    def run_node(idx, max_restart):
+        results[idx] = run_launch(
+            ["--nnodes", "1:2", "--master", master, "--rank", str(idx),
+             "--nproc_per_node", "2", "--elastic_level", "1",
+             "--max_restart", str(max_restart),
+             "--log_dir", str(tmp_path / f"log{idx}"), str(payload)],
+            timeout=420)
+
+    try:
+        threads = [threading.Thread(target=run_node, args=(0, 2)),
+                   threading.Thread(target=run_node, args=(1, 0))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=420)
+    finally:
+        os.environ.pop("JAXDIST_BASE", None)
+
+    # node 1 (the killed worker's node) gave up; node 0 recovered
+    assert results[0].returncode == 0, (results[0].stdout,
+                                        results[0].stderr)
+    data = json.loads((tmp_path / "result.json").read_text())
+    assert data["world"] == 2
+    K, M, D, LR = 3, 4, 16, 0.1
+    assert data["resumed_from"] == K
+
+    # single-process oracle over the full parameter vector
+    w = __import__("numpy").zeros(D, dtype="float32")
+    import numpy as np
+    oracle = []
+    for t in range(K + M):
+        tgt = np.random.RandomState(100 + t).randn(D).astype(np.float32)
+        loss = float(np.sum((w - tgt) ** 2))
+        w = w - LR * 2.0 * (w - tgt)
+        oracle.append(loss)
+    np.testing.assert_allclose(data["losses"], oracle[K:], rtol=1e-5)
