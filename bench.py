@@ -143,9 +143,9 @@ def main():
     remat_mode = os.environ.get("BENCH_REMAT", "full")
     # legacy knob values from earlier rounds: 1 = full remat, 0 = off
     remat_mode = {"1": "full", "0": "off"}.get(remat_mode, remat_mode)
-    if remat_mode not in ("full", "dots", "attn", "off"):
+    if remat_mode not in ("full", "dots", "attn", "offload", "off"):
         sys.exit(f"unknown BENCH_REMAT={remat_mode!r}; "
-                 "pick from full|dots|attn|off")
+                 "pick from full|dots|attn|offload|off")
     step, init_fn = L.build_hybrid_train_step(
         cfg, mesh, learning_rate=1e-4, remat=remat_mode != "off",
         remat_policy=remat_mode if remat_mode != "off" else "full")

@@ -81,6 +81,8 @@ def flag_value(name: str) -> Any:
 define_flag("check_nan_inf", False, "Scan op outputs for nan/inf (debug pass).")
 define_flag("check_nan_inf_level", 0, "0: report all; higher levels reduce verbosity.")
 define_flag("use_pallas_kernels", True, "Use Pallas kernels on TPU (fall back to XLA ops otherwise).")
+define_flag("use_pallas_rms_norm", True, "Use the Pallas rms_norm kernel (isolated knob for dispatch decisions).")
+define_flag("use_pallas_layer_norm", False, "Use the fused Pallas LayerNorm kernel (round-4 experiment; engage per measured decision).")
 define_flag("deterministic", False, "Force deterministic compilation/reductions where possible.")
 define_flag("log_level", 0, "VLOG-style verbosity for framework-internal logging.")
 define_flag("benchmark", False, "Block on every op for timing (eager debugging).")

@@ -396,9 +396,9 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
     if pipeline_schedule not in ("fill_drain", "1f1b"):
         raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
-    if remat_policy not in ("full", "dots", "attn"):
+    if remat_policy not in ("full", "dots", "attn", "offload"):
         raise ValueError(f"unknown remat_policy {remat_policy!r} "
-                         "(expected 'full', 'dots' or 'attn')")
+                         "(expected 'full', 'dots', 'attn' or 'offload')")
     if pipeline_schedule == "1f1b":
         if mesh.shape.get("pp", 1) <= 1:
             raise ValueError("pipeline_schedule='1f1b' needs a pp axis > 1")
@@ -497,6 +497,18 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                         fn = jax.checkpoint(
                             fn, policy=jax.checkpoint_policies
                             .save_only_these_names("attn_out"))
+                    elif remat_policy == "offload":
+                        # VERDICT r3 item 9: stream the attention outputs
+                        # to pinned HOST memory during forward and fetch
+                        # them back for backward — no recompute, no HBM
+                        # residency (core/offload.py's memory kind)
+                        fn = jax.checkpoint(
+                            fn, policy=jax.checkpoint_policies
+                            .save_and_offload_only_these_names(
+                                names_which_can_be_saved=[],
+                                names_which_can_be_offloaded=["attn_out"],
+                                offload_src="device",
+                                offload_dst="pinned_host"))
                     else:
                         fn = jax.checkpoint(fn)
                 return fn(lp, carry, cos, sin), None

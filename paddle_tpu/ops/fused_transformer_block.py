@@ -44,7 +44,19 @@ _ACTS = {
 def layer_norm_array(x, scale=None, bias=None, eps=1e-5):
     """fp32-accumulated LayerNorm (fused by XLA; parity with the reference's
     in-kernel LN in fused_multi_transformer_op.cu.h:§0). scale/bias optional
-    so fused epilogues (bias_dropout_residual_ln) share ONE LN numerics."""
+    so fused epilogues (bias_dropout_residual_ln) share ONE LN numerics.
+
+    With FLAGS_use_pallas_layer_norm the scale+bias form routes through
+    the single-pass Pallas kernel (ops/layer_norm_fused.py)."""
+    if scale is not None and bias is not None:
+        from .layer_norm_fused import _use_pallas_ln, layer_norm_fused
+        from .rms_norm import _pick_block_rows
+        h = x.shape[-1]
+        rows = 1
+        for s_ in x.shape[:-1]:
+            rows *= s_
+        if _use_pallas_ln() and h % 128 == 0 and _pick_block_rows(rows, h):
+            return layer_norm_fused(x, scale, bias, eps)
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)

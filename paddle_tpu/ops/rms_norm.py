@@ -20,6 +20,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import use_pallas, next_multiple
+
+
+def _use_pallas_rms() -> bool:
+    # dedicated knob so the round-4 win-or-delete decision (VERDICT r3
+    # weak-4) can isolate rms_norm from the other Pallas kernels
+    from ..flags import flag_value
+    return use_pallas() and flag_value("use_pallas_rms_norm")
 from ..core.dispatch import apply
 
 
@@ -145,7 +152,7 @@ def _rms_fwd(x, w, eps):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    if use_pallas() and h % 128 == 0 and _pick_block_rows(rows, h):
+    if _use_pallas_rms() and h % 128 == 0 and _pick_block_rows(rows, h):
         x2 = x.reshape(rows, h)
         y = _pallas_fwd(x2, w, eps)
         return y.reshape(x.shape), (x, w)
@@ -158,7 +165,7 @@ def _rms_bwd(eps, res, g):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    if use_pallas() and h % 128 == 0 and _pick_block_rows(rows, h):
+    if _use_pallas_rms() and h % 128 == 0 and _pick_block_rows(rows, h):
         dx, dw = _pallas_bwd(x.reshape(rows, h), w, g.reshape(rows, h), eps)
         return dx.reshape(x.shape), dw.astype(w.dtype)
     xf = x.astype(jnp.float32)
