@@ -55,6 +55,18 @@ def convert_dtype(dtype) -> "np.dtype":
     return jnp.dtype(dtype)
 
 
+def canonical_dtype(dtype) -> "np.dtype":
+    """convert_dtype + x64-aware canonicalization: an int64/float64 request
+    maps to the platform default (int32/float32 with x64 disabled) silently,
+    instead of tripping jax's truncation warning at every astype."""
+    d = convert_dtype(dtype)
+    if d is None:
+        return None
+    import jax
+
+    return jax.dtypes.canonicalize_dtype(d)
+
+
 def dtype_name(dtype) -> str:
     return jnp.dtype(dtype).name
 

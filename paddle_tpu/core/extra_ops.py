@@ -251,25 +251,39 @@ def tensor_split(x, num_or_indices, axis=0, name=None) -> List[Tensor]:
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    """paddle.mode: most frequent value (+ its last index) along ``axis``."""
+    """paddle.mode: most frequent value (+ its last index) along ``axis``.
+
+    Sort-based: per-element frequencies come from searchsorted over the
+    sorted row (O(n log n) time, O(n) memory — not the O(n^2) pairwise
+    equality matrix). The mode maximises the count, ties resolved toward
+    the LARGEST index (paddle returns the last occurrence of the modal
+    value)."""
     def fn(v):
         ax = axis % v.ndim
         mv = jnp.moveaxis(v, ax, -1)
+        lead = mv.shape[:-1]
         n = mv.shape[-1]
-        # count matches per element; the mode maximises the count, ties
-        # resolved toward the LARGEST index (paddle returns the last
-        # occurrence of the modal value)
-        eq = mv[..., :, None] == mv[..., None, :]
-        counts = jnp.sum(eq, axis=-1)
+        flat = mv.reshape(-1, n)
+        sv = jnp.sort(flat, axis=-1)
+
+        def row_counts(srow, qrow):
+            hi = jnp.searchsorted(srow, qrow, side="right")
+            lo = jnp.searchsorted(srow, qrow, side="left")
+            return hi - lo
+
+        counts = jax.vmap(row_counts)(sv, flat)
         best = jnp.max(counts, axis=-1, keepdims=True)
-        is_best = counts == best
         idx = jnp.arange(n)
-        pick = jnp.max(jnp.where(is_best, idx, -1), axis=-1)
-        vals = jnp.take_along_axis(mv, pick[..., None], axis=-1)[..., 0]
+        pick = jnp.max(jnp.where(counts == best, idx, -1), axis=-1)
+        vals = jnp.take_along_axis(flat, pick[:, None], axis=-1)[:, 0]
+        vals = vals.reshape(lead)
+        pick = pick.reshape(lead)
         if keepdim:
             vals = jnp.expand_dims(vals, ax)
             pick = jnp.expand_dims(pick, ax)
-        return vals, pick.astype(jnp.int64)
+        # default int dtype (int32 unless x64 is enabled) — a hard int64
+        # cast silently truncates + warns when x64 is off
+        return vals, pick.astype(jax.dtypes.canonicalize_dtype(jnp.int64))
 
     return apply(fn, _t(x), op_name="mode", n_outputs=2)
 
@@ -333,18 +347,73 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None) -> Tensor:
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
     """paddle.histogramdd: D-dimensional histogram of an (N, D) sample.
-    Returns (hist, list_of_edges) — numpy.histogramdd semantics."""
-    v = np.asarray(_v(x))
-    w = None if weights is None else np.asarray(_v(weights))
+    Returns (hist, list_of_edges) — numpy.histogramdd semantics.
+
+    Device-side and trace-safe: binning is searchsorted + bincount in
+    jnp, so it works under jit (bin COUNTS stay static; edges may be
+    traced values) and never forces a device→host sync in eager mode."""
+    v = _v(x)
+    if v.ndim == 1:           # numpy promotes a 1-D sample to (N, 1)
+        v = v[:, None]
+    n_samples, ndim = v.shape
+    w = None if weights is None else _v(weights)
+
+    # resolve per-dimension bin counts (static) and edges (maybe traced)
     if isinstance(bins, (list, tuple)) and len(bins) and \
             not np.isscalar(bins[0]):
-        bins = [np.asarray(_v(b)) for b in bins]
-    rng = None
-    if ranges is not None:
-        r = list(ranges)
-        rng = [(float(r[2 * i]), float(r[2 * i + 1]))
-               for i in range(len(r) // 2)]
-    hist, edges = np.histogramdd(v, bins=bins, range=rng, density=density,
-                                 weights=w)
-    return (Tensor(jnp.asarray(hist.astype(np.float32))),
-            [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges])
+        edges = [_v(b).astype(jnp.float32) for b in bins]
+        nbins = [int(e.shape[0]) - 1 for e in edges]
+    else:
+        if np.isscalar(bins):
+            nbins = [int(bins)] * ndim
+        else:
+            nbins = [int(b) for b in bins]
+    if len(nbins) != ndim:
+        raise ValueError(
+            "The dimension of bins must be equal to the dimension of the "
+            f"sample x ({len(nbins)} vs {ndim}).")
+    if not (isinstance(bins, (list, tuple)) and len(bins)
+            and not np.isscalar(bins[0])):
+        if ranges is not None:
+            r = list(ranges)
+            lo = [jnp.float32(r[2 * i]) for i in range(ndim)]
+            hi = [jnp.float32(r[2 * i + 1]) for i in range(ndim)]
+        else:
+            lo = [jnp.min(v[:, d]).astype(jnp.float32) for d in range(ndim)]
+            hi = [jnp.max(v[:, d]).astype(jnp.float32) for d in range(ndim)]
+            # span is degenerate only when max == min: numpy then widens
+            # to [lo-0.5, hi+0.5]; any non-zero span is kept exactly
+            deg = [h == l for l, h in zip(lo, hi)]
+            lo = [jnp.where(d, l - 0.5, l) for d, l in zip(deg, lo)]
+            hi = [jnp.where(d, h + 0.5, h) for d, h in zip(deg, hi)]
+        edges = [jnp.linspace(lo[d], hi[d], nbins[d] + 1)
+                 for d in range(ndim)]
+
+    flat_idx = jnp.zeros((n_samples,), jnp.int32)
+    valid = jnp.ones((n_samples,), bool)
+    for d in range(ndim):
+        e = edges[d]
+        col = v[:, d].astype(e.dtype)
+        idx_d = jnp.searchsorted(e, col, side="right") - 1
+        # rightmost bin is closed on both sides (numpy semantics)
+        idx_d = jnp.where(col == e[-1], nbins[d] - 1, idx_d)
+        valid &= (col >= e[0]) & (col <= e[-1])
+        idx_d = jnp.clip(idx_d, 0, nbins[d] - 1)
+        flat_idx = flat_idx * nbins[d] + idx_d.astype(jnp.int32)
+
+    if w is None:
+        wv = valid.astype(jnp.float32)
+    else:
+        wv = jnp.where(valid, w.astype(jnp.float32), 0.0)
+    total = int(np.prod(nbins)) if nbins else 1
+    hist = jnp.bincount(flat_idx, weights=wv, length=total)
+    hist = hist.reshape(tuple(nbins))
+    if density:
+        hist = hist / jnp.sum(hist)
+        for d in range(ndim):
+            widths = jnp.diff(edges[d])
+            shape = [1] * ndim
+            shape[d] = nbins[d]
+            hist = hist / widths.reshape(shape)
+    return (Tensor(hist.astype(jnp.float32)),
+            [Tensor(e.astype(jnp.float32)) for e in edges])

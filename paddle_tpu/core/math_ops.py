@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .dispatch import apply, unwrap
-from .dtype import convert_dtype
+from .dtype import canonical_dtype, convert_dtype
 from .tensor import Tensor
 
 
@@ -195,13 +195,13 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    d = convert_dtype(dtype)
+    d = canonical_dtype(dtype)
     return apply(lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim).astype(d),
                  _t(x), op_name="argmax")
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    d = convert_dtype(dtype)
+    d = canonical_dtype(dtype)
     return apply(lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim).astype(d),
                  _t(x), op_name="argmin")
 
@@ -245,7 +245,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         if keepdim:
             vals = jnp.expand_dims(vals, axis)
             inds = jnp.expand_dims(inds, axis)
-        return vals, inds.astype(jnp.int64)
+        return vals, inds.astype(canonical_dtype("int64"))
     return apply(fn, _t(x), op_name="kthvalue")
 
 
@@ -567,7 +567,7 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
         else:
             vals, idx = jax.lax.top_k(-vm, k)
             vals = -vals
-        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(canonical_dtype("int64")))
     return apply(fn, _t(x), op_name="topk")
 
 
@@ -582,7 +582,7 @@ def argsort(x, axis=-1, descending=False, name=None):
     def fn(v):
         i = jnp.argsort(v, axis=axis)
         i = jnp.flip(i, axis=axis) if descending else i
-        return i.astype(jnp.int64)
+        return i.astype(canonical_dtype("int64"))
     return apply(fn, _t(x), op_name="argsort")
 
 

@@ -87,4 +87,16 @@ define_flag("deterministic", False, "Force deterministic compilation/reductions 
 define_flag("log_level", 0, "VLOG-style verbosity for framework-internal logging.")
 define_flag("benchmark", False, "Block on every op for timing (eager debugging).")
 define_flag("ring_attention_mode", "ring", "Long-context attention mode: 'ring' or 'ulysses'.")
+define_flag("dy2static_fallback", True,
+            "On ConversionError (or an untraceable predicate) under "
+            "to_static, warn and fall back to the eager path instead of "
+            "raising — the reference SOT's graceful-fallback behaviour. "
+            "Set to 0 for the strict raise.")
+define_flag("dy2static_rebind_wrappers", True,
+            "Allow dy2static conversion to re-bind a wraps-style "
+            "decorator's closure cell onto the converted function. The "
+            "rebind is PROCESS-WIDE: every call site of the shared wrapper "
+            "switches to the converted body. Set to 0 to keep the wrapper "
+            "untouched (its per-call behavior then only applies on the "
+            "unconverted object).")
 define_flag("remat_policy", "none", "Default rematerialisation policy: none|dots|everything.")

@@ -81,15 +81,22 @@ class StaticFunction:
     The wrapped fn passes through the dy2static AST rewrite first
     (jit/dy2static.py), so data-dependent Python ``if``/``while`` over
     Tensors lower to lax.cond / lax.while_loop instead of failing at trace
-    time — the SOT-conversion analog.
+    time — the SOT-conversion analog. When tracing still fails
+    (ConversionError or an untraceable predicate) and
+    ``FLAGS_dy2static_fallback`` is on (default), the call falls back to
+    the EAGER path with a warning and stays eager — the reference SOT's
+    graceful-fallback behaviour; ``FLAGS_dy2static_fallback=0`` restores
+    the strict raise.
     """
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  donate_params: bool = False):
         from .dy2static import convert_control_flow
+        self._orig_fn = fn
         self._fn = convert_control_flow(fn)
         self._layer = layer
         self._jitted = None
+        self._fallback = False
         self.guard = CompileGuard(getattr(fn, "__name__", "to_static"))
 
     def _build(self):
@@ -109,6 +116,8 @@ class StaticFunction:
         self._jitted = jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._orig_fn(*args, **kwargs)
         if self._jitted is None:
             self._build()
         params = param_arrays(self._layer) if self._layer else {}
@@ -116,7 +125,23 @@ class StaticFunction:
         key = _random.next_key()
         uargs, ukwargs = tree_unwrap(args), tree_unwrap(kwargs)
         self.guard.check(uargs, ukwargs)
-        out = self._jitted(params, buffers, key, uargs, ukwargs)
+        from .dy2static import ConversionError
+        try:
+            out = self._jitted(params, buffers, key, uargs, ukwargs)
+        except (ConversionError, jax.errors.ConcretizationTypeError) as e:
+            from ..flags import flag_value
+            if not flag_value("dy2static_fallback"):
+                raise
+            import warnings
+            warnings.warn(
+                f"{self.guard.name}: tracing failed "
+                f"({type(e).__name__}: {str(e).splitlines()[0]}); falling "
+                "back to the EAGER path for this and future calls — the "
+                "function will not be compiled "
+                "(FLAGS_dy2static_fallback=0 restores the strict raise)",
+                stacklevel=2)
+            self._fallback = True
+            return self._orig_fn(*args, **kwargs)
         return tree_wrap(out)
 
     @property

@@ -85,11 +85,14 @@ def check_unconvertible(pred, loc: str, reason: str):
     return bool(p)
 
 
-def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = ""):
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = "",
+                   names=None):
     """Runtime dispatch for a rewritten ``if`` statement.
 
     Both branch fns take no arguments (they close over the local scope) and
-    return the tuple of names assigned in either branch.
+    return the tuple of names assigned in either branch. ``names`` (when
+    provided by the rewriter) labels that tuple position-by-position so
+    synthetic conversion temporaries can be recognised at runtime.
     """
     p = pred._value if isinstance(pred, Tensor) else pred
     if not isinstance(p, jax.core.Tracer):
@@ -109,6 +112,25 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, loc: str = ""):
             f"{loc}: {e} while tracing both branches of a data-dependent "
             "`if` — a variable assigned in only one branch must be "
             "initialised before the `if`") from e
+    if names is not None and isinstance(t_out, tuple) \
+            and isinstance(f_out, tuple):
+        # A synthetic __dy2st_* temporary (a nested conversion's range
+        # triple / index var / inner escape flag) that one branch binds
+        # and the other leaves UNDEFINED is branch-LOCAL: it is re-
+        # initialised before any later use, so its post-if value is dead.
+        # Mirror the bound side across instead of demanding both branches
+        # bind it. User names keep the strict same-structure error below.
+        t_out, f_out = list(t_out), list(f_out)
+        for i, name in enumerate(names):
+            if not name.startswith("__dy2st_"):
+                continue
+            if isinstance(t_out[i], _Undefined) and \
+                    not isinstance(f_out[i], _Undefined):
+                t_out[i] = f_out[i]
+            elif isinstance(f_out[i], _Undefined) and \
+                    not isinstance(t_out[i], _Undefined):
+                f_out[i] = t_out[i]
+        t_out, f_out = tuple(t_out), tuple(f_out)
     tu, fu = _unwrap(t_out), _unwrap(f_out)
     t_struct = jax.tree_util.tree_structure(tu)
     f_struct = jax.tree_util.tree_structure(fu)
@@ -155,7 +177,13 @@ def convert_bool_op(op: str, loc: str, *thunks):
     short-circuit semantics (including returning the operand itself, not a
     bool). The first TRACED operand ends short-circuiting: the remaining
     operands are evaluated and folded with logical_and/or into a boolean
-    tensor (the reference SOT's behaviour for tensor predicates)."""
+    tensor (the reference SOT's behaviour for tensor predicates).
+
+    DOCUMENTED DIVERGENCE: once an operand is traced, every later operand
+    is evaluated eagerly — a guard like ``t_cond and x / y > 0`` divides
+    even when ``t_cond`` would be false, so side effects/exceptions fire
+    where Python's short-circuit would have skipped them. Exceptions from
+    a post-trace operand are annotated with the conversion location."""
     val = thunks[0]()
     for i, t in enumerate(thunks[1:], 1):
         raw = val._value if isinstance(val, Tensor) else val
@@ -170,7 +198,18 @@ def convert_bool_op(op: str, loc: str, *thunks):
             continue
         acc = jnp.asarray(raw).astype(bool)
         for t2 in thunks[i:]:
-            v2 = t2()
+            try:
+                v2 = t2()
+            except Exception as e:
+                if hasattr(e, "add_note"):
+                    e.add_note(
+                        f"dy2static {loc}: an earlier operand of this "
+                        f"`{op}` is a traced tensor, so short-circuit "
+                        "evaluation does not apply — later operands run "
+                        "unconditionally under tracing. Guard the "
+                        "failing operand (e.g. hoist it above the "
+                        "bool-op) if it must be skipped.")
+                raise
             v2 = v2._value if isinstance(v2, Tensor) else v2
             nxt = jnp.asarray(v2).astype(bool)
             acc = (jnp.logical_and(acc, nxt) if op == "and"
@@ -228,6 +267,28 @@ def check_iterable(it, loc: str):
             "convertible; loop over `range(n)` and index, or use a "
             "tensor op (scan/vmap)")
     return it
+
+
+def convert_ret_select(loc, default_fn, *sites):
+    """Single-exit return selector planted by the return-in-loop lowering.
+
+    ``sites`` are ``(flag, value_thunk)`` pairs, one per lowered ``return``
+    statement, in source order. The guards the lowering plants make the
+    flags mutually exclusive (once a return fires, every later flag's code
+    is skipped/broken out of), so fold order is irrelevant. Concrete flags
+    reproduce Python exactly (only the fired site's thunk runs); any traced
+    flag evaluates every thunk and selects via lax.cond."""
+    if not any(_is_traced(f) for f, _ in sites):
+        for f, th in sites:
+            raw = f._value if isinstance(f, Tensor) else f
+            if bool(raw):
+                return th()
+        return default_fn()
+    out = default_fn()
+    for f, th in sites:
+        val = th()
+        out = convert_ifelse(f, lambda v=val: v, lambda o=out: o, loc)
+    return out
 
 
 def convert_while(cond_fn: Callable, body_fn: Callable, carry, loc: str = ""):
@@ -318,6 +379,15 @@ def _store_names(nodes) -> set:
             if isinstance(node.ctx, (ast.Store, ast.Del)):
                 found.add(node.id)
 
+        def visit_Subscript(self, node):
+            # `out[t] = v` / `out[t] += v` rebinds out's VALUE: the base
+            # name must ride the carry or the in-place write inside the
+            # converted body leaks a tracer into the closed-over object
+            if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    isinstance(node.value, ast.Name):
+                found.add(node.value.id)
+            self.generic_visit(node)
+
     for n in nodes:
         V().visit(n)
     return found
@@ -385,6 +455,232 @@ def _has(nodes, kinds, prune_loops: bool = False) -> ast.AST:
         if hit:
             return hit[0]
     return None
+
+
+class _ReturnLowering:
+    """Single-exit rewrite for ``return`` inside loops — the reference
+    dy2static ReturnTransformer's role (python/paddle/jit/dy2static/
+    transformers/return_transformer.py:§0), built on the same flag
+    machinery as break/continue lowering.
+
+    Every ``return expr`` whose nearest enclosing construct chain reaches
+    a While/For becomes ``__ret_flag_N = True; break`` — the break rides
+    the existing escape lowering — and ``expr`` is RECORDED, not
+    evaluated: because the break exits immediately, the loop-carried
+    state at the post-loop program point equals the state at the return
+    site, so the expr evaluates identically there (every name a loop
+    body assigns is loop-carried by the while conversion). Spine
+    statements after a flagging loop are wrapped in ``if not (flags):``
+    guards, and the function gains a single trailing
+    ``return __dy2st_ret_select(...)`` that picks the fired site's value
+    (or the original fall-through return) — see
+    :func:`convert_ret_select`.
+
+    Returns inside ``try``/``match`` blocks of a converted loop raise
+    ConversionError (→ eager fallback when enabled)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.n = 0
+
+    def _loc(self, node) -> str:
+        return f"{self.filename}:{getattr(node, 'lineno', '?')}"
+
+    @staticmethod
+    def _flags_or(flags):
+        if not flags:
+            raise ConversionError(
+                "internal: return-lowering produced an empty flag set")
+        names = [ast.Name(id=f, ctx=ast.Load()) for f in flags]
+        return names[0] if len(names) == 1 else \
+            ast.BoolOp(op=ast.Or(), values=names)
+
+    @staticmethod
+    def _loop_has_return(stmts) -> bool:
+        """Is there a Return nested inside any While/For (pruning defs)?"""
+        found = []
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(self, node):  # prune
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+            def visit_While(self, node):
+                found.append(node)
+                self.generic_visit(node)
+
+            visit_For = visit_While
+            visit_AsyncFor = visit_While
+
+        for s in stmts:
+            V().visit(s)
+        return any(_has(lp.body, ast.Return) is not None for lp in found)
+
+    @staticmethod
+    def _thunk(expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def run(self, fdef) -> bool:
+        """Apply in place; True when the function was rewritten."""
+        if not self._loop_has_return(fdef.body):
+            return False
+        fdef.body = self._transform_block(list(fdef.body), fdef.lineno)
+        ast.fix_missing_locations(fdef)
+        return True
+
+    def _transform_block(self, stmts, lineno):
+        """Bring a function-level block into single-exit form: the first
+        statement whose loops carry a lowered ``return`` splits the
+        block — everything after it moves into a ``__dy2st_tail_N``
+        closure (evaluated only when no lowered return fired, so names
+        FIRST bound after the loop stay ordinary locals of the tail) and
+        the block ends with ``return __dy2st_ret_select(...)``. Blocks
+        with no return-carrying loop are returned unchanged."""
+        for i, st in enumerate(stmts):
+            sites: list = []
+            if isinstance(st, (ast.While, ast.For)) and \
+                    _has(st.body, ast.Return) is not None:
+                st.body = self._lower_in_loop(st.body, sites) or [ast.Pass()]
+            elif isinstance(st, ast.If) and self._loop_has_return([st]):
+                # a loop-with-return nested in an if branch: flags set
+                # inside propagate out of the converted if (plain stored
+                # names); statements after the loop within the branch are
+                # guarded by _lower_branch
+                st.body = self._lower_branch(list(st.body), sites)
+                st.orelse = self._lower_branch(list(st.orelse), sites)
+            if not sites:
+                continue
+            inits = [ast.copy_location(ast.Assign(
+                targets=[ast.Name(id=f, ctx=ast.Store())],
+                value=ast.Constant(value=False)), st)
+                for f, _ in sites]
+            remainder = stmts[i + 1:]
+            return (stmts[:i] + inits + [st]
+                    + self._make_tail_return(remainder, sites, st))
+        return stmts
+
+    def _make_tail_return(self, remainder, sites, anchor):
+        """Build ``[<preamble>, def __dy2st_tail_N(...), return
+        __dy2st_ret_select(loc, tail, *sites)]``. The tail closure holds
+        the whole post-loop remainder (recursively transformed), so its
+        natural ``return`` stays inside it and new names bind as tail
+        locals; stores that shadow pre-loop names are snapshot as default
+        arguments (the _make_call pattern) to dodge UnboundLocalError."""
+        loc = self._loc(anchor)
+        out = []
+        if remainder:
+            stores = sorted(_store_names(remainder))
+            tail_name = f"__dy2st_tail_{self.n}"
+            self.n += 1
+            tail_body = self._transform_block(remainder, anchor.lineno) \
+                or [ast.Pass()]
+            tail_def = ast.FunctionDef(
+                name=tail_name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=a) for a in stores],
+                    kwonlyargs=[], kw_defaults=[],
+                    defaults=[ast.Name(id=a, ctx=ast.Load())
+                              for a in stores]),
+                body=tail_body, decorator_list=[], type_params=[])
+            out += _RewriteControlFlow._undef_preamble(stores)
+            out.append(tail_def)
+            default = ast.Name(id=tail_name, ctx=ast.Load())
+        else:
+            default = self._thunk(ast.Constant(value=None))
+        sel = ast.Return(value=ast.Call(
+            func=ast.Name(id="__dy2st_ret_select", ctx=ast.Load()),
+            args=[ast.Constant(value=loc), default]
+            + [ast.Tuple(elts=[ast.Name(id=f, ctx=ast.Load()),
+                               self._thunk(e)], ctx=ast.Load())
+               for f, e in sites],
+            keywords=[]))
+        out.append(sel)
+        return [ast.copy_location(s, anchor) for s in out]
+
+    def _lower_branch(self, block, sites):
+        """Inside an if-branch on the spine (no ``break`` available, no
+        early block exit): lower return-carrying loops; statements after
+        one are wrapped in ``if not (<its flags>):`` so they are skipped
+        once a return fired. Plain direct Returns stay (the branch
+        conversion or the eager fallback owns them)."""
+        for i, st in enumerate(block):
+            local: list = []
+            if isinstance(st, (ast.While, ast.For)) and \
+                    _has(st.body, ast.Return) is not None:
+                st.body = self._lower_in_loop(st.body, local) or [ast.Pass()]
+            elif isinstance(st, ast.If) and self._loop_has_return([st]):
+                st.body = self._lower_branch(list(st.body), local)
+                st.orelse = self._lower_branch(list(st.orelse), local)
+            if not local:
+                continue
+            sites.extend(local)
+            rest = self._lower_branch(block[i + 1:], sites)
+            out = block[:i + 1]
+            if rest:
+                guard = ast.If(
+                    test=ast.UnaryOp(
+                        op=ast.Not(),
+                        operand=self._flags_or([f for f, _ in local])),
+                    body=rest, orelse=[])
+                out.append(ast.copy_location(guard, st))
+            return out
+        return block
+
+    def _lower_in_loop(self, block, sites):
+        """Inside a loop body: Return -> flag + break (dead code after a
+        return in the same block is dropped; the later escape lowering
+        guards cross-statement paths)."""
+        out = []
+        for st in block:
+            if isinstance(st, ast.Return):
+                flag = f"__ret_flag_{self.n}"
+                self.n += 1
+                expr = st.value if st.value is not None \
+                    else ast.Constant(value=None)
+                sites.append((flag, expr))
+                out.append(ast.copy_location(ast.Assign(
+                    targets=[ast.Name(id=flag, ctx=ast.Store())],
+                    value=ast.Constant(value=True)), st))
+                out.append(ast.copy_location(ast.Break(), st))
+                return out
+            if isinstance(st, ast.If) and _has([st], ast.Return) is not None:
+                st.body = self._lower_in_loop(st.body, sites) or [ast.Pass()]
+                st.orelse = self._lower_in_loop(st.orelse, sites)
+                out.append(st)
+                continue
+            if isinstance(st, (ast.While, ast.For)) and \
+                    _has(st.body, ast.Return) is not None:
+                inner: list = []
+                st.body = self._lower_in_loop(st.body, inner) or [ast.Pass()]
+                out.append(st)
+                if inner:
+                    sites.extend(inner)
+                    # the fired return must escape THIS loop too
+                    out.append(ast.copy_location(ast.If(
+                        test=self._flags_or([f for f, _ in inner]),
+                        body=[ast.Break()], orelse=[]), st))
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)) and \
+                    _has([st], ast.Return) is not None:
+                st.body = self._lower_in_loop(st.body, sites) or [ast.Pass()]
+                out.append(st)
+                continue
+            if isinstance(st, (ast.Try, ast.Match)) and \
+                    _has([st], ast.Return) is not None:
+                raise ConversionError(
+                    f"{self._loc(st)}: `return` inside a "
+                    f"{type(st).__name__.lower()} block of a converted "
+                    "loop is not supported; move the return out of the "
+                    "block")
+            out.append(st)
+        return out
 
 
 class _RewriteControlFlow(ast.NodeTransformer):
@@ -520,13 +816,19 @@ class _RewriteControlFlow(ast.NodeTransformer):
 
         tfn = branch(f"__dy2st_true_{n}", body)
         ffn = branch(f"__dy2st_false_{n}", orelse)
+        kw = []
+        if not returning and names:
+            kw.append(ast.keyword(
+                arg="names",
+                value=ast.Tuple(elts=[ast.Constant(value=a) for a in names],
+                                ctx=ast.Load())))
         call = ast.Call(
             func=ast.Name(id="__dy2st_convert_ifelse", ctx=ast.Load()),
             args=[self._convert_bool_expr(node.test, self._loc(node)),
                   ast.Name(id=tfn.name, ctx=ast.Load()),
                   ast.Name(id=ffn.name, ctx=ast.Load()),
                   ast.Constant(value=self._loc(node))],
-            keywords=[])
+            keywords=kw)
         return [tfn, ffn], call
 
     # -- break/continue flag lowering ---------------------------------------
@@ -853,7 +1155,19 @@ def convert_control_flow(fn: Callable) -> Callable:
     # function after the rewrite — see the `orig is not fn` tail
     # (ADVICE r3 #5).
     fdef.decorator_list = []
-    new_tree = _RewriteControlFlow(filename).visit(tree)
+    try:
+        _ReturnLowering(filename).run(fdef)
+        new_tree = _RewriteControlFlow(filename).visit(tree)
+    except ConversionError as e:
+        from ..flags import flag_value
+        if flag_value("dy2static_fallback"):
+            warnings.warn(
+                f"dy2static: conversion of "
+                f"{getattr(fn, '__name__', fn)!r} failed ({e}); falling "
+                "back to the eager path (set FLAGS_dy2static_fallback=0 "
+                "for the strict raise)", stacklevel=2)
+            return orig
+        raise
     ast.fix_missing_locations(new_tree)
     glb = dict(fn.__globals__)
     glb["__dy2st_convert_ifelse"] = convert_ifelse
@@ -865,6 +1179,7 @@ def convert_control_flow(fn: Callable) -> Callable:
     glb["__dy2st_range_args"] = convert_range_args
     glb["__dy2st_range_cont"] = convert_range_cont
     glb["__dy2st_check_iterable"] = check_iterable
+    glb["__dy2st_ret_select"] = convert_ret_select
     freevars = fn.__code__.co_freevars
     if freevars:
         # re-bind the original closure: wrap the rewritten def in a factory
@@ -898,9 +1213,29 @@ def convert_control_flow(fn: Callable) -> Callable:
         # behavior by pointing the wrapper that calls ``fn`` at the
         # converted function: find its closure cell holding ``fn`` and
         # re-bind it. The converted body is semantically identical eagerly,
-        # so mutating the shared cell is safe. If no such cell exists (the
-        # decorator stashed ``fn`` somewhere opaque), warn — never drop
-        # silently (ADVICE r3 #5).
+        # so mutating the shared cell is safe — but NOTE the rebind is
+        # PROCESS-WIDE: every other call site of the shared wrapper object
+        # switches to the converted body too (including its zero-trip-loop
+        # target binding and bool-op eager-eval deviations). Gate:
+        # FLAGS_dy2static_rebind_wrappers=0 keeps the wrapper untouched and
+        # returns the converted function bare (the wrapper's per-call
+        # behavior then only runs on the unconverted object). If no cell
+        # holding ``fn`` exists (the decorator stashed it somewhere
+        # opaque), warn — never drop silently (ADVICE r3 #5, r4 #2).
+        from ..flags import flag_value
+        if not flag_value("dy2static_rebind_wrappers"):
+            warnings.warn(
+                f"dy2static: FLAGS_dy2static_rebind_wrappers=0 — the "
+                f"decorator wrapping {getattr(orig, '__name__', orig)!r} "
+                "is left untouched and its per-call behavior is dropped "
+                "from the converted path", stacklevel=2)
+            return new_fn
+        import logging
+        logging.getLogger(__name__).debug(
+            "dy2static: re-binding the wrapper chain of %r onto the "
+            "converted function (process-wide effect on the shared "
+            "wrapper; FLAGS_dy2static_rebind_wrappers=0 disables)",
+            getattr(orig, "__name__", orig))
         link = orig
         while link is not None and link is not fn:
             for cell in (getattr(link, "__closure__", None) or ()):

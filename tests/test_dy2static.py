@@ -7,6 +7,8 @@ cond/while ops, and the bucketing policy for ragged shapes (SURVEY.md §2.5
 dy2static + CINN rows).
 """
 
+import warnings
+
 import numpy as np
 import pytest
 import jax
@@ -75,9 +77,19 @@ class TestIfConversion:
                 y = x * 2.0
             return y  # noqa: F821 — y undefined when branch not taken
 
-        sf = jit.to_static(f)
-        with pytest.raises(ConversionError, match="initialise"):
-            sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        # strict mode keeps the round-3 actionable raise
+        paddle.set_flags({"FLAGS_dy2static_fallback": 0})
+        try:
+            sf = jit.to_static(f)
+            with pytest.raises(ConversionError, match="initialise"):
+                sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_dy2static_fallback": 1})
+        # default mode (r5): falls back to eager and produces the value
+        sf2 = jit.to_static(f)
+        with pytest.warns(UserWarning, match="EAGER"):
+            out = sf2(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value), 2.0)
 
     def test_unconvertible_return_pattern_diagnostic(self):
         def f(x):
@@ -86,9 +98,17 @@ class TestIfConversion:
             x = x + 1
             return x
 
-        sf = jit.to_static(f)
-        with pytest.raises(ConversionError, match="single return"):
-            sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        paddle.set_flags({"FLAGS_dy2static_fallback": 0})
+        try:
+            sf = jit.to_static(f)
+            with pytest.raises(ConversionError, match="single return"):
+                sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_dy2static_fallback": 1})
+        sf2 = jit.to_static(f)
+        with pytest.warns(UserWarning, match="EAGER"):
+            out = sf2(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value), 1.0)
 
     def test_one_armed_concrete_if_preserves_name_semantics(self):
         """A variable assigned only under a concrete-False `if` must stay
@@ -195,20 +215,22 @@ class TestWhileConversion:
         # 4 per iteration; breaks once the sum reaches >= 6 (two rounds)
         np.testing.assert_allclose(out, 2.0 * np.ones(4))
 
-    def test_while_with_return_diagnostic(self):
-        """return inside a data-dependent while stays unconvertible with
-        the actionable error."""
+    def test_while_with_return_converts(self):
+        """round-5: return inside a data-dependent while CONVERTS via the
+        single-exit flag lowering (was a diagnostic raise through r4)."""
         def f(x):
             s = x * 0.0
             while (s.sum() < 10.0):
                 s = s + x
-                if False:
-                    return s
+                if (s.sum() > 6.0):
+                    return s * -1.0
             return s
 
         sf = jit.to_static(f)
-        with pytest.raises(ConversionError, match="return"):
-            sf(paddle.to_tensor(np.ones((4,), np.float32)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # no fallback warning allowed
+            out = sf(paddle.to_tensor(np.ones((4,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value), -2.0 * np.ones(4))
 
     def test_concrete_while_unchanged(self):
         def f(x, n=3):
