@@ -21,6 +21,7 @@ from .common_layers import (  # noqa: F401
 from .rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, RNN, SimpleRNNCell, LSTMCell, GRUCell,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

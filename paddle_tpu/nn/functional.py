@@ -1673,3 +1673,7 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
         raise NotImplementedError("max_unpool3d: only NCDHW")
     return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
                           output_size, "max_unpool3d")
+
+
+# -- beam search backtrack (paddle.nn.functional.gather_tree) ----------------
+from .decode import gather_tree  # noqa: E402,F401

@@ -1,0 +1,180 @@
+"""BeamSearchDecoder / dynamic_decode / gather_tree (VERDICT r4 missing #6).
+
+Oracle: an independent numpy beam search over the same GRU cell weights —
+step-by-step expansion with explicit sorting, no shared code with the
+jax implementation."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _np_gru_step(params, x, h):
+    wih, whh, bih, bhh = params
+    hs = whh.shape[1]
+    xg = x @ wih.T + bih
+    hg = h @ whh.T + bhh
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    r = sig(xg[:, :hs] + hg[:, :hs])
+    z = sig(xg[:, hs:2 * hs] + hg[:, hs:2 * hs])
+    c = np.tanh(xg[:, 2 * hs:] + r * hg[:, 2 * hs:])
+    return (1 - z) * c + z * h
+
+
+def _np_beam_search(cell_params, emb, proj_w, proj_b, h0, start, end,
+                    beam, steps):
+    """Reference beam search for ONE batch row: returns (sequences, scores)
+    sorted best-first, sequences padded with end after finish."""
+    def log_softmax(v):
+        v = v - v.max()
+        return v - np.log(np.exp(v).sum())
+
+    # beams: list of (tokens, logp, h, finished)
+    beams = [([], 0.0, h0.copy(), False)]
+    for _ in range(steps):
+        cands = []
+        for toks, lp, h, fin in beams:
+            if fin:
+                cands.append((toks + [end], lp, h, True))
+                continue
+            prev = toks[-1] if toks else start
+            x = emb[prev][None, :]
+            h2 = _np_gru_step(cell_params, x, h[None, :])[0]
+            logits = h2 @ proj_w.T + proj_b
+            lps = log_softmax(logits.astype(np.float64))
+            for v in range(len(lps)):
+                cands.append((toks + [v], lp + lps[v], h2,
+                              v == end))
+        cands.sort(key=lambda c: -c[1])
+        beams = cands[:beam]
+    return ([c[0] for c in beams], [c[1] for c in beams])
+
+
+class TestBeamSearch:
+    def _make(self, vocab=7, hidden=12, emb_dim=5, seed=0):
+        rs = np.random.RandomState(seed)
+        cell = nn.GRUCell(emb_dim, hidden)
+        embedding = nn.Embedding(vocab, emb_dim)
+        proj = nn.Linear(hidden, vocab)
+        # randomize deterministic weights
+        for p in list(cell.parameters()) + list(embedding.parameters()) \
+                + list(proj.parameters()):
+            p.set_value(rs.randn(*p.shape).astype(np.float32) * 0.7)
+        return cell, embedding, proj, rs
+
+    def test_matches_numpy_oracle(self):
+        vocab, hidden, beam, steps = 7, 12, 3, 5
+        cell, embedding, proj, rs = self._make(vocab, hidden)
+        batch = 2
+        h0 = rs.randn(batch, hidden).astype(np.float32)
+
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=0, end_token=vocab - 1, beam_size=beam,
+            embedding_fn=embedding, output_fn=proj)
+        outs, final = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(h0), max_step_num=steps)
+        got_ids = np.asarray(outs._value)              # (batch, T, beam)
+        got_scores = np.asarray(final.log_probs._value)
+
+        cp = [np.asarray(p._value) for p in
+              (cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh)]
+        ew = np.asarray(embedding.weight._value)
+        pw = np.asarray(proj.weight._value).T          # paddle Linear: x@W
+        pb = np.asarray(proj.bias._value)
+        for b in range(batch):
+            seqs, scores = _np_beam_search(
+                cp, ew, pw, pb, h0[b], 0, vocab - 1, beam, steps)
+            for k in range(beam):
+                np.testing.assert_array_equal(got_ids[b, :, k], seqs[k])
+                np.testing.assert_allclose(got_scores[b, k], scores[k],
+                                           rtol=2e-4)
+
+    def test_beam1_equals_greedy(self):
+        vocab, hidden = 9, 8
+        cell, embedding, proj, rs = self._make(vocab, hidden, seed=4)
+        h0 = rs.randn(1, hidden).astype(np.float32)
+        dec = nn.BeamSearchDecoder(cell, 0, vocab - 1, 1,
+                                   embedding_fn=embedding, output_fn=proj)
+        outs, _ = nn.dynamic_decode(dec, inits=paddle.to_tensor(h0),
+                                    max_step_num=6)
+        got = np.asarray(outs._value)[0, :, 0]
+
+        # stepwise greedy with the same layers
+        h = paddle.to_tensor(h0)
+        tok = paddle.to_tensor(np.asarray([0], np.int32))
+        want = []
+        for _ in range(6):
+            out, h = cell(embedding(tok), h)
+            tok = paddle.argmax(proj(out), axis=-1).astype("int32")
+            want.append(int(np.asarray(tok._value)[0]))
+            if want[-1] == vocab - 1:
+                want += [vocab - 1] * (6 - len(want))
+                break
+        np.testing.assert_array_equal(got, want)
+
+    def test_end_token_freezes_beam(self):
+        """Once a beam emits end_token its score must stop changing and it
+        must keep emitting end_token."""
+        vocab, hidden, beam = 5, 6, 4
+        cell, embedding, proj, rs = self._make(vocab, hidden, seed=7)
+        # bias the projection hard toward end_token so beams finish early
+        bias = np.zeros(vocab, np.float32)
+        bias[vocab - 1] = 4.0
+        proj.bias.set_value(bias)
+        h0 = rs.randn(1, hidden).astype(np.float32)
+        dec = nn.BeamSearchDecoder(cell, 0, vocab - 1, beam,
+                                   embedding_fn=embedding, output_fn=proj)
+        outs, final, lengths = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(h0), max_step_num=8,
+            return_length=True)
+        ids = np.asarray(outs._value)[0]               # (T, beam)
+        lens = np.asarray(lengths._value)[0]
+        for k in range(beam):
+            L = int(lens[k])
+            assert L <= 8
+            # after its length, a finished beam pads with end_token
+            assert (ids[L:, k] == vocab - 1).all()
+
+    def test_gather_tree_matches_manual(self):
+        ids = np.asarray([[[2, 5], [3, 4]],
+                          [[6, 7], [8, 9]],
+                          [[1, 0], [2, 3]]], np.int32)     # (T=3, B=2, K=2)
+        parents = np.asarray([[[0, 0], [0, 0]],
+                              [[1, 0], [0, 1]],
+                              [[0, 1], [1, 0]]], np.int32)
+        got = np.asarray(
+            paddle.nn.functional.gather_tree(
+                paddle.to_tensor(ids), paddle.to_tensor(parents))._value)
+        t, b, k = ids.shape
+        want = np.zeros_like(ids)
+        for bb in range(b):
+            for kk in range(k):
+                beam = kk
+                for tt in range(t - 1, -1, -1):
+                    want[tt, bb, kk] = ids[tt, bb, beam]
+                    beam = parents[tt, bb, beam]
+        np.testing.assert_array_equal(got, want)
+
+    def test_under_jit(self):
+        """The whole decode compiles as one program (scan-based)."""
+        vocab, hidden = 6, 8
+        cell, embedding, proj, rs = self._make(vocab, hidden, seed=2)
+        h0 = rs.randn(2, hidden).astype(np.float32)
+        dec = nn.BeamSearchDecoder(cell, 0, vocab - 1, 2,
+                                   embedding_fn=embedding, output_fn=proj)
+
+        def run(h):
+            outs, _ = nn.dynamic_decode(dec, inits=paddle.to_tensor(h),
+                                        max_step_num=4)
+            return outs._value
+
+        got = np.asarray(jax.jit(run)(jnp.asarray(h0)))
+        want = np.asarray(run(jnp.asarray(h0)))
+        np.testing.assert_array_equal(got, want)
