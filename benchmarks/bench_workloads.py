@@ -266,16 +266,21 @@ def bench_decode():
     from paddle_tpu.inference.decoding import (GenerationConfig,
                                                llama_engine)
 
+    gqa = os.environ.get("BENCH_DECODE_GQA") == "1"
     if smoke:
         cfg = L.llama_tiny(num_hidden_layers=2)
         B, T, new = 2, 16, 8
     else:
         # the 876M serving config (wide3072) in bf16 — decode is
-        # HBM-bandwidth-bound, so tokens/s tracks bytes-of-weights/step
+        # HBM-bandwidth-bound, so tokens/s tracks bytes-of-weights/step.
+        # BENCH_DECODE_GQA=1: nkv = nh/4 (VERDICT r4 missing #4) — smaller
+        # KV projections AND a 4x smaller KV cache to stream per step,
+        # exactly where serving bandwidth wins live
         cfg = L.LlamaConfig(
             vocab_size=32000, hidden_size=3072, intermediate_size=8192,
             num_hidden_layers=6, num_attention_heads=24,
-            num_key_value_heads=24, max_position_embeddings=2048,
+            num_key_value_heads=6 if gqa else 24,
+            max_position_embeddings=2048,
             dtype=jnp.bfloat16)
         B, T, new = 8, 512, 128
 
@@ -316,11 +321,12 @@ def bench_decode():
     total_bytes = sum(leaf_bytes(v) for v in params.values())
     bytes_per_tok = total_bytes / B               # amortised over batch
     return {"metric": "llama_876M_serving_decode"
-            + ("_int8" if int8_mode else ""),
+            + ("_int8" if int8_mode else "") + ("_gqa" if gqa else ""),
             "prefill_ms": round(t_prefill * 1e3, 1),
             "decode_tokens_per_sec": round(decode_tok_s, 1),
             "per_seq_tokens_per_sec": round(decode_tok_s / B, 1),
             "hbm_gbps_implied": round(decode_tok_s * bytes_per_tok / 1e9, 1),
+            "num_kv_heads": cfg.num_key_value_heads,
             "batch": B, "prompt": T, "new_tokens": new}
 
 
@@ -480,7 +486,45 @@ def bench_vit():
             x = x.astype("bfloat16")
         y = paddle.to_tensor(rng.randint(0, 10 if smoke else 1000,
                                          (B,)).astype(np.int64))
-        run = lambda: tstep(x, y)
+        kstep = 1 if smoke else int(os.environ.get("BENCH_VIT_KSTEP", "1"))
+        if kstep > 1:
+            # VERDICT r4 next-round #3: jit k TRAINING STEPS per host fence
+            # (lax.scan over k microbatches with donated carry) — amortizes
+            # the ~11 ms/step axon-tunnel dispatch gap PROFILE_vit_r4
+            # measured. Distinct from the rejected per-LAYER stacked scan.
+            from jax import lax
+            from paddle_tpu.jit.functional import (param_arrays,
+                                                   buffer_arrays)
+            from paddle_tpu import random as _prand
+            inner = tstep._make_step_fn()
+
+            def multi(params, opt_state, buffers, xs, ys, lr, step_i, keys):
+                def body(carry, inp):
+                    p, o, b, si = carry
+                    x_, y_, kk = inp
+                    loss, p, o, b = inner(p, o, b, (x_, y_), lr, si, kk)
+                    return (p, o, b, si + 1), loss
+
+                (p, o, b, si), losses = lax.scan(
+                    body, (params, opt_state, buffers, step_i),
+                    (xs, ys, keys))
+                return losses[-1], p, o, b, si
+
+            multi_jit = jax.jit(multi, donate_argnums=(0, 1, 2))
+            xs = jnp.stack([x._value] * kstep)
+            ys = jnp.stack([y._value] * kstep)
+            lr_arr = jnp.asarray(1e-4, jnp.float32)
+            st = {"p": param_arrays(net), "o": tstep._opt_state_tree(),
+                  "b": buffer_arrays(net), "i": jnp.asarray(1, jnp.int32)}
+
+            def run():
+                keys = jax.random.split(_prand.next_key(), kstep)
+                loss, st["p"], st["o"], st["b"], st["i"] = multi_jit(
+                    st["p"], st["o"], st["b"], xs, ys, lr_arr, st["i"],
+                    keys)
+                return paddle.to_tensor(loss)
+        else:
+            run = lambda: tstep(x, y)  # noqa: E731
     else:
         params = stacked_params_from_module(net)
         dt_ = jnp.float32 if smoke else jnp.bfloat16
@@ -501,6 +545,9 @@ def bench_vit():
                                                  xj, yj)
             return loss
 
+    ksteps = 1
+    if os.environ.get("BENCH_VIT_STACKED") != "1" and not smoke:
+        ksteps = int(os.environ.get("BENCH_VIT_KSTEP", "1"))
     for _ in range(warm):
         loss = run()
     float(loss)
@@ -509,14 +556,15 @@ def bench_vit():
         loss = run()
     float(loss)
     dt = time.perf_counter() - t0
-    img_s = B * steps / dt
+    img_s = B * steps * ksteps / dt
     n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
     # ViT train flops/img ~= 6 * matmul params * tokens + attention
     tokens = (side // 16) ** 2 + 1
     flops_img = 6.0 * (n_params - 1000 * 1024) * tokens if not smoke else 0
     mfu = flops_img * img_s / PEAK_V5E if not smoke else 0.0
     return {"metric": "vit_large_train", "img_per_sec": round(img_s, 1),
-            "step_ms": round(dt / steps * 1e3, 1), "mfu": round(mfu, 4),
+            "step_ms": round(dt / (steps * ksteps) * 1e3, 1),
+            "mfu": round(mfu, 4), "steps_per_fence": ksteps,
             "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
 
 

@@ -87,6 +87,11 @@ define_flag("deterministic", False, "Force deterministic compilation/reductions 
 define_flag("log_level", 0, "VLOG-style verbosity for framework-internal logging.")
 define_flag("benchmark", False, "Block on every op for timing (eager debugging).")
 define_flag("ring_attention_mode", "ring", "Long-context attention mode: 'ring' or 'ulysses'.")
+define_flag("serving_a8w8_prefill", True,
+            "When serving with int8-quantized weights, run PREFILL matmuls "
+            "on the int8xint8->int32 MXU path with per-token activation "
+            "scales (reference fused_multi_transformer_int8). Decode keeps "
+            "weight-only dequant. 0 = weight-only everywhere.")
 define_flag("dy2static_fallback", True,
             "On ConversionError (or an untraceable predicate) under "
             "to_static, warn and fall back to the eager path instead of "

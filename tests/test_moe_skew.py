@@ -1,0 +1,185 @@
+"""MoE hot-expert stress (VERDICT r4 next-round #8): skewed routing where
+~90% of tokens hit 2 experts. Checks capacity-drop accounting, no-NaN with
+empty experts, EP-vs-dense parity under skew, and finite training grads.
+
+Reference: capacity kernels limit_by_capacity / prune_gate_by_capacity
+(paddle/phi/kernels/gpu/limit_by_capacity_kernel.cu:§0, SURVEY §2.4 EP row)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import moe_ops as mo
+
+
+def _skewed_logits(rs, T, E, hot=(0, 1), hot_frac=0.9):
+    """Gate logits sending ~hot_frac of tokens to the hot experts."""
+    logits = rs.randn(T, E).astype(np.float32)
+    n_hot = int(T * hot_frac)
+    for i in range(n_hot):
+        logits[i, hot[i % len(hot)]] += 8.0
+    return logits
+
+
+class TestSkewAccounting:
+    def test_capacity_drop_accounting(self):
+        """Under 90/10 skew with a small capacity: every expert's kept
+        slots <= capacity, kept+dropped == routed, and dropped tokens
+        contribute exactly zero to the combined output."""
+        rs = np.random.RandomState(0)
+        T, E, C, D = 64, 8, 6, 4
+        logits = _skewed_logits(rs, T, E)
+        probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+        gate_prob, gate_idx = jax.lax.top_k(probs, 2)
+        routes = mo.dispatch_indices_topk(np.asarray(gate_idx), E, C)
+        tfs, cfs, flats, oks = mo.dispatch_plan(routes, E, C, T)
+
+        # per-slot occupancy: token_for_slot >= 0
+        tfs_np = np.asarray(tfs).reshape(E, C)
+        kept_per_expert = (tfs_np >= 0).sum(axis=1)
+        assert (kept_per_expert <= C).all()
+        # routed = every (token, k) pair; kept = slots that landed
+        counts = np.zeros(E, np.int64)
+        for t in range(T):
+            for k in range(2):
+                counts[int(np.asarray(gate_idx)[t, k])] += 1
+        np.testing.assert_array_equal(kept_per_expert,
+                                      np.minimum(counts, C))
+        # hot experts overflow, cold experts keep everything
+        assert kept_per_expert[0] == C and kept_per_expert[1] == C
+        assert counts[0] > C and counts[1] > C
+
+        # dropped tokens: combine contribution is zero -> with identity
+        # experts the output for fully-dropped tokens is exactly 0
+        x = rs.randn(T, D).astype(np.float32)
+        slots = mo.moe_dispatch_gather(jnp.asarray(x), tfs, flats, oks, E, C)
+        out = mo.moe_combine_gather(slots, gate_prob, flats, oks, tfs, cfs)
+        out = np.asarray(out)
+        # oks (T, K) flags which routes landed within capacity: with
+        # identity experts every token's output is x[t] * sum of kept
+        # route probs — dropped routes contribute exactly zero
+        ok_np = np.asarray(oks)
+        gp = np.asarray(gate_prob)
+        for t in range(T):
+            w = sum(float(gp[t, k]) for k in range(2) if ok_np[t, k])
+            np.testing.assert_allclose(out[t], x[t] * w, rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_empty_experts_no_nan(self):
+        """All tokens routed to expert 0: the other experts run on empty
+        (masked) slots — forward and grads must stay finite."""
+        rs = np.random.RandomState(1)
+        T, E, C, D, FF = 32, 8, 32, 4, 8
+        x = rs.randn(T, D).astype(np.float32)
+        logits = np.full((T, E), -10.0, np.float32)
+        logits[:, 0] = 10.0
+        w1 = (rs.randn(E, D, FF) * 0.3).astype(np.float32)
+        w2 = (rs.randn(E, FF, D) * 0.3).astype(np.float32)
+
+        def loss(xv, w1v, w2v):
+            probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+            gate_prob, gate_idx = jax.lax.top_k(probs, 2)
+            routes = mo.dispatch_indices_topk(gate_idx, E, C)
+            tfs, cfs, flats, oks = mo.dispatch_plan(routes, E, C, T)
+            slots = mo.moe_dispatch_gather(xv, tfs, flats, oks, E, C)
+            h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, w1v))
+            y = jnp.einsum("ecf,efd->ecd", h, w2v)
+            out = mo.moe_combine_gather(y, gate_prob, flats, oks, tfs, cfs)
+            return jnp.sum(out ** 2)
+
+        val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2))
+        assert np.isfinite(float(val))
+        for g in grads:
+            assert np.isfinite(np.asarray(g)).all()
+        # empty experts must receive exactly zero weight gradient
+        gw1 = np.asarray(grads[1])
+        assert np.abs(gw1[2:]).max() == 0.0
+
+    def test_ep_matches_dense_under_skew(self):
+        """8-device expert-parallel all_to_all path == single-device gather
+        path under 90/10 skew WITH drops (same capacity on both)."""
+        E, D, FF, T_local = 8, 4, 16, 32
+        n = 8
+        T = n * T_local
+        C = 8   # tight: hot experts drop
+        mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:n]), ("expert",))
+        from jax.sharding import PartitionSpec as P
+        rs = np.random.RandomState(2)
+        x = rs.randn(T, D).astype(np.float32)
+        logits = _skewed_logits(rs, T, E)
+        w1 = (rs.randn(E, D, FF) * 0.3).astype(np.float32)
+        w2 = (rs.randn(E, FF, D) * 0.3).astype(np.float32)
+
+        def fn(xl, lg, w1l, w2l):
+            return mo.expert_parallel_ffn(xl, lg, w1l, w2l, "expert",
+                                          num_experts=E, capacity=C, topk=2)
+
+        f = jax.jit(jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("expert"), P("expert"), P("expert"), P("expert")),
+            out_specs=P("expert"), check_vma=False))
+        got = np.asarray(f(x, logits, w1, w2))
+        assert np.isfinite(got).all()
+
+        # single-device oracle: same routing/capacity per LOCAL shard
+        # (capacity applies per source device in the EP path)
+        outs = []
+        for dvc in range(n):
+            xl = jnp.asarray(x[dvc * T_local:(dvc + 1) * T_local])
+            lg = jnp.asarray(logits[dvc * T_local:(dvc + 1) * T_local])
+            probs = jax.nn.softmax(lg, axis=-1)
+            gate_prob, gate_idx = jax.lax.top_k(probs, 2)
+            routes = mo.dispatch_indices_topk(gate_idx, E, C)
+            tfs, cfs, flats, oks = mo.dispatch_plan(routes, E, C, T_local)
+            slots = mo.moe_dispatch_gather(xl, tfs, flats, oks, E, C)
+            h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf",
+                                       slots.astype(jnp.float32),
+                                       jnp.asarray(w1)))
+            y = jnp.einsum("ecf,efd->ecd", h, jnp.asarray(w2))
+            out = mo.moe_combine_gather(y, gate_prob, flats, oks, tfs, cfs)
+            outs.append(np.asarray(out))
+        ref = np.concatenate(outs, axis=0)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-4)
+
+    def test_moe_layer_trains_under_skew(self):
+        """GPT-MoE block with a gate biased 90/10: one training step runs,
+        loss and every grad finite (capacity drops do not poison AD)."""
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+            MoELayer
+        from paddle_tpu import nn, optimizer
+
+        d = 8
+        paddle.seed(0)
+
+        class _Expert(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(d, d)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        layer = MoELayer(d, [_Expert() for _ in range(4)], gate="gshard",
+                         top_k=2)
+        # bias the gate hard toward experts 0/1
+        for name, p in layer.named_parameters():
+            if "gate" in name and p.ndim == 2:
+                v = np.asarray(p._value).copy()
+                v[:, 0] += 4.0
+                v[:, 1] += 3.5
+                p.set_value(v)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=layer.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(64, d).astype(np.float32))
+        out = layer(x)
+        loss = (out ** 2).mean()
+        loss.backward()
+        for p in layer.parameters():
+            if p._grad_value is not None:
+                assert np.isfinite(np.asarray(p._grad_value)).all()
+        opt.step()
+        assert np.isfinite(float(loss._value))

@@ -107,6 +107,45 @@ def test_ring_flash_attention_eager_api():
     assert qt._grad_value is not None
 
 
+def test_ring_sep4_nontoy_parity_fwd_bwd():
+    """VERDICT r4 next-round #2: sep=4 at NON-TOY S_local (1024 per device,
+    S=4096 global) — ring fwd AND bwd must match global attention."""
+    mesh = pmesh.build_mesh({"sep": 4})
+    pmesh.set_global_mesh(mesh)
+    rng = np.random.RandomState(11)
+    b, s, h, d = 1, 4096, 2, 64
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    v = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    scale = 1.0 / math.sqrt(d)
+
+    want = fa._sdpa_array(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          scale=scale, causal=True)
+    prog = shard_map(
+        lambda a, b_, c: ra.ring_attention_array(a, b_, c, "sep",
+                                                 causal=True),
+        mesh=mesh, in_specs=(P(None, "sep"),) * 3, out_specs=P(None, "sep"),
+        check_vma=False)
+    got = jax.jit(prog)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_ring(a, b_, c):
+        return jnp.sum(prog(a, b_, c) ** 2) / s
+
+    def loss_full(a, b_, c):
+        return jnp.sum(
+            fa._sdpa_array(a, b_, c, scale=scale, causal=True) ** 2) / s
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b_ in zip(g_full, g_ring):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   rtol=5e-4, atol=5e-4)
+
+
 def test_llama_ring_sep_mode_loss_matches_ulysses():
     """Full hybrid train step with sep>1: ring and ulysses modes give the
     same first-step loss (same math, different comm pattern)."""
