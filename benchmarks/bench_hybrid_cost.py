@@ -365,8 +365,14 @@ def project_pod(fits, compile_degrees, degrees, vpp, M_real, L_real,
     bubble = (pp_deg - 1) / (vpp * M_real + pp_deg - 1) if pp_deg > 1 else 0.0
     t_worst = sum(t_comm.values())
     t_best = max(t_comm.values()) if t_comm else 0.0
+    # XLA's latency-hiding scheduler overlaps collectives with MXU work
+    # (ZeRO gathers prefetch the next layer; mp psums overlap the
+    # surrounding matmuls): exposed time = what compute cannot cover
+    t_overlapped = max(0.0, t_best - t_compute)
     mfu_worst = chip_mfu * (1 - bubble) * t_compute / (t_compute + t_worst)
     mfu_best = chip_mfu * (1 - bubble) * t_compute / (t_compute + t_best)
+    mfu_olap = chip_mfu * (1 - bubble) * t_compute / (
+        t_compute + t_overlapped)
     return {
         "mesh": degrees, "vpp": vpp, "microbatches": M_real,
         "layers": L_real,
@@ -378,13 +384,17 @@ def project_pod(fits, compile_degrees, degrees, vpp, M_real, L_real,
         "bubble_fraction": round(bubble, 4),
         "pod_mfu_range_worst_best": [round(mfu_worst, 4),
                                      round(mfu_best, 4)],
+        "pod_mfu_comm_compute_overlap": round(mfu_olap, 4),
         "assumptions": {
             "chip_mfu_measured_single_chip": chip_mfu,
             "ici_axis_gbps": V5P["ici_axis_gbps"],
             "traffic_model": "bidirectional-ring factors per kind; "
                              "worst = no overlap of any comm with compute "
-                             "or each other, best = all axes fully overlap "
-                             "each other (slowest axis exposed)"},
+                             "or each other; best = all axes fully overlap "
+                             "each other (slowest axis exposed); overlap = "
+                             "collectives additionally hide under compute "
+                             "(XLA latency-hiding scheduler), exposing "
+                             "only the excess of the slowest axis"},
     }
 
 

@@ -2,7 +2,7 @@
 device-fenced wall clock + XLA cost analysis + jax.profiler trace with a
 top-op table. Usage:  python benchmarks/profile_workload.py [bert|vit]
 
-Writes benchmarks/PROFILE_<name>_r4.md and prints one JSON line.
+Writes benchmarks/PROFILE_<name>_r5.md and prints one JSON line.
 """
 
 import glob
@@ -123,7 +123,79 @@ def _build_vit(jax, smoke):
         f"ViT-L/16 train (B={B}, {side}^2, bf16 O2)"
 
 
-BUILDERS = {"bert": _build_bert, "vit": _build_vit}
+def _build_bert_packed(jax, smoke):
+    """The PACKED encoder step (VERDICT r4 next-round #7): same packing,
+    segment-masked flash and real-token accounting as
+    bench_workloads.bench_bert_packed."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
+
+    if smoke:
+        cfg = ErnieConfig(vocab_size=512, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128, max_position_embeddings=64)
+        B, S, lo, hi = 2, 32, 8, 32
+    else:
+        cfg = ErnieConfig(vocab_size=30522, hidden_size=1024,
+                          num_hidden_layers=24, num_attention_heads=16,
+                          intermediate_size=4096,
+                          max_position_embeddings=512)
+        B, S, lo, hi = 16, 512, 64, 512
+    paddle.seed(0)
+    net = ErnieForMaskedLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=net.parameters())
+    if not smoke:
+        amp.decorate(models=net, optimizers=opt, level="O2",
+                     dtype="bfloat16")
+    step = paddle.jit.TrainStep(
+        net, lambda m, i, l, s: m.compute_loss(i, l, segment_ids=s), opt)
+
+    rng = np.random.RandomState(0)
+    lens = []
+    while True:
+        n = int(rng.randint(lo, hi + 1))
+        if sum(lens) + n > B * S:
+            break
+        lens.append(n)
+    lens.sort(reverse=True)
+    fill = [0] * B
+    seg_lens = [[] for _ in range(B)]
+    for n in lens:
+        r = min((i for i in range(B) if fill[i] + n <= S),
+                key=lambda i: fill[i], default=None)
+        if r is None:
+            continue
+        seg_lens[r].append(n)
+        fill[r] += n
+    ids = np.zeros((B, S), np.int32)
+    seg = np.full((B, S), -1, np.int32)
+    labels = np.full((B, S), -100, np.int64)
+    for r in range(B):
+        at = 0
+        for si, n in enumerate(seg_lens[r]):
+            tok = rng.randint(1, cfg.vocab_size, (n,))
+            ids[r, at:at + n] = tok
+            seg[r, at:at + n] = si
+            mask = rng.rand(n) < 0.15
+            labels[r, at:at + n] = np.where(mask, tok, -100)
+            at += n
+    real_tokens = int((seg >= 0).sum())
+    attn_flops = 12.0 * cfg.num_hidden_layers * cfg.hidden_size * float(
+        sum(n * n for r in seg_lens for n in r))
+    ids_t = paddle.to_tensor(ids)
+    labels_t = paddle.to_tensor(labels)
+    seg_t = paddle.to_tensor(seg)
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    # report per-REAL-token flops so the harness's MFU matches the bench
+    flops_tok = 6.0 * n_params + attn_flops / max(real_tokens, 1)
+    return (lambda: step(ids_t, labels_t, seg_t)), real_tokens, flops_tok, \
+        (f"BERT-large MLM PACKED (h=1024 L=24 S={S} B={B}, "
+         f"fill={real_tokens / (B * S):.3f}, bf16 O2)")
+
+
+BUILDERS = {"bert": _build_bert, "vit": _build_vit,
+            "bert_packed": _build_bert_packed}
 
 
 def main():
@@ -146,7 +218,7 @@ def main():
     float(loss)
     step_s = (time.perf_counter() - t0) / steps
 
-    trace_dir = f"/tmp/{name}_trace_r4"
+    trace_dir = f"/tmp/{name}_trace_r5"
     top_ops, device_step_ms = [], None
     try:
         with jax.profiler.trace(trace_dir):
@@ -164,7 +236,7 @@ def main():
     peak, gen = detect_peak()
     mfu = flops_unit * units_per_step / step_s / peak if not smoke else 0.0
     lines = [
-        f"# {name} step profile — round 4",
+        f"# {name} step profile — round 5",
         "",
         f"Config: {desc}, single {gen} chip.",
         "",
@@ -180,7 +252,7 @@ def main():
     for n, ms in top_ops:
         lines.append(f"| {n[:90]} | {ms:.1f} |")
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       f"PROFILE_{name}_r4.md")
+                       f"PROFILE_{name}_r5.md")
     with open(out, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(json.dumps({"workload": name, "step_ms": round(step_s * 1e3, 1),
