@@ -825,16 +825,28 @@ def _cached_attention(q, k_cache, v_cache, kv_len, config: LlamaConfig):
     position kv_len - T + i)."""
     b, t, nh, d = q.shape
     s_max = k_cache.shape[1]
-    rep = nh // k_cache.shape[2]
-    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
-    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
-    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) / math.sqrt(d)
+    nkv = k_cache.shape[2]
+    rep = nh // nkv
     q_pos = kv_len - t + jnp.arange(t)                      # (T,)
     mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]     # (T, S_max)
+    if rep > 1:
+        # grouped attention WITHOUT materializing repeated KV: a
+        # jnp.repeat here would stream rep x the cache bytes every decode
+        # step — exactly the bandwidth GQA exists to save. Group the
+        # query heads instead: (B, T, nkv, rep, d) against (B, S, nkv, d).
+        qg = q.reshape(b, t, nkv, rep, d)
+        scores = jnp.einsum("btgrd,bsgd->bgrts", qg.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) / math.sqrt(d)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrts,bsgd->btgrd",
+                         probs.astype(v_cache.dtype), v_cache)
+        return out.reshape(b, t, nh, d)
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / math.sqrt(d)
     scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
     return out
 
 
