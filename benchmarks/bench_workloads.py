@@ -111,6 +111,7 @@ def bench_bert_packed():
     per-segment attention FLOPs only — padding waste shows up as lost MFU,
     exactly as it would on the reference's flash_attn_varlen path."""
     jax, smoke = _setup()
+    import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu import amp, optimizer
     from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
@@ -180,22 +181,63 @@ def bench_bert_packed():
     labels_t = paddle.to_tensor(labels)
     seg_t = paddle.to_tensor(seg)
 
+    kstep = 1 if smoke else int(os.environ.get("BENCH_BERT_KSTEP", "1"))
+    if kstep > 1:
+        # k TRAINING STEPS per host fence (the ViT BENCH_VIT_KSTEP
+        # pattern): the packed step's device time is 168.9 ms vs 179.7
+        # wall (PROFILE_bert_packed_r5.md) — amortize the ~11 ms tunnel
+        # dispatch gap
+        from jax import lax
+        from paddle_tpu.jit.functional import param_arrays, buffer_arrays
+        from paddle_tpu import random as _prand
+        inner = step._make_step_fn()
+
+        def multi(params, opt_state, buffers, xs, ys, ss, lr, step_i, keys):
+            def body(carry, inp):
+                p, o, b, si = carry
+                x_, y_, s_, kk = inp
+                loss, p, o, b = inner(p, o, b, (x_, y_, s_), lr, si, kk)
+                return (p, o, b, si + 1), loss
+
+            (p, o, b, si), losses = lax.scan(
+                body, (params, opt_state, buffers, step_i),
+                (xs, ys, ss, keys))
+            return losses[-1], p, o, b, si
+
+        multi_jit = jax.jit(multi, donate_argnums=(0, 1, 2))
+        xs = jnp.stack([ids_t._value] * kstep)
+        ys = jnp.stack([labels_t._value] * kstep)
+        ss = jnp.stack([seg_t._value] * kstep)
+        lr_arr = jnp.asarray(1e-4, jnp.float32)
+        st = {"p": param_arrays(net), "o": step._opt_state_tree(),
+              "b": buffer_arrays(net), "i": jnp.asarray(1, jnp.int32)}
+
+        def run():
+            keys = jax.random.split(_prand.next_key(), kstep)
+            loss, st["p"], st["o"], st["b"], st["i"] = multi_jit(
+                st["p"], st["o"], st["b"], xs, ys, ss, lr_arr, st["i"],
+                keys)
+            return paddle.to_tensor(loss)
+    else:
+        run = lambda: step(ids_t, labels_t, seg_t)  # noqa: E731
+
     for _ in range(warm):
-        loss = step(ids_t, labels_t, seg_t)
+        loss = run()
     float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step(ids_t, labels_t, seg_t)
+        loss = run()
     float(loss)
     dt = time.perf_counter() - t0
 
-    tok_s = real_tokens * steps / dt
+    tok_s = real_tokens * steps * kstep / dt
     n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
     flops_step = 6.0 * n_params * real_tokens + attn_flops
-    mfu = flops_step * steps / dt / PEAK_V5E if not smoke else 0.0
+    mfu = flops_step * steps * kstep / dt / PEAK_V5E if not smoke else 0.0
     return {"metric": "bert_large_mlm_train_packed",
             "tokens_per_sec": round(tok_s, 1),
-            "step_ms": round(dt / steps * 1e3, 1), "mfu": round(mfu, 4),
+            "step_ms": round(dt / (steps * kstep) * 1e3, 1),
+            "mfu": round(mfu, 4), "steps_per_fence": kstep,
             "fill_rate": round(real_tokens / (B * S), 4),
             "params_m": round(n_params / 1e6, 1), "loss": float(loss)}
 
