@@ -30,6 +30,7 @@ from .core.extra_ops import (  # noqa: F401
     vstack, row_stack, column_stack, dstack, atleast_1d, atleast_2d,
     atleast_3d, tensor_split, mode, masked_scatter, diagonal_scatter,
     select_scatter, slice_scatter, histogramdd,
+    frac, gammaln, isin, clip_, geometric_, index_put, index_put_, unfold,
 )
 from .core import op_schema as _op_schema  # noqa: E402
 _op_schema.install(globals())  # schema-generated ops (only missing names)
@@ -57,6 +58,14 @@ from .framework.io_save import save, load  # noqa: F401
 # incubate, profiler (kept out of the base import to keep import time low)
 
 
+#: linalg functions paddle also exposes at top level (paddle.cholesky etc.)
+_LINALG_TOPLEVEL = frozenset((
+    "cholesky", "cholesky_solve", "matrix_power", "slogdet", "corrcoef",
+    "cov", "det", "pinv", "matrix_rank", "eig", "eigh", "eigvals",
+    "eigvalsh", "svd", "qr", "lu", "lstsq", "solve", "triangular_solve",
+))
+
+
 def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
@@ -65,6 +74,11 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _LINALG_TOPLEVEL:
+        mod = importlib.import_module(".linalg", __name__)
+        fn = getattr(mod, name)
+        globals()[name] = fn
+        return fn
     if name in ("Model", "summary"):
         from .hapi import Model, summary
         globals().update(Model=Model, summary=summary)

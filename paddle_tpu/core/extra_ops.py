@@ -417,3 +417,81 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
             hist = hist / widths.reshape(shape)
     return (Tensor(hist.astype(jnp.float32)),
             [Tensor(e.astype(jnp.float32)) for e in edges])
+
+
+# -- round-5 API-audit batch (sweep 4) ---------------------------------------
+def frac(x, name=None) -> Tensor:
+    """paddle.frac: x - trunc(x)."""
+    return apply(lambda v: v - jnp.trunc(v), _t(x), op_name="frac")
+
+
+def gammaln(x, name=None) -> Tensor:
+    """paddle.gammaln: log |Gamma(x)|."""
+    from jax.scipy.special import gammaln as _g
+    return apply(lambda v: _g(v.astype(jnp.float32)), _t(x),
+                 op_name="gammaln")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None) -> Tensor:
+    """paddle.isin: elementwise membership of x in test_x."""
+    return apply(lambda v, t: jnp.isin(v, t, assume_unique=assume_unique,
+                                       invert=invert),
+                 _t(x), _t(test_x), op_name="isin")
+
+
+def clip_(x, min=None, max=None, name=None) -> Tensor:
+    """paddle.Tensor.clip_ (in place)."""
+    t = _t(x)
+    t._value = jnp.clip(t._value,
+                        None if min is None else min,
+                        None if max is None else max)
+    return t
+
+
+def geometric_(x, probs, name=None) -> Tensor:
+    """paddle.Tensor.geometric_ (in place): fill with Geometric(probs)
+    samples (number of Bernoulli trials to first success, support 1..inf)."""
+    t = _t(x)
+    key = _random.next_key()
+    u = jax.random.uniform(key, t._value.shape, jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny)
+    p = jnp.asarray(_v(probs) if not np.isscalar(probs) else probs,
+                    jnp.float32)
+    g = jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1.0
+    t._value = g.astype(t._value.dtype)
+    return t
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    """paddle.index_put: out[indices] = value (scatter by index tensors;
+    ``accumulate`` adds instead of overwriting)."""
+    def fn(v, val, *idx):
+        ref = v.at[tuple(i.astype(jnp.int32) for i in idx)]
+        return ref.add(val.astype(v.dtype)) if accumulate \
+            else ref.set(val.astype(v.dtype))
+
+    return apply(fn, _t(x), _t(value), *[_t(i) for i in indices],
+                 op_name="index_put")
+
+
+def index_put_(x, indices, value, accumulate=False, name=None) -> Tensor:
+    t = _t(x)
+    t._value = index_put(t, indices, value, accumulate)._value
+    return t
+
+
+def unfold(x, axis, size, step, name=None) -> Tensor:
+    """paddle.Tensor.unfold: sliding windows of ``size`` every ``step``
+    along ``axis``; the window dim is appended LAST (paddle semantics)."""
+    def fn(v):
+        ax = axis % v.ndim
+        n = v.shape[ax]
+        starts = jnp.arange(0, n - size + 1, step)
+        win = starts[:, None] + jnp.arange(size)[None, :]   # (W, size)
+        g = jnp.take(v, win.reshape(-1), axis=ax)
+        shp = list(v.shape)
+        shp[ax:ax + 1] = [starts.shape[0], size]
+        g = g.reshape(shp)
+        return jnp.moveaxis(g, ax + 1, -1)
+
+    return apply(fn, _t(x), op_name="unfold")

@@ -32,4 +32,8 @@ from .layers_extra import (  # noqa: F401
     InstanceNorm1D, Bilinear, CosineSimilarity, PairwiseDistance,
     Unfold, Fold, HuberLoss, MarginRankingLoss, TripletMarginLoss,
     SpectralNorm,
+    ChannelShuffle, Softmax2D, ThresholdedReLU, RReLU, CTCLoss,
+    CosineEmbeddingLoss, GaussianNLLLoss, HingeEmbeddingLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, PoissonNLLLoss,
+    SoftMarginLoss, AdaptiveLogSoftmaxWithLoss,
 )

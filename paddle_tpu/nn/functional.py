@@ -1677,3 +1677,46 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
 
 # -- beam search backtrack (paddle.nn.functional.gather_tree) ----------------
 from .decode import gather_tree  # noqa: E402,F401
+
+
+# -- round-5 API-audit batch (sweep 4) ---------------------------------------
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """paddle.nn.functional.sequence_mask: mask[..., j] = j < x[...]
+    (reference python/paddle/nn/functional/extension.py:§0)."""
+    xv = unwrap(x)
+    if maxlen is None:
+        ml = int(jnp.max(xv))            # data-dependent: eager-only then
+    else:
+        ml = int(maxlen)
+    out = jnp.arange(ml) < jnp.expand_dims(xv, -1)
+    from ..core.dtype import canonical_dtype
+    return Tensor(out.astype(canonical_dtype(dtype)))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """paddle.nn.functional.zeropad2d (pad = [left, right, top, bottom])."""
+    return pad(x, list(padding), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """paddle.nn.functional.multi_margin_loss (multi-class hinge;
+    reference python/paddle/nn/functional/loss.py:§0)."""
+    def fn(x, y, *w):
+        n, c = x.shape
+        y = y.astype(jnp.int32)
+        x_y = jnp.take_along_axis(x, y[:, None], axis=1)      # (N, 1)
+        diff = jnp.maximum(margin - x_y + x, 0.0) ** p
+        if w:
+            diff = diff * jnp.take(w[0], y)[:, None]
+        mask = jnp.arange(c)[None, :] != y[:, None]
+        per = jnp.sum(diff * mask, axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="multi_margin_loss")

@@ -1,0 +1,148 @@
+"""Round-5 API-audit sweep #4 (SURVEY §8.1): the long-tail batch —
+tensor ops (frac/gammaln/isin/clip_/geometric_/index_put/unfold),
+top-level linalg aliases, new functional losses, and the nn layer set
+incl. AdaptiveLogSoftmaxWithLoss.
+
+Reference: python/paddle/tensor/math.py, python/paddle/nn/layer/loss.py,
+python/paddle/nn/functional/loss.py:§0."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestTensorOps:
+    def test_frac_gammaln_isin(self):
+        import scipy.special as sp
+        x = paddle.to_tensor(np.asarray([1.7, -2.3, 0.5], np.float32))
+        np.testing.assert_allclose(np.asarray(paddle.frac(x)._value),
+                                   [0.7, -0.3, 0.5], rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(paddle.gammaln(
+                paddle.to_tensor(np.asarray([2.5, 7.0], np.float32)))._value),
+            sp.gammaln([2.5, 7.0]), rtol=1e-5)
+        m = paddle.isin(paddle.to_tensor(np.asarray([1, 2, 3, 4])),
+                        paddle.to_tensor(np.asarray([2, 4])))
+        np.testing.assert_array_equal(np.asarray(m._value),
+                                      [False, True, False, True])
+
+    def test_inplace_clip_geometric(self):
+        t = paddle.to_tensor(np.asarray([-5.0, 0.0, 5.0], np.float32))
+        out = paddle.clip_(t, -1, 1)
+        assert out is t
+        np.testing.assert_array_equal(np.asarray(t._value), [-1, 0, 1])
+        g = paddle.to_tensor(np.zeros(20000, np.float32))
+        paddle.seed(3)
+        paddle.geometric_(g, 0.5)
+        gv = np.asarray(g._value)
+        assert gv.min() >= 1 and 1.8 < gv.mean() < 2.2   # E = 1/p = 2
+
+    def test_index_put_and_unfold(self):
+        y = paddle.index_put(
+            paddle.to_tensor(np.zeros((3, 3), np.float32)),
+            (paddle.to_tensor(np.asarray([0, 2])),
+             paddle.to_tensor(np.asarray([1, 2]))),
+            paddle.to_tensor(np.asarray([7.0, 8.0], np.float32)))
+        assert np.asarray(y._value)[0, 1] == 7
+        assert np.asarray(y._value)[2, 2] == 8
+        acc = paddle.index_put(
+            y, (paddle.to_tensor(np.asarray([0])),
+                paddle.to_tensor(np.asarray([1]))),
+            paddle.to_tensor(np.asarray([1.0], np.float32)),
+            accumulate=True)
+        assert np.asarray(acc._value)[0, 1] == 8
+        u = paddle.unfold(
+            paddle.to_tensor(np.arange(10, dtype=np.float32)), 0, 2, 4)
+        np.testing.assert_array_equal(np.asarray(u._value),
+                                      [[0, 1], [4, 5], [8, 9]])
+        u2 = paddle.unfold(paddle.to_tensor(
+            np.arange(12, dtype=np.float32).reshape(3, 4)), 1, 2, 2)
+        assert tuple(u2.shape) == (3, 2, 2)
+
+    def test_linalg_toplevel_aliases(self):
+        a = np.asarray([[4.0, 2.0], [2.0, 3.0]], np.float32)
+        c = np.asarray(paddle.cholesky(paddle.to_tensor(a))._value)
+        np.testing.assert_allclose(c @ c.T, a, rtol=1e-5)
+        sign, logdet = paddle.slogdet(paddle.to_tensor(a))
+        np.testing.assert_allclose(float(sign._value) *
+                                   np.exp(float(logdet._value)),
+                                   np.linalg.det(a), rtol=1e-5)
+        mp = paddle.matrix_power(paddle.to_tensor(a), 2)
+        np.testing.assert_allclose(np.asarray(mp._value), a @ a, rtol=1e-5)
+
+
+class TestFunctional:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.asarray([2, 4])), maxlen=5)
+        np.testing.assert_array_equal(
+            np.asarray(m._value), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+
+    def test_zeropad2d(self):
+        z = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32)),
+                        [1, 2, 3, 4])
+        assert tuple(z.shape) == (1, 1, 9, 5)
+        assert float(np.asarray(z._value).sum()) == 4.0
+
+    def test_multi_margin_loss(self):
+        x = paddle.to_tensor(np.asarray([[0.1, 0.9], [0.8, 0.2]],
+                                        np.float32))
+        y = paddle.to_tensor(np.asarray([1, 0]))
+        got = float(F.multi_margin_loss(x, y)._value)
+        # per-sample: max(0, 1 - x_y + x_other)/C
+        want = np.mean([(1 - 0.9 + 0.1) / 2, (1 - 0.8 + 0.2) / 2])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestLayers:
+    def test_adaptive_log_softmax(self):
+        paddle.seed(0)
+        ls = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10], div_value=2.0,
+                                           head_bias=True)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(6, 16).astype(np.float32))
+        y = paddle.to_tensor(rs.randint(0, 20, (6,)))
+        lp = ls.log_prob(x)
+        np.testing.assert_allclose(
+            np.exp(np.asarray(lp._value)).sum(-1), np.ones(6), rtol=1e-4)
+        out, loss = ls(x, y)
+        # output == the target's log prob from the full table
+        np.testing.assert_allclose(
+            np.asarray(out._value),
+            np.take_along_axis(np.asarray(lp._value),
+                               np.asarray(y._value)[:, None], 1)[:, 0],
+            rtol=1e-5)
+        loss.backward()
+        assert ls.head_weight._grad_value is not None
+        assert ls.tail_weights[0][0]._grad_value is not None
+        assert tuple(ls.predict(x).shape) == (6,)
+
+    def test_wrapper_layers_run(self):
+        rs = np.random.RandomState(1)
+        x4 = paddle.to_tensor(rs.randn(2, 4, 6, 6).astype(np.float32))
+        assert tuple(nn.ChannelShuffle(2)(x4).shape) == (2, 4, 6, 6)
+        sm = np.asarray(nn.Softmax2D()(x4)._value)
+        np.testing.assert_allclose(sm.sum(axis=1), np.ones((2, 6, 6)),
+                                   rtol=1e-5)
+        x = paddle.to_tensor(rs.randn(8).astype(np.float32))
+        assert nn.ThresholdedReLU()(x).shape == [8]
+        assert nn.RReLU()(x).shape == [8]
+        a = paddle.to_tensor(rs.randn(4, 3).astype(np.float32))
+        b = paddle.to_tensor(rs.randn(4, 3).astype(np.float32))
+        lbl = paddle.to_tensor(np.asarray([1, -1, 1, -1]))
+        for loss in (nn.CosineEmbeddingLoss()(a, b, lbl),
+                     nn.HingeEmbeddingLoss()(a, lbl.reshape([4, 1])
+                                             .astype("float32")
+                                             .expand([4, 3])),
+                     nn.SoftMarginLoss()(a, lbl.reshape([4, 1])
+                                         .astype("float32").expand([4, 3])),
+                     nn.GaussianNLLLoss()(a, b, paddle.ones([4, 3])),
+                     nn.PoissonNLLLoss()(a, (b * b)),
+                     nn.MultiLabelSoftMarginLoss()(
+                         a, paddle.to_tensor(
+                             (rs.rand(4, 3) > 0.5).astype(np.float32))),
+                     nn.MultiMarginLoss()(
+                         a, paddle.to_tensor(np.asarray([0, 1, 2, 0])))):
+            assert np.isfinite(float(loss._value)), loss
