@@ -334,7 +334,7 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros((num_slots,), np.int32)
         self._bt = np.zeros((num_slots, self._table_width), np.int32)
         self._rng = jax.random.key(self.config.seed)
-        self._compiled_prefill: Dict[int, Callable] = {}
+        self._compiled_prefill: Dict[Tuple[int, int], Callable] = {}
         self._decode_chunk = None
 
     # -- compiled programs --------------------------------------------------
@@ -397,8 +397,17 @@ class ContinuousBatchingEngine:
 
     def _admit(self, params):
         """Fill free slots from the queue: allocate pages, prefill into the
-        slot, record the first generated token."""
+        slots, record the first generated tokens.
+
+        Round-5: admissions are BATCHED — every free slot fillable this
+        round goes through ONE prefill call per prompt bucket (B padded to
+        the next power of two so the compile cache stays small; pad rows
+        write into the reserved garbage page 0 and their sampled tokens
+        are discarded). A one-at-a-time B=1 prefill wave was ~1/3 of the
+        mixed-workload serve wall time at 16 slots — batch-1 prefills
+        leave the MXU almost idle."""
         cfg = self.config
+        picked = []                      # (slot, req, pages_row, lp)
         for s in range(self.num_slots):
             if self._slot_rid[s] is not None or not self._queue:
                 continue
@@ -406,7 +415,7 @@ class ContinuousBatchingEngine:
             lp = len(req.prompt)
             total = lp + cfg.max_new_tokens      # submit() bounds this
             if not self.mgr.can_allocate(total):
-                if not self._live:
+                if not self._live and not picked:
                     raise MemoryError(
                         f"request {req.rid} needs "
                         f"{self.mgr._pages_for(total)} pages but the pool "
@@ -416,26 +425,46 @@ class ContinuousBatchingEngine:
             self._queue.pop(0)
             pages = self.mgr.allocate(req.rid, total)
             self.mgr._lens[req.rid] = lp
-            bucket = _bucket(lp)
-            ids = np.full((1, bucket), cfg.pad_token_id, np.int32)
-            ids[0, :lp] = req.prompt
-            row = np.zeros((1, self._table_width), np.int32)
-            row[0, :len(pages)] = pages
-            if bucket not in self._compiled_prefill:
-                self._compiled_prefill[bucket] = self._build_prefill(bucket)
+            picked.append((s, req, pages, lp))
+        if not picked:
+            return
+        groups: Dict[int, list] = {}
+        for item in picked:
+            groups.setdefault(_bucket(item[3]), []).append(item)
+        for bucket, items in groups.items():
+            real = len(items)
+            b_pad = 1
+            while b_pad < real:
+                b_pad *= 2
+            # real <= num_slots by construction; clamp keeps b_pad within
+            # one slot-wave (for non-power-of-two num_slots the final
+            # bucket is num_slots itself)
+            b_pad = min(b_pad, self.num_slots)
+            ids = np.full((b_pad, bucket), cfg.pad_token_id, np.int32)
+            rows = np.zeros((b_pad, self._table_width), np.int32)
+            lens = np.ones((b_pad,), np.int32)   # pad rows: 1 garbage tok
+            for i, (s, req, pages, lp) in enumerate(items):
+                ids[i, :lp] = req.prompt
+                rows[i, :len(pages)] = pages
+                lens[i] = lp
+            key = (bucket, b_pad)
+            if key not in self._compiled_prefill:
+                self._compiled_prefill[key] = self._build_prefill(bucket)
             self._rng, sub = jax.random.split(self._rng)
             tok, self.mgr.k_pages, self.mgr.v_pages = \
-                self._compiled_prefill[bucket](
-                    params, jnp.asarray(ids),
-                    jnp.asarray([lp], jnp.int32), self.mgr.k_pages,
-                    self.mgr.v_pages, jnp.asarray(row), sub)
-            # NO host readback: the prefill token is written into the slot
-            # lazily and reaches the host with the next chunk's emissions
-            self._tok_dev = self._tok_dev.at[s].set(tok[0])
-            self._slot_rid[s] = req.rid
-            self._live[req.rid] = req
-            self._pos[s] = lp
-            self._bt[s] = row[0]
+                self._compiled_prefill[key](
+                    params, jnp.asarray(ids), jnp.asarray(lens),
+                    self.mgr.k_pages, self.mgr.v_pages, jnp.asarray(rows),
+                    sub)
+            # NO host readback: prefill tokens are written into the slots
+            # lazily and reach the host with the next chunk's emissions
+            slot_idx = jnp.asarray([s for s, *_ in items], jnp.int32)
+            self._tok_dev = self._tok_dev.at[slot_idx].set(tok[:real])
+            for i, (s, req, pages, lp) in enumerate(items):
+                self._slot_rid[s] = req.rid
+                self._live[req.rid] = req
+                self._pos[s] = lp
+                self._bt[s] = rows[i]
 
     def _complete(self, req) -> bool:
         cfg = self.config
