@@ -60,14 +60,23 @@ def paged_attention_array(q, k_pages, v_pages, block_tables, seq_lens,
     v = jnp.take(v_pages, block_tables, axis=0)
     k = k.reshape(b, max_pages * page, nkv, d)
     v = v.reshape(b, max_pages * page, nkv, d)
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
 
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    mask = jnp.arange(max_pages * page)[None, :] < seq_lens[:, None]
+    if rep > 1:
+        # grouped attention without materializing repeated KV (a
+        # jnp.repeat here streamed rep x the gathered cache bytes — the
+        # exact bandwidth GQA exists to save; same fix as
+        # models/llama._cached_attention, round 5)
+        qg = q.reshape(b, nkv, rep, d)
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrs,bsgd->bgrd", probs.astype(v.dtype), v)
+        return out.reshape(b, nh, d)
     scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * s
-    mask = jnp.arange(max_pages * page)[None, :] < seq_lens[:, None]
     scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)
