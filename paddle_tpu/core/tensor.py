@@ -24,6 +24,16 @@ from . import autograd
 from .place import Place, current_place
 from .dtype import convert_dtype
 
+# Cap on how many rows a `for` over a TRACED tensor may statically unroll
+# (each row duplicates the consuming code in the jaxpr). dy2static reuses
+# this constant; its eager fallback catches TracedIterationError.
+TRACED_ITER_UNROLL_LIMIT = 256
+
+
+class TracedIterationError(RuntimeError):
+    """Iterating a traced tensor in a way that cannot (or should not)
+    lower to a compiled program; the message says what to change."""
+
 _tensor_count = [0]
 
 
@@ -201,6 +211,23 @@ class Tensor:
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self):
+        # Under a jax trace, iteration unrolls shape[0] copies of whatever
+        # consumes the rows into the jaxpr. Guard here — not only in
+        # dy2static's check_iterable — so wrapped iteration (enumerate/zip/
+        # reversed over a tensor) hits the same actionable error instead of
+        # silently emitting a giant program (round-5 review finding).
+        if isinstance(self._value, jax.core.Tracer):
+            if not self._value.shape:
+                raise TracedIterationError(
+                    "iterating a 0-d traced tensor; loops need a leading "
+                    "axis (or use a tensor op)")
+            n = self._value.shape[0]
+            if n > TRACED_ITER_UNROLL_LIMIT:
+                raise TracedIterationError(
+                    f"iterating a traced tensor with leading axis {n} would "
+                    f"unroll {n} copies of the consuming code (limit "
+                    f"{TRACED_ITER_UNROLL_LIMIT}); loop over `range(n)` and "
+                    "index, or use a tensor op (scan/vmap)")
         for i in range(len(self)):
             yield self[i]
 

@@ -126,9 +126,11 @@ class StaticFunction:
         uargs, ukwargs = tree_unwrap(args), tree_unwrap(kwargs)
         self.guard.check(uargs, ukwargs)
         from .dy2static import ConversionError
+        from ..core.tensor import TracedIterationError
         try:
             out = self._jitted(params, buffers, key, uargs, ukwargs)
-        except (ConversionError, jax.errors.ConcretizationTypeError) as e:
+        except (ConversionError, TracedIterationError,
+                jax.errors.ConcretizationTypeError) as e:
             from ..flags import flag_value
             if not flag_value("dy2static_fallback"):
                 raise

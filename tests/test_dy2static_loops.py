@@ -74,7 +74,62 @@ class TestForRange:
         got = _run(f, jnp.asarray([0.0]))
         np.testing.assert_allclose(got, [6.0])
 
-    def test_traced_tensor_iterable_diagnosed(self):
+    def test_traced_tensor_iterable_unrolls(self):
+        # round-5: tensor iteration converts (static leading-axis unroll,
+        # the jax/SOT semantics) instead of raising.
+        def f(x):
+            s = x[0] * 0.0
+            for v in x:
+                s = s + v * 2.0
+            return s
+
+        got = _run(f, jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]))
+        np.testing.assert_allclose(got, [18.0, 24.0])
+
+    def test_huge_tensor_iterable_diagnosed(self):
+        # past the unroll limit the actionable error (and the to_static
+        # eager fallback) is preserved rather than emitting a giant jaxpr
+        def f(x):
+            s = x[0] * 0.0
+            for v in x:
+                s = s + v
+            return s
+
+        conv = convert_control_flow(f)
+        with pytest.raises(ConversionError, match="unroll"):
+            jax.jit(conv)(jnp.zeros((257, 2)))
+
+    def test_wrapped_huge_tensor_iterable_guarded(self):
+        # review r5: enumerate/zip bypass check_iterable (the rewriter
+        # guards the whole iterator expression), so Tensor.__iter__ itself
+        # must enforce the unroll limit under trace.
+        from paddle_tpu.core.tensor import TracedIterationError
+
+        def f(x):
+            s = x[0] * 0.0
+            for i, v in enumerate(paddle.to_tensor(x)):
+                s = s + v
+            return s._value
+
+        with pytest.raises(TracedIterationError, match="unroll"):
+            jax.jit(f)(jnp.zeros((300, 2)))
+
+    def test_wrapped_huge_tensor_for_falls_back_under_to_static(self):
+        from paddle_tpu.jit import to_static
+
+        def fwd(x):
+            s = x[0] * 0.0
+            for i, v in enumerate(x):
+                s = s + v
+            return s
+
+        sf = to_static(fwd)
+        x = paddle.to_tensor(np.ones((300, 2), np.float32))
+        with pytest.warns(UserWarning, match="falling back to the EAGER"):
+            out = sf(x)
+        np.testing.assert_allclose(np.asarray(out._value), [300.0, 300.0])
+
+    def test_traced_scalar_iterable_diagnosed(self):
         def f(x):
             s = 0.0
             for v in x:
@@ -82,8 +137,31 @@ class TestForRange:
             return s
 
         conv = convert_control_flow(f)
-        with pytest.raises(ConversionError, match="traced tensor"):
-            jax.jit(conv)(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(ConversionError, match="0-d"):
+            jax.jit(conv)(jnp.asarray(3.0))
+
+    def test_enumerate_over_traced_tensor(self):
+        def f(x):
+            s = x[0] * 0.0
+            for i, row in enumerate(paddle.to_tensor(x)):
+                s = s + row * float(i)
+            return s._value
+
+        got = np.asarray(jax.jit(convert_control_flow(f))(
+            jnp.asarray([[1.0], [2.0], [3.0]])))
+        np.testing.assert_allclose(got, [2.0 + 6.0])
+
+    def test_tensor_for_with_concrete_break(self):
+        def f(x):
+            s = x[0] * 0.0
+            for i, row in enumerate(x):
+                if i >= 2:          # concrete predicate: plain Python break
+                    break
+                s = s + row
+            return s
+
+        got = _run(f, jnp.asarray([[1.0], [2.0], [4.0], [8.0]]))
+        np.testing.assert_allclose(got, [3.0])
 
 
 class TestBreakContinue:

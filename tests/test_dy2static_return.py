@@ -226,38 +226,40 @@ class TestReturnInLoop:
 
 class TestFallbackToEager:
     def test_partially_convertible_falls_back(self):
-        """A function whose control flow cannot convert (tensor-iterable
-        for) runs EAGERLY with a warning instead of raising."""
-        m = paddle.nn.Linear(4, 4)
+        """A function whose control flow cannot convert (a traced `while`
+        whose body GROWS its carried tensor — shapes change every
+        iteration, which no compiled loop can express) runs EAGERLY with a
+        warning instead of raising. (Round 5 moved the old example here —
+        tensor-iterable `for` — into the convertible set.)"""
 
         def fwd(x):
-            ys = []
-            for row in x:          # iterating a traced tensor: unconvertible
-                ys.append(m(row))
-            return paddle.stack(ys)
+            s = x
+            while s.sum() < 6.0:   # traced predicate -> while converts...
+                s = paddle.concat([s, s])   # ...but the carry GROWS
+            return s
 
         sf = to_static(fwd)
-        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        x = paddle.to_tensor(np.ones((1,), np.float32))
         with pytest.warns(UserWarning, match="falling back to the EAGER"):
             out = sf(x)
-        assert tuple(out.shape) == (3, 4)
+        assert tuple(out.shape) == (8,)
         # subsequent calls stay eager, no second warning
         with warnings.catch_warnings():
             warnings.simplefilter("error")
             out2 = sf(x)
-        assert tuple(out2.shape) == (3, 4)
+        assert tuple(out2.shape) == (8,)
 
     def test_strict_flag_restores_raise(self):
         def fwd(x):
-            ys = []
-            for row in x:
-                ys.append(row * 2.0)
-            return paddle.stack(ys)
+            s = x
+            while s.sum() < 6.0:
+                s = paddle.concat([s, s])
+            return s
 
         paddle.set_flags({"FLAGS_dy2static_fallback": 0})
         try:
             sf = to_static(fwd)
-            x = paddle.to_tensor(np.ones((3, 4), np.float32))
+            x = paddle.to_tensor(np.ones((1,), np.float32))
             with pytest.raises(ConversionError):
                 sf(x)
         finally:
