@@ -153,13 +153,33 @@ def main():
     if remat_mode not in ("full", "dots", "attn", "offload", "off"):
         sys.exit(f"unknown BENCH_REMAT={remat_mode!r}; "
                  "pick from full|dots|attn|offload|off")
+    # BENCH_KSTEP: k training steps per dispatch (lax.scan over a leading
+    # k axis, params/opt-state carry donated) — amortizes the per-dispatch
+    # host cost through the axon tunnel. k=1 preserves the historical
+    # single-step program byte-for-byte.
+    # default 8 from the round-5 chip sweep: k=1 51.88% / k=4 52.77% /
+    # k=8 52.88% / k=16 52.94% MFU — converged by k=8; k=16's +0.06 not
+    # worth the doubled scan compile. BENCH_KSTEP=1 restores the
+    # historical single-step program.
+    try:
+        kstep = int(os.environ.get("BENCH_KSTEP", "8"))
+    except ValueError:
+        sys.exit(f"BENCH_KSTEP={os.environ['BENCH_KSTEP']!r} is not an "
+                 "integer; pick k in [1, 64]")
+    if not 1 <= kstep <= 64:
+        sys.exit(f"BENCH_KSTEP={kstep} out of range [1, 64] (the scan "
+                 "compile cost and HBM batch stacking grow with k)")
     step, init_fn = L.build_hybrid_train_step(
         cfg, mesh, learning_rate=1e-4, remat=remat_mode != "off",
-        remat_policy=remat_mode if remat_mode != "off" else "full")
+        remat_policy=remat_mode if remat_mode != "off" else "full",
+        k_steps=kstep)
     params, opt_state = init_fn(seed=0)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (1, B, S)).astype(np.int32)
     labels = np.roll(ids, -1, axis=-1).astype(np.int32)
+    if kstep > 1:
+        ids = np.broadcast_to(ids, (kstep,) + ids.shape).copy()
+        labels = np.broadcast_to(labels, (kstep,) + labels.shape).copy()
 
     # warmup/compile. float(loss) forces a device→host transfer: on the axon
     # platform block_until_ready returns before execution completes (round-2
@@ -176,7 +196,8 @@ def main():
         print(f"# remat=dots failed ({type(e).__name__}); retrying with "
               "full remat", file=sys.stderr)
         step, init_fn = L.build_hybrid_train_step(
-            cfg, mesh, learning_rate=1e-4, remat=True, remat_policy="full")
+            cfg, mesh, learning_rate=1e-4, remat=True, remat_policy="full",
+            k_steps=kstep)
         params, opt_state = init_fn(seed=0)
         for _ in range(warmup):
             loss, params, opt_state = step(params, opt_state, ids, labels)
@@ -188,7 +209,7 @@ def main():
     float(loss)  # chain of param deps ⇒ waits for all `steps` steps
     dt = time.perf_counter() - t0
 
-    tokens = B * S * steps
+    tokens = B * S * steps * kstep
     tok_per_sec = tokens / dt
 
     n_params = sum(int(np.prod(v.shape)) for v in params.values())

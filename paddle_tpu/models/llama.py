@@ -392,11 +392,17 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                             virtual_pp: int = 1,
                             remat_policy: str = "full",
                             pipeline_schedule: str = "fill_drain",
-                            zero_gather: str = "per_layer"):
+                            zero_gather: str = "per_layer",
+                            k_steps: int = 1):
     """Returns (step_fn, init_fn).
 
     step_fn(params, opt_state, batch_ids, batch_labels) ->
         (loss, params, opt_state) — jitted, fully sharded.
+
+    ``k_steps > 1`` compiles k optimizer steps into ONE dispatch
+    (lax.scan over a leading k axis the batch arrays must then carry;
+    the returned loss is the last step's). One host round-trip per k
+    steps instead of per step.
 
     Parallelism inside: dp (batch), pp (ppermute pipeline: fill-drain, or
     the interleaved virtual-pipeline schedule when ``virtual_pp > 1`` —
@@ -788,6 +794,31 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
     ns = lambda spec_tree: jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), spec_tree,
         is_leaf=lambda x: isinstance(x, P))
+    if k_steps > 1:
+        # k TRAINING STEPS per dispatch: one lax.scan over a leading
+        # k-axis of the batch with the (params, opt_state) carry donated.
+        # Amortizes the per-dispatch host cost (under the axon tunnel,
+        # ~11 ms of dispatch + plumbing per call — the same lever that
+        # took packed BERT 45.8%→50.5%, benchmarks/bench_workloads.py).
+        # step_fn(params, opt_state, ids, labels) with ids/labels carrying
+        # a leading k axis; returns the LAST step's loss.
+        def multi(params, opt_state, ids, labels):
+            def body(carry, batch):
+                p, o = carry
+                loss, p, o = step(p, o, batch[0], batch[1])
+                return (p, o), loss
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state), (ids, labels))
+            return losses[-1], p, o
+
+        kb_spec = P(None, *batch_in_spec)
+        step_jit = jax.jit(
+            multi,
+            in_shardings=(ns(specs), ns(state_specs), ns(kb_spec), ns(kb_spec)),
+            out_shardings=(NamedSharding(mesh, P()), ns(specs), ns(state_specs)),
+            donate_argnums=(0, 1),
+        )
+        return step_jit, init_fn
     step_jit = jax.jit(
         step,
         in_shardings=(ns(specs), ns(state_specs), ns(batch_in_spec), ns(batch_in_spec)),
