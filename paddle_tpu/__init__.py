@@ -70,7 +70,8 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "static", "quantization",
-                "linalg", "fft", "sparse", "distribution", "signal"):
+                "linalg", "fft", "sparse", "distribution", "signal",
+                "audio", "text", "utils", "onnx"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -1,0 +1,19 @@
+"""``paddle_tpu.audio`` — audio feature extraction.
+
+Parity with python/paddle/audio/ of the reference (SURVEY.md §2 L7 API
+long tail): ``functional`` (mel scales, fbank matrices, dct, windows,
+power_to_db) and ``features`` (Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers). Everything is jnp on top of
+paddle_tpu.signal's stft, so features jit and run on device — the
+reference computes these with its own kernels on CPU/GPU.
+
+The reference's ``audio.backends`` (soundfile/wave I/O) is host-side by
+nature; a stdlib-``wave`` WAV loader is provided and anything beyond
+16/32-bit PCM WAV raises with a pointer at the optional deps.
+"""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401
+
+__all__ = ["functional", "features", "backends"]

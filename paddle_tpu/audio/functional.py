@@ -1,0 +1,208 @@
+"""paddle_tpu.audio.functional — mel/dct/window helpers.
+
+Reference: python/paddle/audio/functional/{functional,window}.py:§0. All
+pure jnp; formulas follow the reference's HTK/Slaney conventions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def _t(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz → mel. Slaney (default) is linear below 1 kHz, log above; htk
+    is the 2595·log10(1+f/700) form."""
+    f = _t(freq)
+    scalar = not hasattr(f, "shape") or jnp.asarray(f).shape == ()
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar and not isinstance(freq, Tensor) \
+        else Tensor(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """mel → Hz (inverse of hz_to_mel)."""
+    m = _t(mel)
+    scalar = not hasattr(m, "shape") or jnp.asarray(m).shape == ()
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar and not isinstance(mel, Tensor) \
+        else Tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """n_mels mel-spaced frequencies in [f_min, f_max] (Hz)."""
+    lo = float(_t(hz_to_mel(f_min, htk=htk)))
+    hi = float(_t(hz_to_mel(f_max, htk=htk)))
+    mels = jnp.linspace(lo, hi, n_mels, dtype=jnp.float32)
+    return Tensor(_t(mel_to_hz(Tensor(mels), htk=htk)).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Center frequencies of rfft bins: linspace(0, sr/2, 1+n_fft//2)."""
+    return Tensor(jnp.linspace(0, sr / 2.0, 1 + n_fft // 2,
+                               dtype=jnp.float32).astype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Mel filterbank matrix (n_mels, 1 + n_fft//2) — triangular filters
+    between successive mel frequencies (reference compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = _t(fft_frequencies(sr, n_fft))
+    mel_f = _t(mel_frequencies(n_mels + 2, f_min=f_min, f_max=f_max,
+                               htk=htk))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]    # (n_mels+2, n_bins)
+
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        norms = jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+        weights = weights / jnp.maximum(norms, 1e-10)
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Power → decibels with amin flooring and optional top_db clamp."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = jnp.asarray(_t(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """DCT-II matrix (n_mels, n_mfcc) for MFCC (reference create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm is None:
+        dct = dct * 2.0
+    elif norm == "ortho":
+        scale = jnp.full((n_mfcc,), math.sqrt(2.0 / n_mels))
+        scale = scale.at[0].set(math.sqrt(1.0 / n_mels))
+        dct = dct * scale[None, :]
+    else:
+        raise ValueError(f"unsupported norm: {norm!r}")
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """Window by name — hann/hamming/blackman/bartlett/kaiser(beta)/
+    gaussian(std)/general_gaussian(p, sig)/exponential(center, tau)/
+    triang/bohman. Of the reference's set only ``taylor`` is absent
+    (sidelobe-design iteration, named in the unsupported error).
+    ``fftbins=True`` gives the periodic form (symmetric window of N+1
+    truncated to N)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + 1 if fftbins else win_length
+    i = jnp.arange(n, dtype=jnp.float32)
+    if name == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / (n - 1))
+    elif name == "blackman":
+        a = 2 * math.pi * i / (n - 1)
+        w = 0.42 - 0.5 * jnp.cos(a) + 0.08 * jnp.cos(2 * a)
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * i / (n - 1) - 1.0)
+    elif name == "triang":
+        # scipy triang: no zero endpoints
+        if n % 2 == 0:
+            w = jnp.where(i < n / 2, (2 * i + 1) / n, (2 * (n - i) - 1) / n)
+        else:
+            w = 1.0 - jnp.abs(i - (n - 1) / 2.0) / ((n + 1) / 2.0)
+    elif name == "bohman":
+        x = jnp.abs(2.0 * i / (n - 1) - 1.0)
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+        w = jnp.where(x >= 1.0, 0.0, w)
+    elif name == "kaiser":
+        beta = float(args[0]) if args else 12.0
+        x = 2.0 * i / (n - 1) - 1.0
+        import jax.scipy.special  # i0 lives here
+
+        w = jax.scipy.special.i0(beta * jnp.sqrt(jnp.maximum(
+            0.0, 1 - x * x))) / jax.scipy.special.i0(jnp.asarray(beta))
+    elif name == "gaussian":
+        std = float(args[0]) if args else 1.0
+        x = i - (n - 1) / 2.0
+        w = jnp.exp(-0.5 * (x / std) ** 2)
+    elif name == "general_gaussian":
+        p = float(args[0]) if args else 1.0
+        sig = float(args[1]) if len(args) > 1 else 1.0
+        x = i - (n - 1) / 2.0
+        w = jnp.exp(-0.5 * jnp.abs(x / sig) ** (2 * p))
+    elif name == "exponential":
+        center = args[0] if args else None
+        tau = float(args[1]) if len(args) > 1 else 1.0
+        c = (n - 1) / 2.0 if center is None else float(center)
+        w = jnp.exp(-jnp.abs(i - c) / tau)
+    else:
+        raise ValueError(
+            f"unsupported window: {name!r} (taylor is the one reference "
+            "window not implemented; the rest are listed in the docstring)")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(w.astype(dtype))
+
+
+# needed by get_window('kaiser') at import sites that jit
+import jax  # noqa: E402

