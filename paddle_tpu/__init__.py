@@ -71,7 +71,7 @@ def __getattr__(name):
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "static", "quantization",
                 "linalg", "fft", "sparse", "distribution", "signal",
-                "audio", "text", "utils", "onnx"):
+                "audio", "text", "utils", "onnx", "geometric"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

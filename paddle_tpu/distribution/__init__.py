@@ -15,14 +15,19 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .core.dispatch import apply
-from .core.tensor import Tensor
-from . import random as _random
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import random as _random
 
 
 def _val(x):
     if isinstance(x, Tensor):
-        return x._value
+        v = x._value
+        # int/bool parameters (e.g. Chi2(to_tensor(4))) would poison the
+        # float closed forms (full_like(df, 0.5) truncates to 0)
+        if not jnp.issubdtype(v.dtype, jnp.inexact):
+            v = v.astype(jnp.float32)
+        return v
     return jnp.asarray(x, jnp.float32)
 
 
@@ -167,10 +172,85 @@ class Categorical(Distribution):
         return Tensor(jnp.sum(jnp.exp(la) * (la - lb), axis=-1))
 
 
+#: closed-form same-family KLs for the extended zoo (reference
+#: python/paddle/distribution/kl.py's _REGISTER_TABLE):§0
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
-    if type(p) is not type(q):
-        raise NotImplementedError(
-            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
-    if hasattr(p, "kl_divergence"):
+    # most-specific matching (super)class pair wins, like the reference
+    # kl.py's dispatch — so Chi2 resolves to the (Gamma, Gamma) form
+    for tp in type(p).__mro__:
+        for tq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((tp, tq))
+            if fn is not None:
+                return fn(p, q)
+    if type(p) is type(q) and hasattr(p, "kl_divergence"):
         return p.kl_divergence(q)
-    raise NotImplementedError(type(p).__name__)
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+from .extras import (  # noqa: E402,F401
+    Beta, Binomial, Cauchy, Chi2, Dirichlet, Exponential,
+    ExponentialFamily, Gamma, Geometric, Gumbel, Laplace, LogNormal,
+    Multinomial, MultivariateNormal, Poisson, StudentT,
+)
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, SigmoidTransform,
+    StackTransform, TanhTransform, Transform, TransformedDistribution,
+)
+from jax.scipy import special as _jsp  # noqa: E402
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a1 - a2) * _jsp.digamma(a1)
+                  - _jsp.gammaln(a1) + _jsp.gammaln(a2)
+                  + a2 * (jnp.log(b1) - jnp.log(b2))
+                  + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    lb = lambda a, b: (_jsp.gammaln(a) + _jsp.gammaln(b)  # noqa: E731
+                       - _jsp.gammaln(a + b))
+    return Tensor(lb(a2, b2) - lb(a1, b1)
+                  + (a1 - a2) * _jsp.digamma(a1)
+                  + (b1 - b2) * _jsp.digamma(b1)
+                  + (a2 - a1 + b2 - b1) * _jsp.digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    c1, c2 = p.concentration, q.concentration
+    s1 = c1.sum(-1)
+    return Tensor(_jsp.gammaln(s1) - _jsp.gammaln(c2.sum(-1))
+                  - jnp.sum(_jsp.gammaln(c1), -1)
+                  + jnp.sum(_jsp.gammaln(c2), -1)
+                  + jnp.sum((c1 - c2) * (_jsp.digamma(c1)
+                                         - _jsp.digamma(s1)[..., None]), -1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    r = p.scale / q.scale
+    return Tensor(jnp.log(q.scale) - jnp.log(p.scale) + d / q.scale
+                  + r * jnp.exp(-d / p.scale) - 1.0)

@@ -1,0 +1,189 @@
+"""``paddle_tpu.geometric`` — graph message passing and segment ops.
+
+Parity with python/paddle/geometric/ of the reference
+(message_passing/send_recv.py, segment ops, sampling —
+paddle/phi/kernels/gpu/graph_send_recv_kernel.cu:§0). The compute ops
+are gather + ``jax.ops.segment_*`` (XLA scatter-reduce on TPU), so they
+jit and differentiate; the two sampling utilities are host-side numpy
+by nature (the reference runs them on CPU for graph batching too) and
+are documented eager-only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "sample_neighbors",
+]
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _seg_reduce(msgs, dst, n, reduce_op: str):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst, num_segments=n)
+        return tot / jnp.maximum(cnt, 1.0).reshape(
+            (n,) + (1,) * (msgs.ndim - 1))
+    if reduce_op in ("min", "max"):
+        red = jax.ops.segment_min if reduce_op == "min" \
+            else jax.ops.segment_max
+        out = red(msgs, dst, num_segments=n)
+        # empty segments hold the reduction identity (±inf for floats,
+        # iinfo extremes for ints); mask them to 0 by count, which is
+        # exact for every dtype
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.int32),
+                                  dst, num_segments=n)
+        mask = (cnt > 0).reshape((n,) + (1,) * (msgs.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros((), msgs.dtype))
+    raise ValueError(f"unknown reduce_op {reduce_op!r}; "
+                     "pick from sum/mean/min/max")
+
+
+def _out_size(dst, x_rows, out_size):
+    if out_size is not None:
+        return int(out_size)
+    if dst.size == 0:
+        return 0
+    try:
+        return int(jnp.max(dst)) + 1
+    except jax.errors.ConcretizationTypeError:
+        # data-dependent max(dst)+1 cannot shape an output under jit;
+        # fall back to the node count (pass out_size to override)
+        return int(x_rows)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather ``x`` rows at ``src_index``, reduce them at ``dst_index``
+    (reference graph_send_recv). ``out_size=None`` infers max(dst)+1
+    eagerly; under jit it defaults to ``x.shape[0]`` (pass ``out_size``
+    for anything else — output shapes must be static)."""
+
+    def fn(xv, src, dst):
+        n = _out_size(dst, xv.shape[0], out_size)
+        return _seg_reduce(xv[src.astype(jnp.int32)],
+                           dst.astype(jnp.int32), n, reduce_op)
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Node features combined with EDGE features
+    (``message_op(x[src], y_edge)``), then reduced at dst."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        n = _out_size(dst, xv.shape[0], out_size)
+        msgs = _MSG_OPS[message_op](xv[src.astype(jnp.int32)], yv)
+        return _seg_reduce(msgs, dst.astype(jnp.int32), n, reduce_op)
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge messages ``message_op(x[src], y[dst])`` — no reduction
+    (reference graph_send_uv)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        return _MSG_OPS[message_op](xv[src.astype(jnp.int32)],
+                                    yv[dst.astype(jnp.int32)])
+    return apply(fn, x, y, src_index, dst_index, op_name="send_uv")
+
+
+def _segment(data, segment_ids, reduce_op):
+    def fn(d, s):
+        n = _out_size(s, d.shape[0], None)
+        return _seg_reduce(d, s.astype(jnp.int32), n, reduce_op)
+    return apply(fn, data, segment_ids, op_name=f"segment_{reduce_op}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Reference paddle.geometric.segment_sum (ids must be sorted in the
+    reference; the scatter here accepts any order)."""
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def reindex_graph(x, neighbors, count, name=None):
+    """Host-side graph reindexing (reference graph_reindex): maps the
+    node ids in ``x`` (unique target nodes) and ``neighbors`` (concat of
+    per-node neighbor lists, lengths in ``count``) to a compact 0..n-1
+    id space. Returns (reindex_src, reindex_dst, out_nodes). Eager-only
+    (output size is data-dependent)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    order = {int(v): i for i, v in enumerate(xv)}
+    out_nodes = list(xv)
+    for v in nb:
+        v = int(v)
+        if v not in order:
+            order[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([order[int(v)] for v in nb], np.int32)
+    reindex_dst = np.repeat(np.arange(len(cnt), dtype=np.int32), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int32))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False,
+                     perm_buffer=None, name=None):
+    """Host-side uniform neighbor sampling over a CSC graph (reference
+    graph_sample_neighbors). Returns (out_neighbors, out_count) — ragged
+    output sizes are data-dependent, so this is eager-only like the
+    reference's CPU path used for batching."""
+    if return_eids or eids is not None:
+        raise NotImplementedError(
+            "sample_neighbors eids tracking is not implemented; sample "
+            "without eids or index edge features by (dst, position)")
+    rowv = np.asarray(row._value if isinstance(row, Tensor) else row)
+    colv = np.asarray(colptr._value if isinstance(colptr, Tensor)
+                      else colptr)
+    nodes = np.asarray(input_nodes._value
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.RandomState(np.random.randint(0, 2 ** 31))
+    outs, counts = [], []
+    for n in nodes:
+        lo, hi = int(colv[n]), int(colv[n + 1])
+        neigh = rowv[lo:hi]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        outs.append(neigh)
+        counts.append(len(neigh))
+    flat = np.concatenate(outs) if outs else np.zeros((0,), rowv.dtype)
+    return (Tensor(jnp.asarray(flat.astype(np.int32))),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))

@@ -1,0 +1,334 @@
+"""Round-5 distribution zoo + transforms + paddle.geometric.
+
+Reference: python/paddle/distribution/ (15 added distributions, the
+transform family, kl.py registry) and python/paddle/geometric/.
+Moment checks run against closed forms; log_probs against hand oracles;
+KLs against Monte-Carlo estimates.
+"""
+
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+import paddle_tpu.geometric as G
+
+
+def _arr(t):
+    return np.asarray(t._value)
+
+
+class TestDistributionMoments:
+    CASES = [
+        ("Exponential", lambda: D.Exponential(2.0), 0.5, 0.25),
+        ("Gamma", lambda: D.Gamma(3.0, 2.0), 1.5, 0.75),
+        ("Beta", lambda: D.Beta(2.0, 3.0), 0.4, 0.04),
+        ("Laplace", lambda: D.Laplace(1.0, 2.0), 1.0, 8.0),
+        ("LogNormal", lambda: D.LogNormal(0.0, 0.5),
+         math.exp(0.125), None),
+        ("Gumbel", lambda: D.Gumbel(0.0, 1.0), 0.57722, None),
+        ("Poisson", lambda: D.Poisson(4.0), 4.0, 4.0),
+        ("Geometric", lambda: D.Geometric(0.25), 3.0, 12.0),
+        ("Binomial", lambda: D.Binomial(10, 0.3), 3.0, 2.1),
+        ("StudentT", lambda: D.StudentT(10.0), 0.0, 1.25),
+        ("Cauchy", lambda: D.Cauchy(0.0, 1.0), None, None),
+        ("Chi2", lambda: D.Chi2(4.0), 4.0, 8.0),
+    ]
+
+    @pytest.mark.parametrize("name,mk,m,v", CASES,
+                             ids=[c[0] for c in CASES])
+    def test_sample_moments(self, name, mk, m, v):
+        paddle.seed(7)
+        d = mk()
+        s = _arr(d.sample((20000,)))
+        assert s.shape[0] == 20000 and np.isfinite(s).all()
+        if m is not None:
+            assert abs(s.mean() - m) < 0.2 * max(1.0, abs(m))
+        if v is not None:
+            assert abs(s.var() - v) < 0.25 * max(1.0, v)
+        # mean/variance properties agree with the closed forms
+        if m is not None and hasattr(type(d), "mean"):
+            assert abs(float(np.asarray(_arr(d.mean)).reshape(-1)[0]) - m) \
+                < 1e-3 * max(1.0, abs(m))
+
+    def test_entropy_matches_monte_carlo(self):
+        paddle.seed(3)
+        for d in (D.Exponential(1.5), D.Gamma(2.0, 3.0), D.Beta(2.0, 2.0),
+                  D.Laplace(0.0, 1.0), D.Gumbel(1.0, 2.0),
+                  D.LogNormal(0.0, 0.7)):
+            s = d.sample((50000,))
+            mc = -_arr(d.log_prob(s)).mean()
+            ent = float(np.asarray(_arr(d.entropy())).reshape(-1)[0])
+            assert abs(ent - mc) < 0.05 * max(1.0, abs(ent)), type(d).__name__
+
+    def test_log_prob_normalization_discrete(self):
+        # Binomial over its support sums to 1
+        d = D.Binomial(8, 0.35)
+        ks = paddle.to_tensor(np.arange(9, dtype=np.float32))
+        total = np.exp(_arr(d.log_prob(ks))).sum()
+        assert abs(total - 1.0) < 1e-5
+        g = D.Geometric(0.4)
+        ks = paddle.to_tensor(np.arange(60, dtype=np.float32))
+        assert abs(np.exp(_arr(g.log_prob(ks))).sum() - 1.0) < 1e-5
+
+
+class TestMultivariate:
+    def test_mvn_log_prob_and_sampling(self):
+        paddle.seed(11)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                                   covariance_matrix=cov)
+        lp = float(_arr(mvn.log_prob(
+            paddle.to_tensor(np.zeros(2, np.float32)))))
+        want = -0.5 * math.log((2 * math.pi) ** 2 * np.linalg.det(cov))
+        assert abs(lp - want) < 1e-4
+        s = _arr(mvn.sample((40000,)))
+        got_cov = np.cov(s.T)
+        np.testing.assert_allclose(got_cov, cov, atol=0.08)
+
+    def test_mvn_scale_tril(self):
+        L = np.array([[1.0, 0.0], [0.7, 0.5]], np.float32)
+        mvn = D.MultivariateNormal(np.zeros(2, np.float32), scale_tril=L)
+        np.testing.assert_allclose(mvn.covariance_matrix, L @ L.T,
+                                   atol=1e-6)
+
+    def test_multinomial(self):
+        paddle.seed(5)
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        mn = D.Multinomial(20, p)
+        s = _arr(mn.sample((3000,)))
+        assert (s.sum(-1) == 20).all()
+        np.testing.assert_allclose(s.mean(0), 20 * p, atol=0.4)
+        lp = float(_arr(mn.log_prob(
+            paddle.to_tensor(np.array([4.0, 6.0, 10.0], np.float32)))))
+        want = (math.lgamma(21) - math.lgamma(5) - math.lgamma(7)
+                - math.lgamma(11) + 4 * math.log(0.2) + 6 * math.log(0.3)
+                + 10 * math.log(0.5))
+        assert abs(lp - want) < 1e-3
+
+    def test_dirichlet(self):
+        paddle.seed(9)
+        d = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+        s = _arr(d.sample((20000,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.02)
+        mc = -_arr(d.log_prob(paddle.to_tensor(s[:5000]))).mean()
+        ent = float(_arr(d.entropy()))
+        assert abs(ent - mc) < 0.05
+
+
+class TestKL:
+    PAIRS = [
+        (lambda: (D.Exponential(2.0), D.Exponential(0.7)),),
+        (lambda: (D.Gamma(3.0, 2.0), D.Gamma(2.5, 1.0)),),
+        (lambda: (D.Beta(2.0, 3.0), D.Beta(4.0, 2.0)),),
+        (lambda: (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),),
+        (lambda: (D.Dirichlet(np.array([2.0, 3.0], np.float32)),
+                  D.Dirichlet(np.array([1.0, 4.0], np.float32))),),
+    ]
+
+    @pytest.mark.parametrize("mk", [p[0] for p in PAIRS])
+    def test_closed_form_matches_monte_carlo(self, mk):
+        paddle.seed(13)
+        p, q = mk()
+        kl = float(np.asarray(_arr(D.kl_divergence(p, q))).reshape(-1)[0])
+        s = p.sample((100000,))
+        mc = (_arr(p.log_prob(s)) - _arr(q.log_prob(s))).mean()
+        assert abs(kl - mc) < 0.05 * max(1.0, abs(kl)), (kl, mc)
+
+    def test_unregistered_pair_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Exponential(1.0), D.Gamma(1.0, 1.0))
+
+    def test_subclass_resolves_parent_kl(self):
+        """Review r5: Chi2 is a Gamma — the (Gamma, Gamma) closed form
+        must apply via MRO dispatch."""
+        paddle.seed(21)
+        p, q = D.Chi2(4.0), D.Chi2(6.0)
+        kl = float(np.asarray(_arr(D.kl_divergence(p, q))).reshape(-1)[0])
+        s = p.sample((100000,))
+        mc = (_arr(p.log_prob(s)) - _arr(q.log_prob(s))).mean()
+        assert abs(kl - mc) < 0.05 * max(1.0, abs(kl))
+
+    def test_chi2_int_df(self):
+        """Review r5: integer df must not truncate the 1/2 rate."""
+        c = D.Chi2(paddle.to_tensor(4))
+        assert float(np.asarray(c.rate)) == 0.5
+        assert abs(float(np.asarray(_arr(c.mean)).reshape(-1)[0]) - 4.0) \
+            < 1e-5
+
+
+class TestTransforms:
+    def test_lognormal_equals_exp_of_normal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 0.5),
+                                       D.ExpTransform())
+        ln = D.LogNormal(0.0, 0.5)
+        xs = paddle.to_tensor(np.array([0.3, 1.0, 2.5], np.float32))
+        np.testing.assert_allclose(_arr(td.log_prob(xs)),
+                                   _arr(ln.log_prob(xs)), atol=1e-5)
+
+    def test_affine_of_normal_is_normal(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), D.AffineTransform(3.0, 2.0))
+        n = D.Normal(3.0, 2.0)
+        xs = paddle.to_tensor(np.array([-1.0, 3.0, 7.0], np.float32))
+        np.testing.assert_allclose(_arr(td.log_prob(xs)),
+                                   _arr(n.log_prob(xs)), atol=1e-5)
+
+    @pytest.mark.parametrize("t,xs", [
+        (D.ExpTransform(), [-1.0, 0.0, 2.0]),
+        (D.SigmoidTransform(), [-2.0, 0.5, 3.0]),
+        (D.TanhTransform(), [-1.5, 0.0, 1.5]),
+        (D.AffineTransform(1.0, -2.0), [-1.0, 0.0, 2.0]),
+        (D.PowerTransform(3.0), [0.5, 1.0, 2.0]),
+    ])
+    def test_roundtrip_and_logdet(self, t, xs):
+        x = paddle.to_tensor(np.array(xs, np.float32))
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(_arr(back), xs, atol=1e-5)
+        # log|det J| via autodiff of the scalar forward
+        ld = _arr(t.forward_log_det_jacobian(x))
+        for i, xv in enumerate(xs):
+            g = jax.grad(lambda v: t._forward(v))(jnp.float32(xv))
+            assert abs(ld[i] - math.log(abs(float(g)))) < 1e-4
+
+    def test_chain_and_stack(self):
+        ch = D.ChainTransform([D.ExpTransform(),
+                               D.AffineTransform(1.0, 2.0)])
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        np.testing.assert_allclose(_arr(ch.inverse(ch.forward(x))),
+                                   [0.0, 1.0], atol=1e-5)
+        # chain logdet = sum of stage logdets at propagated points
+        ld = _arr(ch.forward_log_det_jacobian(x))
+        want = _arr(D.ExpTransform().forward_log_det_jacobian(x)) \
+            + math.log(2.0)
+        np.testing.assert_allclose(ld, want, atol=1e-5)
+
+        st = D.StackTransform([D.ExpTransform(), D.TanhTransform()], axis=0)
+        x2 = paddle.to_tensor(np.array([[0.5], [0.5]], np.float32))
+        y2 = _arr(st.forward(x2))
+        np.testing.assert_allclose(
+            y2, [[math.exp(0.5)], [math.tanh(0.5)]], atol=1e-5)
+
+    def test_event_dim_base_sums_logdet(self):
+        """Review r5: a base with event dims (Dirichlet) must yield a
+        SCALAR log_prob per batch element, log-det summed over events."""
+        base = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+        td = D.TransformedDistribution(base, D.AffineTransform(0.0, 2.0))
+        y = td.sample()
+        lp = _arr(td.log_prob(y))
+        assert lp.shape == ()
+        # oracle: base.log_prob(y/2) - 3*log 2
+        want = float(_arr(base.log_prob(
+            paddle.to_tensor(_arr(y) / 2.0)))) - 3 * math.log(2.0)
+        assert abs(float(lp) - want) < 1e-4
+
+    def test_segment_minmax_int_empty_segments(self):
+        """Review r5: int dtypes must not leak iinfo sentinels into
+        empty segments."""
+        x = paddle.to_tensor(np.array([[5], [7]], np.int32))
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([0, 0], np.int32))
+        out = _arr(G.send_u_recv(x, src, dst, "min", out_size=3))
+        np.testing.assert_array_equal(out, [[5], [0], [0]])
+        out = _arr(G.send_u_recv(x, src, dst, "max", out_size=3))
+        np.testing.assert_array_equal(out, [[7], [0], [0]])
+
+    def test_sample_neighbors_eids_not_implemented(self):
+        with pytest.raises(NotImplementedError, match="eids"):
+            G.sample_neighbors(np.array([0], np.int32),
+                               np.array([0, 1], np.int32),
+                               np.array([0], np.int32), return_eids=True)
+
+    def test_independent_transform_sums_event_dims(self):
+        base = D.AffineTransform(0.0, 2.0)
+        ind = D.IndependentTransform(base, 1)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        ld = _arr(ind.forward_log_det_jacobian(x))
+        assert ld.shape == (3,)
+        np.testing.assert_allclose(ld, 4 * math.log(2.0), atol=1e-5)
+
+
+class TestGeometric:
+    def test_send_u_recv_reduces(self):
+        x = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+        np.testing.assert_allclose(
+            _arr(G.send_u_recv(x, src, dst, "sum")),
+            [[1, 2], [6, 8], [3, 4]])
+        np.testing.assert_allclose(
+            _arr(G.send_u_recv(x, src, dst, "mean")),
+            [[1, 2], [3, 4], [3, 4]])
+        np.testing.assert_allclose(
+            _arr(G.send_u_recv(x, src, dst, "max")),
+            [[1, 2], [5, 6], [3, 4]])
+
+    def test_send_u_recv_grad_under_jit(self):
+        x = np.array([[1., 2], [3, 4], [5, 6]], np.float32)
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([1, 0], np.int32))
+
+        def f(xv):
+            out = G.send_u_recv(paddle.to_tensor(xv), src, dst, "sum",
+                                out_size=3)
+            return out._value.sum()
+
+        g = jax.jit(jax.grad(f))(x)
+        # rows 0/1 each feed one message; row 2 unused
+        np.testing.assert_allclose(np.asarray(g),
+                                   [[1, 1], [1, 1], [0, 0]])
+
+    def test_send_ue_recv_and_send_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+        y = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 2], np.int32))
+        dst = paddle.to_tensor(np.array([1, 1], np.int32))
+        # out_size=None infers max(dst)+1 = 2 rows (reference behaviour)
+        out = G.send_ue_recv(x, y, src, dst, "mul", "sum")
+        np.testing.assert_allclose(_arr(out), [[0.], [70.]])
+        out3 = G.send_ue_recv(x, y, src, dst, "mul", "sum", out_size=3)
+        np.testing.assert_allclose(_arr(out3), [[0.], [70.], [0.]])
+        uv = G.send_uv(x, x, src, dst, "add")
+        np.testing.assert_allclose(_arr(uv), [[3.], [5.]])
+
+    def test_segment_ops(self):
+        d = paddle.to_tensor(np.array([[1., 1], [2, 2], [3, 3], [4, 4]],
+                                      np.float32))
+        sid = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        np.testing.assert_allclose(_arr(G.segment_sum(d, sid)),
+                                   [[3, 3], [7, 7]])
+        np.testing.assert_allclose(_arr(G.segment_mean(d, sid)),
+                                   [[1.5, 1.5], [3.5, 3.5]])
+        np.testing.assert_allclose(_arr(G.segment_min(d, sid)),
+                                   [[1, 1], [3, 3]])
+        np.testing.assert_allclose(_arr(G.segment_max(d, sid)),
+                                   [[2, 2], [4, 4]])
+
+    def test_reindex_graph(self):
+        src, dst, nodes = G.reindex_graph(
+            paddle.to_tensor(np.array([10, 20], np.int32)),
+            paddle.to_tensor(np.array([30, 10, 20, 40], np.int32)),
+            paddle.to_tensor(np.array([2, 2], np.int32)))
+        assert list(_arr(nodes)) == [10, 20, 30, 40]
+        assert list(_arr(src)) == [2, 0, 1, 3]
+        assert list(_arr(dst)) == [0, 0, 1, 1]
+
+    def test_sample_neighbors(self):
+        row = np.array([1, 2, 0, 2, 0, 1], np.int32)
+        colptr = np.array([0, 2, 4, 6], np.int32)
+        nb, cnt = G.sample_neighbors(row, colptr,
+                                     np.array([0, 2], np.int32),
+                                     sample_size=1)
+        assert list(_arr(cnt)) == [1, 1]
+        flat = _arr(nb)
+        assert flat[0] in (1, 2) and flat[1] in (0, 1)
+        # full neighborhoods when sample_size = -1
+        nb2, cnt2 = G.sample_neighbors(row, colptr,
+                                       np.array([1], np.int32))
+        assert list(_arr(cnt2)) == [2] and set(_arr(nb2)) == {0, 2}
