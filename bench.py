@@ -185,23 +185,45 @@ def main():
     # platform block_until_ready returns before execution completes (round-2
     # observation: a 374M-model step "finished" in ~0.2ms), so only a value
     # dependency is a trustworthy fence.
-    try:
-        for _ in range(warmup):
-            loss, params, opt_state = step(params, opt_state, ids, labels)
-        float(loss)
-    except Exception as e:
-        if remat_mode != "dots":
-            raise
-        # "dots" keeps more activations live; fall back to full remat
-        print(f"# remat=dots failed ({type(e).__name__}); retrying with "
-              "full remat", file=sys.stderr)
-        step, init_fn = L.build_hybrid_train_step(
-            cfg, mesh, learning_rate=1e-4, remat=True, remat_policy="full",
-            k_steps=kstep)
-        params, opt_state = init_fn(seed=0)
-        for _ in range(warmup):
-            loss, params, opt_state = step(params, opt_state, ids, labels)
-        float(loss)
+    # Warmup with a fallback chain: remat=dots can OOM on live
+    # activations (-> full remat), and the k-step scan double-buffers
+    # the params+opt-state carry, which OOMs at the 13B geometry
+    # (measured 17.57G vs 15.75G HBM) -> k=1 single-step dispatch.
+    fallbacks = []
+    if remat_mode == "dots":
+        fallbacks.append(("full remat",
+                          dict(remat=True, remat_policy="full",
+                               k_steps=kstep)))
+    if kstep > 1:
+        # if dots is in play it has already failed by the time this
+        # fallback fires — pair k=1 with full remat, not dots again
+        k1_policy = "full" if remat_mode in ("dots", "off") else remat_mode
+        fallbacks.append(("k=1 (single-step dispatch)",
+                          dict(remat=remat_mode != "off",
+                               remat_policy=k1_policy, k_steps=1)))
+    while True:
+        try:
+            for _ in range(warmup):
+                loss, params, opt_state = step(params, opt_state, ids,
+                                               labels)
+            float(loss)
+            break
+        except Exception as e:
+            if not fallbacks:
+                raise
+            msg, retry = fallbacks.pop(0)
+            print(f"# warmup failed ({type(e).__name__}); retrying with "
+                  f"{msg}", file=sys.stderr)
+            if retry["k_steps"] == 1 and kstep > 1:
+                kstep = 1
+                ids, labels = ids[0], labels[0]
+            # drop the failed attempt's device state BEFORE re-init — the
+            # params+opt-state copy (10.4G at the 13B geometry) would
+            # otherwise coexist with the fresh one and OOM the retry too
+            step = params = opt_state = None
+            step, init_fn = L.build_hybrid_train_step(
+                cfg, mesh, learning_rate=1e-4, **retry)
+            params, opt_state = init_fn(seed=0)
 
     t0 = time.perf_counter()
     for _ in range(steps):

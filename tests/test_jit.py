@@ -157,3 +157,56 @@ def test_to_static_guard():
     assert net.forward.recompile_count == 0
     net(paddle.to_tensor(np.ones((5, 4), np.float32)))
     assert net.forward.recompile_count == 1
+
+
+class TestMultiStep:
+    def test_matches_sequential_steps(self):
+        """round 5: TrainStep.multi_step(k) — k optimizer steps in one
+        dispatch must produce the SAME params and last loss as k
+        sequential step() calls (distinct batches, AdamW bias
+        correction riding the scanned step index)."""
+        from paddle_tpu import optimizer
+
+        def build():
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 1))
+            opt = optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=net.parameters())
+            loss_fn = lambda m, x, y: ((m(x) - y) ** 2).mean()  # noqa: E731
+            return net, paddle.jit.TrainStep(net, loss_fn, opt)
+
+        rs = np.random.RandomState(0)
+        xs = rs.randn(3, 4, 8).astype(np.float32)
+        ys = rs.randn(3, 4, 1).astype(np.float32)
+
+        net1, step1 = build()
+        for i in range(3):
+            l_seq = step1(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i]))
+        net2, step2 = build()
+        l_multi = step2.multi_step(3)(paddle.to_tensor(xs),
+                                      paddle.to_tensor(ys))
+        np.testing.assert_allclose(float(l_seq._value),
+                                   float(l_multi._value), rtol=1e-5)
+        p1 = dict(net1.named_parameters())
+        for n, p2 in net2.named_parameters():
+            np.testing.assert_allclose(np.asarray(p1[n]._value),
+                                       np.asarray(p2._value),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_leading_axis_validated(self):
+        from paddle_tpu import optimizer
+        net = nn.Linear(4, 1)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, lambda m, x: m(x).sum(), opt)
+        run = step.multi_step(2)
+        with pytest.raises(ValueError, match="leading 2 axis"):
+            run(paddle.to_tensor(np.ones((3, 4), np.float32)))
+
+    def test_k_must_be_positive(self):
+        from paddle_tpu import optimizer
+        net = nn.Linear(4, 1)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, lambda m, x: m(x).sum(), opt)
+        with pytest.raises(ValueError, match=">= 1"):
+            step.multi_step(0)
