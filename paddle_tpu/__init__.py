@@ -71,7 +71,8 @@ def __getattr__(name):
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "static", "quantization",
                 "linalg", "fft", "sparse", "distribution", "signal",
-                "audio", "text", "utils", "onnx", "geometric"):
+                "audio", "text", "utils", "onnx", "geometric",
+                "device", "regularizer", "callbacks", "version"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

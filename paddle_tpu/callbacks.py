@@ -1,0 +1,10 @@
+"""``paddle_tpu.callbacks`` — hapi training callbacks at the reference's
+top-level path (python/paddle/callbacks/ re-exports hapi.callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL"]

@@ -5,10 +5,13 @@ Parity with python/paddle/distributed/ of the reference (SURVEY.md §2.3/§2.4).
 
 from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv,
+    is_initialized,
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, all_reduce, all_gather, reduce_scatter,
     broadcast, reduce, scatter, alltoall, all_to_all, send, recv, barrier,
+    gather, wait, get_backend, destroy_process_group, all_gather_object,
+    broadcast_object_list, scatter_object_list,
 )
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
@@ -20,10 +23,14 @@ from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import env  # noqa: F401
-from .auto_parallel.api import shard_tensor, ProcessMesh, Shard, Replicate, Partial  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    shard_tensor, ProcessMesh, Shard, Replicate, Partial, reshard,
+    shard_layer, dtensor_from_fn, Strategy,
+)
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
 from . import communication  # noqa: F401
 from .communication.p2p import (  # noqa: F401
     P2POp, batch_isend_irecv, isend, irecv,
 )
+from .communication import stream  # noqa: F401

@@ -134,3 +134,71 @@ def reshard(tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
 
 def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard a Layer's parameters over ``process_mesh`` in place
+    (reference api.py::shard_layer). ``shard_fn(sublayer_name, sublayer,
+    process_mesh)`` assigns placements per sublayer; the default
+    replicates every parameter onto the mesh. ``input_fn``/``output_fn``
+    are registered as forward pre/post hooks like the reference."""
+    from ...nn import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError(f"expected a Layer, got {type(layer).__name__}")
+
+    def default_shard(name, sub, mesh):
+        for _, p in sub.named_parameters(include_sublayers=False):
+            sharded = shard_tensor(p, mesh,
+                                   [Replicate()] * max(1, p.ndim))
+            p._value = sharded._value
+
+    fn = shard_fn or default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+class _StrategyConfig:
+    """Attribute bag with defaults (enable=False style, reference
+    paddle.distributed.Strategy sub-configs)."""
+
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+
+class Strategy:
+    """Reference paddle.distributed.Strategy (auto-parallel-to-static
+    config, python/paddle/distributed/auto_parallel/strategy.py:§0):
+    sub-configs for sharding / amp / pipeline / fused passes. Consumed
+    by auto_parallel.Engine; the GSPMD partitioner makes most knobs
+    advisory here — stage/degree feed mesh construction, amp maps to
+    paddle_tpu.amp levels."""
+
+    def __init__(self, config=None):
+        cfg = dict(config or {})
+
+        def sub(name, **defaults):
+            defaults.update(cfg.get(name, {}))
+            return _StrategyConfig(**defaults)
+
+        self.sharding = sub("sharding", enable=False, degree=1, stage=1)
+        self.amp = sub("amp", enable=False, dtype="float16", level="O1")
+        self.pipeline = sub("pipeline", enable=False,
+                            schedule_mode="1F1B", micro_batch_size=1,
+                            accumulate_steps=1)
+        self.fused_passes = sub("fused_passes", enable=False,
+                                fused_passes_list=[])
+
+    def __repr__(self):
+        parts = []
+        for k in ("sharding", "amp", "pipeline", "fused_passes"):
+            parts.append(f"{k}={getattr(self, k).__dict__}")
+        return f"Strategy({', '.join(parts)})"

@@ -263,3 +263,117 @@ def psum(x, axis: str):
 
 def ppermute(x, axis: str, perm):
     return lax.ppermute(x, axis, perm)
+
+
+# --------------------------------------------------------------------------
+# Long-tail parity surface (round 5): gather, wait, backend queries, object
+# collectives (reference python/paddle/distributed/communication/{gather,
+# all_gather,broadcast,scatter}.py object variants:§0)
+# --------------------------------------------------------------------------
+
+def gather(tensor, gather_list=None, dst=0, group: Optional[Group] = None,
+           sync_op=True):
+    """Gather shards to rank ``dst``. Single-controller semantics: the
+    gathered list materializes on the (one) host for every dst, so this
+    is all_gather with the reference's call shape (gather_list filled
+    in-place)."""
+    if gather_list is None:
+        gather_list = []
+    all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+    return gather_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference stream-sync. XLA programs order collectives by data
+    dependency, so this only forces materialization."""
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(v)
+    return tensor
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    """Backend name (reference: 'NCCL'/'GLOO'). ICI collectives compiled
+    by XLA; 'XLA' keeps code that just checks truthiness/logs happy."""
+    return "XLA"
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """Tear down comm state (reference parity): destroying the WORLD
+    group (or passing no group) clears the cached collective programs
+    and the env init flag; destroying a subgroup is a no-op beyond
+    dropping the handle (groups alias mesh axes — there is no per-group
+    state to free)."""
+    if group is None or group is _WORLD[0]:
+        _cached_program.cache_clear()
+        _WORLD[0] = None
+        from . import env as _env
+        _env._initialized[0] = False
+
+
+def _store_exchange(obj, op: str):
+    """Serialize ``obj`` and exchange across processes over the jax
+    coordination service (the reference runs tensor collectives on
+    pickled bytes; ``process_allgather`` on a padded uint8 buffer is the
+    same wire shape on the single-controller runtime). Requires
+    ``init_parallel_env()`` in multi-process jobs, like the reference
+    requires its process group init. Single-process worlds
+    short-circuit."""
+    import pickle
+
+    import numpy as np
+
+    from . import env as _env
+
+    world = _env.get_world_size()
+    if world <= 1:
+        return [obj]
+    if not jax.distributed.is_initialized():
+        raise RuntimeError(
+            "object collectives need the coordination service; call "
+            "paddle.distributed.init_parallel_env() first")
+    from jax.experimental import multihost_utils as mhu
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    # round 1: lengths (ragged pickles), round 2: padded bytes
+    sizes = mhu.process_allgather(np.asarray([payload.size], np.int64))
+    max_len = int(sizes.max())
+    buf = np.zeros((max_len,), np.uint8)
+    buf[:payload.size] = payload
+    data = np.asarray(mhu.process_allgather(buf))
+    return [pickle.loads(data[r, :int(sizes[r, 0])].tobytes())
+            for r in range(world)]
+
+
+def all_gather_object(object_list, obj, group: Optional[Group] = None):
+    """Gather arbitrary picklable objects from every process
+    (reference all_gather_object)."""
+    object_list[:] = _store_exchange(obj, "ag")
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group: Optional[Group] = None):
+    """Broadcast the list of objects from process ``src`` in place."""
+    from . import env as _env
+
+    world = _env.get_world_size()
+    if world <= 1:
+        return object_list
+    gathered = _store_exchange(list(object_list), "bc")
+    object_list[:] = gathered[src]
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group: Optional[Group] = None):
+    """Each process receives its slice of ``in_object_list`` from
+    ``src``."""
+    from . import env as _env
+
+    world = _env.get_world_size()
+    if world <= 1:
+        out_object_list[:] = [in_object_list[0] if in_object_list else None]
+        return out_object_list
+    gathered = _store_exchange(in_object_list, "sc")
+    rank = _env.get_rank()
+    out_object_list[:] = [gathered[src][rank]]
+    return out_object_list

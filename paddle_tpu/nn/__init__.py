@@ -5,6 +5,7 @@ Parity with python/paddle/nn/ of the reference (SURVEY.md §2.5).
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .layer import Layer, LayerList, Sequential, ParameterList, ParamAttr  # noqa: F401
 from .common_layers import (  # noqa: F401
     Linear, Embedding, Identity, Flatten, Dropout, Dropout2D, Upsample,

@@ -1,0 +1,262 @@
+"""``paddle_tpu.nn.utils`` — parameter reparametrizations and helpers.
+
+Parity with python/paddle/nn/utils/ of the reference: weight_norm /
+remove_weight_norm (forward-pre-hook reparametrization, like the
+reference's hook-based implementation), spectral_norm (hook form of the
+existing SpectralNorm layer's power iteration), clip_grad_norm_,
+clip_grad_value_, parameters_to_vector / vector_to_parameters.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+    "vector_to_parameters",
+]
+
+
+def _norm_except(v, dim: int):
+    """||v|| computed over every axis except ``dim`` (keepdims)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm). ``g`` and ``v`` become the trainable parameters; the
+    effective weight is rebuilt by a forward-pre-hook each call."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    v_val = w._value
+    g_val = _norm_except(v_val, dim)
+
+    from ..creation import create_parameter
+
+    v = create_parameter(list(v_val.shape), str(w.dtype))
+    v.set_value(np.asarray(v_val))
+    g = create_parameter(list(jnp.shape(g_val)), str(w.dtype))
+    g.set_value(np.asarray(g_val))
+    setattr(layer, f"{name}_v", v)
+    setattr(layer, f"{name}_g", g)
+    # the original parameter must stop being a trainable leaf, but stays
+    # reachable as a plain attribute so forward() keeps reading it
+    w.trainable = False
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.__dict__[name] = w
+
+    axes = None if dim is None else tuple(
+        i for i in range(v_val.ndim) if i != dim)
+
+    def hook(lyr, inputs):
+        # Tensor ops, so the effective weight carries the tape edges and
+        # grads flow to g and v (raw jnp here would silently detach)
+        vv, gg = getattr(lyr, f"{name}_v"), getattr(lyr, f"{name}_g")
+        norm = (vv * vv).sum(axis=axes, keepdim=dim is not None).sqrt()
+        eff = gg * vv / norm.clip(min=1e-12)
+        _set_derived(lyr, name, eff)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    post = layer.register_forward_post_hook(
+        lambda lyr, inputs, outputs: _drop_traced(lyr, name))
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
+        (handle, post)
+    hook(layer, ())  # make the current weight consistent immediately
+    return layer
+
+
+def _is_traced(t) -> bool:
+    import jax
+
+    return isinstance(t._value, jax.core.Tracer)
+
+
+def _set_derived(lyr, name: str, eff):
+    """Install the recomputed weight; under a jit trace, remember the
+    last EAGER value so the traced one never outlives the call (reading
+    ``layer.weight`` after a compiled step must not see a tracer)."""
+    if _is_traced(eff):
+        prev = lyr.__dict__.get(name)
+        if prev is not None and not _is_traced(prev):
+            lyr.__dict__[f"_derived_prev_{name}"] = prev
+    lyr.__dict__[name] = eff
+
+
+def _drop_traced(lyr, name: str):
+    cur = lyr.__dict__.get(name)
+    if cur is not None and _is_traced(cur):
+        prev = lyr.__dict__.pop(f"_derived_prev_{name}", None)
+        if prev is not None:
+            # eager snapshot from before the traced call; refreshed on
+            # the next eager forward (torch's weight cache behaves the
+            # same way)
+            lyr.__dict__[name] = prev
+        else:
+            lyr.__dict__.pop(name, None)
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g*v/||v|| back into a plain parameter and drop the hook."""
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"{name!r} is not weight-normed on this layer")
+    pre_h, post_h = hooks.pop(name)
+    pre_h.remove()
+    post_h.remove()
+    layer.__dict__.pop(f"_derived_prev_{name}", None)
+    v = getattr(layer, f"{name}_v")
+    g = getattr(layer, f"{name}_g")
+    dim_norm = _norm_except(v._value, _infer_dim(v, g))
+    folded = g._value * v._value / jnp.maximum(dim_norm, 1e-12)
+    layer.__dict__.pop(name, None)
+
+    from ..creation import create_parameter
+
+    w = create_parameter(list(folded.shape), str(v.dtype))
+    w.set_value(np.asarray(folded))
+    layer._parameters[name] = w
+    for suffix in ("_v", "_g"):
+        pname = f"{name}{suffix}"
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+        if hasattr(layer, pname):
+            delattr(layer, pname)
+    return layer
+
+
+def _infer_dim(v, g):
+    gs = jnp.shape(g._value)
+    if not gs:
+        return None
+    for i, s in enumerate(gs):
+        if s != 1:
+            return i
+    return 0
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0):
+    """Divide ``layer.<name>`` by its largest singular value, estimated
+    by power iteration refreshed on every forward (reference hook
+    semantics)."""
+    w = getattr(layer, name)
+    mat = w._value
+    if dim != 0:
+        perm = (dim,) + tuple(i for i in range(mat.ndim) if i != dim)
+        mat = jnp.transpose(mat, perm)
+    h = mat.shape[0]
+    rng = np.random.RandomState(0)
+    state = {"u": jnp.asarray(rng.randn(h).astype(np.float32))}
+    # the original stays the trainable parameter under <name>_orig
+    # (reference layout); <name> becomes the derived w/sigma each forward
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer._parameters[f"{name}_orig"] = w
+
+    def hook(lyr, inputs):
+        worig = getattr(lyr, f"{name}_orig")
+        m = worig._value
+        if dim != 0:
+            perm = (dim,) + tuple(i for i in range(m.ndim) if i != dim)
+            m = jnp.transpose(m, perm)
+        m2 = m.reshape(m.shape[0], -1)
+        u = state["u"]
+        # vvec from the current u so n_power_iterations=0 ("use the
+        # stored estimate", reference semantics) is well-defined
+        vvec = m2.T @ u
+        vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+        for _ in range(n_power_iterations):
+            u = m2 @ vvec
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            vvec = m2.T @ u
+            vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+        import jax as _jax
+        if not isinstance(u, _jax.core.Tracer):
+            state["u"] = u  # persist the iterate only outside traces
+        sigma = u @ m2 @ vvec
+        # Tensor division: grads flow to <name>_orig; u/v are constants
+        # at the current iterate (the reference trains the same way)
+        _set_derived(lyr, name, worig / Tensor(jnp.maximum(sigma, eps)))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    post = layer.register_forward_post_hook(
+        lambda lyr, inputs, outputs: _drop_traced(lyr, name))
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = \
+        (handle, post)
+    hook(layer, ())
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """In-place global-norm gradient clip over ``parameters`` (reference
+    nn.utils.clip_grad_norm_; the optimizer-attached ClipGradByGlobalNorm
+    covers the compiled path — this is the eager functional form).
+    Returns the total norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p._grad_value is not None]
+    if not params:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(p._grad_value)) for p in params]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.abs(p._grad_value.astype(jnp.float32))
+                        ** norm_type) for p in params),
+            1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p._grad_value = (p._grad_value.astype(jnp.float32)
+                         * scale).astype(p._grad_value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value: float):
+    """In-place elementwise gradient clamp to [-clip_value, clip_value]."""
+    params = parameters if isinstance(parameters, (list, tuple)) \
+        else [parameters]
+    for p in params:
+        if p._grad_value is not None:
+            p._grad_value = jnp.clip(p._grad_value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters) -> Tensor:
+    """Flatten parameters into one 1-D tensor (reference order)."""
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals) if vals
+                  else jnp.zeros((0,), jnp.float32))
+
+
+def vector_to_parameters(vec: Tensor, parameters: List):
+    """Write slices of ``vec`` back into the parameters, in order."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    params = list(parameters)
+    total = sum(int(np.prod(p.shape)) if len(p.shape) else 1
+                for p in params)
+    if total != v.shape[0]:
+        raise ValueError(f"vector length {v.shape[0]} != total parameter "
+                         f"size {total}")
+    at = 0
+    for p in params:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        p._value = v[at:at + n].reshape(tuple(p.shape)).astype(p._value.dtype)
+        at += n

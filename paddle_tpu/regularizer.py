@@ -1,0 +1,40 @@
+"""``paddle_tpu.regularizer`` — L1Decay / L2Decay.
+
+Parity with python/paddle/regularizer.py of the reference. Optimizers
+already read the ``coeff`` off these objects (optimizer.Optimizer.
+_parse_wd); L2Decay maps onto the decoupled weight-decay the fused
+update applies. L1Decay carries its coeff for the grad-penalty form —
+apply it through the loss (``coeff * sum(|w|)``) or an optimizer that
+reads ``regularization``; the decoupled path warns that it decays
+L2-style if handed an L1 object.
+"""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """Weight decay ``coeff * w`` (the decoupled form every optimizer
+    here implements)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    """L1 regularization ``coeff * sign(w)``. Kept for API parity; the
+    built-in fused optimizers implement decoupled (L2-style) decay, so
+    pass the penalty through the loss for true L1:
+    ``loss + coeff * sum(abs(w))``."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

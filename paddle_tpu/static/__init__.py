@@ -199,3 +199,5 @@ def cuda_places(device_ids=None):
 def cpu_places(device_count=1):
     from ..core.place import CPUPlace
     return [CPUPlace() for _ in range(device_count)]
+
+from . import nn  # noqa: E402,F401  (static.nn builders)

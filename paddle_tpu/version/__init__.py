@@ -1,0 +1,37 @@
+"""``paddle_tpu.version`` — version info module (reference
+python/paddle/version/__init__.py, generated at build time there)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"     # reference reports the CUDA toolkit; none here
+cudnn_version = "False"
+xpu_version = "False"
+istaged = False
+commit = "unknown"
+with_pip = True
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version

@@ -296,3 +296,226 @@ class TestOnnxShim:
     def test_export_raises_actionable(self):
         with pytest.raises(ImportError, match="jit.save"):
             paddle.onnx.export(None, "/tmp/x")
+
+
+class TestDeviceNamespace:
+    def test_queries(self):
+        assert paddle.device.get_device().startswith(("cpu", "tpu", "axon"))
+        assert paddle.device.get_device_count() >= 1
+        assert paddle.device.cuda.device_count() == 0
+        assert paddle.device.is_compiled_with_cuda() is False
+        assert paddle.device.is_compiled_with_distribute() is True
+        assert "cpu" in paddle.device.get_all_device_type()
+        paddle.device.synchronize()  # no-throw
+
+
+class TestRegularizer:
+    def test_l2_decay_feeds_optimizer(self):
+        from paddle_tpu import optimizer
+        net = paddle.nn.Linear(4, 4)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters(),
+                              weight_decay=paddle.regularizer.L2Decay(0.01))
+        assert opt._weight_decay == 0.01
+
+    def test_l1_decay_carries_coeff(self):
+        r = paddle.regularizer.L1Decay(0.5)
+        assert r.coeff == 0.5 and "L1Decay" in repr(r)
+
+
+class TestCallbacksAndVersion:
+    def test_callbacks_reexported(self):
+        assert paddle.callbacks.EarlyStopping is not None
+        assert paddle.callbacks.ModelCheckpoint is not None
+
+    def test_version(self, capsys):
+        assert paddle.version.full_version == paddle.__version__
+        paddle.version.show()
+        assert "full_version" in capsys.readouterr().out
+        assert paddle.version.cuda() == "False"
+
+
+class TestStaticNN:
+    def test_fc_param_reuse(self):
+        import paddle_tpu.static as st
+        st.nn.static_param_store().clear()
+        x = paddle.to_tensor(np.ones((2, 6), np.float32))
+        a = st.nn.fc(x, 3, name="shared")
+        b = st.nn.fc(x, 3, name="shared")
+        np.testing.assert_array_equal(np.asarray(a._value),
+                                      np.asarray(b._value))
+        assert len(st.nn.static_param_store()) == 1
+
+    def test_builders_shapes(self):
+        import paddle_tpu.static as st
+        st.nn.static_param_store().clear()
+        rs = np.random.RandomState(0)
+        img = paddle.to_tensor(rs.randn(2, 3, 8, 8).astype(np.float32))
+        assert tuple(st.nn.conv2d(img, 4, 3).shape) == (2, 4, 6, 6)
+        assert tuple(st.nn.batch_norm(img).shape) == (2, 3, 8, 8)
+        assert tuple(st.nn.layer_norm(img, begin_norm_axis=2).shape) \
+            == (2, 3, 8, 8)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+        assert tuple(st.nn.embedding(ids, (10, 5)).shape) == (2, 2, 5)
+        assert tuple(st.nn.prelu(img, mode="channel").shape) == (2, 3, 8, 8)
+
+    def test_control_flow_traced(self):
+        import jax
+        import paddle_tpu.static as st
+
+        def f(x):
+            big = st.nn.cond(x.sum() > 3.0, lambda: x * 10.0,
+                             lambda: x * -1.0)
+            i, acc = st.nn.while_loop(
+                lambda i, acc: i < 3,
+                lambda i, acc: (i + 1, acc + big.sum()),
+                [paddle.to_tensor(0), paddle.to_tensor(0.0)])
+            return acc._value
+
+        got = jax.jit(lambda v: f(paddle.to_tensor(v)))(
+            np.ones(4, np.float32))
+        np.testing.assert_allclose(np.asarray(got), 120.0)
+        got2 = jax.jit(lambda v: f(paddle.to_tensor(v)))(
+            np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(got2), -6.0)
+
+    def test_switch_case_and_case(self):
+        import paddle_tpu.static as st
+        r = st.nn.switch_case(1, [lambda: paddle.to_tensor(5.0),
+                                  lambda: paddle.to_tensor(7.0)])
+        assert float(r._value) == 7.0
+        r2 = st.nn.case([(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+                         (paddle.to_tensor(True), lambda: paddle.to_tensor(2.0))],
+                        default=lambda: paddle.to_tensor(3.0))
+        assert float(r2._value) == 2.0
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip_and_grads(self):
+        from paddle_tpu.nn import utils as U
+        lin = paddle.nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight._value).copy()
+        U.weight_norm(lin, "weight", dim=0)
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0,
+                                   rtol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names \
+            and "weight" not in names
+        loss = (lin(paddle.to_tensor(
+            np.ones((2, 4), np.float32))) ** 2).sum()
+        loss.backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        U.remove_weight_norm(lin, "weight")
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0,
+                                   rtol=1e-5)
+        assert "weight" in [n for n, _ in lin.named_parameters()]
+
+    def test_weight_norm_trains_compiled(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.nn import utils as U
+        net = paddle.nn.Linear(4, 2)
+        U.weight_norm(net, "weight")
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=net.parameters())
+        step = paddle.jit.TrainStep(
+            net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(8, 2).astype(np.float32))
+        l0 = float(step(x, y)._value)
+        for _ in range(15):
+            l1 = float(step(x, y)._value)
+        assert l1 < l0
+
+    def test_spectral_norm_unit_sigma(self):
+        from paddle_tpu.nn import utils as U
+        lin = paddle.nn.Linear(8, 8)
+        U.spectral_norm(lin, "weight", n_power_iterations=5)
+        out = lin(paddle.to_tensor(np.ones((1, 8), np.float32)))
+        s = np.linalg.svd(np.asarray(lin.weight._value),
+                          compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.05
+        (out ** 2).sum().backward()
+        assert lin.weight_orig.grad is not None
+
+    def test_clip_grad_norm_and_value(self):
+        from paddle_tpu.nn import utils as U
+        p = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        (p * p * 50).sum().backward()
+        total = U.clip_grad_norm_([p], max_norm=1.0)
+        assert float(total._value) > 1.0
+        assert abs(np.linalg.norm(np.asarray(p.grad._value)) - 1.0) < 1e-4
+        q = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        (q * 10).sum().backward()
+        U.clip_grad_value_([q], 0.5)
+        np.testing.assert_allclose(np.asarray(q.grad._value), [0.5, 0.5])
+
+    def test_vector_roundtrip(self):
+        from paddle_tpu.nn import utils as U
+        net = paddle.nn.Linear(3, 2)
+        vec = U.parameters_to_vector(net.parameters())
+        assert tuple(vec.shape) == (3 * 2 + 2,)
+        vals = [np.asarray(p._value).copy() for p in net.parameters()]
+        U.vector_to_parameters(vec * 2.0, net.parameters())
+        for p, v in zip(net.parameters(), vals):
+            np.testing.assert_allclose(np.asarray(p._value), v * 2.0,
+                                       rtol=1e-6)
+        with pytest.raises(ValueError, match="length"):
+            U.vector_to_parameters(
+                paddle.to_tensor(np.ones(3, np.float32)),
+                net.parameters())
+
+
+class TestReviewR5Fixes:
+    def test_weight_readable_after_compiled_step(self):
+        """Review: the weight-norm hook must not leak a tracer into the
+        layer's weight cache when forward runs under jit."""
+        from paddle_tpu import optimizer
+        from paddle_tpu.nn import utils as U
+        net = paddle.nn.Linear(4, 2)
+        U.weight_norm(net, "weight")
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=net.parameters())
+        step = paddle.jit.TrainStep(
+            net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+        rs = np.random.RandomState(0)
+        step(paddle.to_tensor(rs.randn(8, 4).astype(np.float32)),
+             paddle.to_tensor(rs.randn(8, 2).astype(np.float32)))
+        w = np.asarray(net.weight._value)   # raised TracerArrayConversion
+        assert w.shape == (4, 2)
+
+    def test_spectral_norm_zero_iterations(self):
+        from paddle_tpu.nn import utils as U
+        lin = paddle.nn.Linear(6, 6)
+        U.spectral_norm(lin, "weight", n_power_iterations=0)
+        out = lin(paddle.to_tensor(np.ones((1, 6), np.float32)))
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_destroy_subgroup_keeps_world(self):
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        g = dist.new_group(ranks=[0])
+        dist.destroy_process_group(g)
+        assert dist.is_initialized()
+        dist.destroy_process_group()
+        assert not dist.is_initialized()
+
+    def test_multi_step_cached_per_k(self):
+        from paddle_tpu import optimizer
+        net = paddle.nn.Linear(4, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, lambda m, x: m(x).sum(), opt)
+        assert step.multi_step(2) is step.multi_step(2)
+        assert step.multi_step(3) is not step.multi_step(2)
+
+    def test_static_nn_unnamed_creates_fresh(self):
+        """Documented reference semantics: unnamed builder calls create
+        new parameters (named calls share — tested above)."""
+        import paddle_tpu.static as st
+        st.nn.static_param_store().clear()
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        st.nn.fc(x, 2)
+        st.nn.fc(x, 2)
+        assert len(st.nn.static_param_store()) == 2

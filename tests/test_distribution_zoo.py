@@ -65,6 +65,14 @@ class TestDistributionMoments:
             ent = float(np.asarray(_arr(d.entropy())).reshape(-1)[0])
             assert abs(ent - mc) < 0.05 * max(1.0, abs(ent)), type(d).__name__
 
+    def test_poisson_entropy_small_and_large_rate(self):
+        """Review r5: the Stirling surrogate was -4.7 at rate 0.1 (true
+        0.334); exact series now covers small rates."""
+        for r, want in ((0.1, 0.33368), (1.0, 1.30484), (4.0, 2.08667),
+                        (50.0, 3.37327)):
+            got = float(np.asarray(_arr(D.Poisson(r).entropy())))
+            assert abs(got - want) < 2e-3, (r, got, want)
+
     def test_log_prob_normalization_discrete(self):
         # Binomial over its support sums to 1
         d = D.Binomial(8, 0.35)
