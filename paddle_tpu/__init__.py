@@ -72,7 +72,7 @@ def __getattr__(name):
                 "profiler", "models", "inference", "static", "quantization",
                 "linalg", "fft", "sparse", "distribution", "signal",
                 "audio", "text", "utils", "onnx", "geometric",
-                "device", "regularizer", "callbacks", "version"):
+                "device", "regularizer", "callbacks", "version", "hub"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

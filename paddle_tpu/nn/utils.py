@@ -74,7 +74,7 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
     post = layer.register_forward_post_hook(
         lambda lyr, inputs, outputs: _drop_traced(lyr, name))
     layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
-        (handle, post)
+        (handle, post, dim)
     hook(layer, ())  # make the current weight consistent immediately
     return layer
 
@@ -114,13 +114,13 @@ def remove_weight_norm(layer: Layer, name: str = "weight"):
     hooks = layer.__dict__.get("_weight_norm_hooks", {})
     if name not in hooks:
         raise ValueError(f"{name!r} is not weight-normed on this layer")
-    pre_h, post_h = hooks.pop(name)
+    pre_h, post_h, dim = hooks.pop(name)
     pre_h.remove()
     post_h.remove()
     layer.__dict__.pop(f"_derived_prev_{name}", None)
     v = getattr(layer, f"{name}_v")
     g = getattr(layer, f"{name}_g")
-    dim_norm = _norm_except(v._value, _infer_dim(v, g))
+    dim_norm = _norm_except(v._value, dim)
     folded = g._value * v._value / jnp.maximum(dim_norm, 1e-12)
     layer.__dict__.pop(name, None)
 
@@ -136,16 +136,6 @@ def remove_weight_norm(layer: Layer, name: str = "weight"):
         if hasattr(layer, pname):
             delattr(layer, pname)
     return layer
-
-
-def _infer_dim(v, g):
-    gs = jnp.shape(g._value)
-    if not gs:
-        return None
-    for i, s in enumerate(gs):
-        if s != 1:
-            return i
-    return 0
 
 
 def spectral_norm(layer: Layer, name: str = "weight",

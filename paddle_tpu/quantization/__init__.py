@@ -170,3 +170,9 @@ def quantize_stacked_params(params: dict, keys=None,
             q, s = weight_quantize(w, algo)
             out[k] = {"q": q, "scale": s}
     return out
+
+
+from .qat import (  # noqa: E402,F401
+    QAT, PTQ, QuantConfig, FakeQuanterWithAbsMax, AbsmaxObserver,
+    QuantedLinear, quanted_layers,
+)

@@ -519,3 +519,33 @@ class TestReviewR5Fixes:
         st.nn.fc(x, 2)
         st.nn.fc(x, 2)
         assert len(st.nn.static_param_store()) == 2
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(out_features=2):\n"
+            "    'A tiny linear model.'\n"
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.nn.Linear(4, out_features)\n")
+        names = paddle.hub.list(str(tmp_path))
+        assert "tiny" in names
+        assert "tiny linear" in paddle.hub.help(str(tmp_path), "tiny")
+        m = paddle.hub.load(str(tmp_path), "tiny", out_features=3)
+        assert tuple(m(paddle.to_tensor(
+            np.ones((1, 4), np.float32))).shape) == (1, 3)
+
+    def test_remote_sources_refused(self):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_weight_norm_dim1_size1_roundtrip(self):
+        """Review r5: remove_weight_norm must use the RECORDED dim, not
+        re-infer it (size-1 normed axes mis-inferred)."""
+        from paddle_tpu.nn import utils as U
+        lin = paddle.nn.Linear(4, 1)
+        w0 = np.asarray(lin.weight._value).copy()
+        U.weight_norm(lin, "weight", dim=1)
+        U.remove_weight_norm(lin, "weight")
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0,
+                                   rtol=1e-5, atol=1e-7)

@@ -220,3 +220,144 @@ class TestFusedMultiTransformerInt8:
         dyn = np.asarray(_int8_mm(x, wq, ws))
         cal = np.asarray(_int8_mm(x, wq, ws, in_scale=amax))
         np.testing.assert_allclose(cal, dyn, rtol=1e-6, atol=1e-6)
+
+
+class TestQATWorkflow:
+    """Round-5 QAT/PTQ surface (reference python/paddle/quantization/)."""
+
+    def _net(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 4))
+
+    def test_quantize_swaps_configured_linears(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig, quanted_layers)
+        net = self._net()
+        QAT(QuantConfig(activation=FakeQuanterWithAbsMax)).quantize(net)
+        assert len(quanted_layers(net)) == 2
+
+    def test_fake_quant_close_to_float_and_ste_trains(self):
+        from paddle_tpu import optimizer
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig)
+        net = self._net()
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(32, 8).astype(np.float32))
+        ref = np.asarray(net(x)._value)
+        QAT(QuantConfig(activation=FakeQuanterWithAbsMax)).quantize(net)
+        for _ in range(5):
+            out = net(x)          # calibrates the moving-average scales
+        err = np.abs(np.asarray(out._value) - ref).max() \
+            / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05
+        # straight-through gradients train under the compiled TrainStep
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters())
+        y = paddle.to_tensor(rs.randn(32, 4).astype(np.float32))
+        step = paddle.jit.TrainStep(
+            net, lambda m, a, b: ((m(a) - b) ** 2).mean(), opt)
+        l0 = float(step(x, y)._value)
+        for _ in range(25):
+            l1 = float(step(x, y)._value)
+        assert l1 < l0
+
+    def test_convert_lowers_to_weight_only(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig, WeightOnlyLinear)
+        net = self._net()
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        q = QAT(QuantConfig(activation=FakeQuanterWithAbsMax))
+        q.quantize(net)
+        fq = np.asarray(net(x)._value)
+        q.convert(net)
+        kinds = [type(s).__name__ for _, s in net.named_sublayers()]
+        assert kinds.count("WeightOnlyLinear") == 2
+        out = np.asarray(net(x)._value)
+        # int8-weight output stays close to the fake-quant one (acts no
+        # longer quantized; weight grid identical)
+        assert np.abs(out - fq).max() / (np.abs(fq).max() + 1e-9) < 0.05
+
+    def test_ptq_observer_flow(self):
+        from paddle_tpu.quantization import PTQ, AbsmaxObserver
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8),
+                                   paddle.nn.Linear(8, 2))
+        p = PTQ()
+        p.quantize(net)
+        rs = np.random.RandomState(2)
+        x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        ref = np.asarray(net(x)._value)
+        for _ in range(3):
+            net(x)
+        # observers collected a positive scale
+        obs = [s for _, s in net.named_sublayers()
+               if isinstance(s, AbsmaxObserver)]
+        assert obs and all(o.scale > 0 for o in obs)
+        p.convert(net)
+        out = np.asarray(net(x)._value)
+        assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
+
+    def test_name_and_type_config(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig, quanted_layers)
+        net = self._net()
+        cfg = QuantConfig().add_name_config(
+            "0", activation=FakeQuanterWithAbsMax)
+        QAT(cfg).quantize(net)
+        assert [n for n, _ in quanted_layers(net)] == ["0"]
+
+    def test_cold_start_compiled_qat_calibrates(self):
+        """Review r5: a QAT net whose FIRST forwards run under the
+        compiled step must still calibrate (scale buffer rides the bind
+        carry like BN stats) instead of collapsing activations to 0."""
+        from paddle_tpu import optimizer
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig, quanted_layers)
+        net = self._net()
+        QAT(QuantConfig(activation=FakeQuanterWithAbsMax)).quantize(net)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(32, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(32, 4).astype(np.float32))
+        step = paddle.jit.TrainStep(
+            net, lambda m, a, b: ((m(a) - b) ** 2).mean(), opt)
+        l0 = float(step(x, y)._value)
+        for _ in range(20):
+            l1 = float(step(x, y)._value)
+        # scales calibrated through the compiled path (were frozen 0,
+        # which collapsed every activation to ~0 and froze the loss at
+        # the predict-zeros MSE)
+        for _, ql in quanted_layers(net):
+            assert float(ql.activation_quanter.scale._value) > 0.0
+        # and training makes progress (the broken path could not)
+        assert l1 < l0
+
+    def test_weight_bits_config_respected(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                             QuantConfig, quanted_layers)
+        net = self._net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax, weight=4)
+        QAT(cfg).quantize(net)
+        assert all(q.weight_bits == 4 for _, q in quanted_layers(net))
+        with pytest.raises(ValueError, match="weight quanter"):
+            QuantConfig(weight="int8")
+
+    def test_ptq_scales_reach_converted_layers(self):
+        from paddle_tpu.quantization import PTQ
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8),
+                                   paddle.nn.Linear(8, 2))
+        p = PTQ()
+        p.quantize(net)
+        rs = np.random.RandomState(2)
+        x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        for _ in range(3):
+            net(x)
+        scales = p.activation_scales(net)
+        assert scales and all(v > 0 for v in scales.values())
+        p.convert(net)
+        for _, sub in net.named_sublayers():
+            if type(sub).__name__ == "WeightOnlyLinear":
+                assert getattr(sub, "act_scale", 0) > 0
