@@ -538,14 +538,13 @@ def bench_vit():
                                          (B,)).astype(np.int64))
         ksteps = 1 if smoke else max(
             1, int(os.environ.get("BENCH_VIT_KSTEP", "6")))
-        kstep = ksteps
-        if kstep > 1:
+        if ksteps > 1:
             # VERDICT r4 next-round #3: k steps per host fence — distinct
             # from the r4-rejected per-LAYER stacked scan. Sweep: k=6 is
             # the peak (241.8 img/s, 44.0%); k=8 measured a 19x
             # regression (XLA scheduling pathology, ViT-specific; BERT
             # runs k=8 fine) — keep k<=6.
-            run = _kstep_runner(tstep, (x._value, y._value), kstep)
+            run = _kstep_runner(tstep, (x._value, y._value), ksteps)
         else:
             run = lambda: tstep(x, y)  # noqa: E731
     else:

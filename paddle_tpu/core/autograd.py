@@ -60,7 +60,8 @@ def set_grad_enabled(mode: bool):
 class GradNode:
     """One recorded op: holds the vjp closure and edges to input tensors."""
 
-    __slots__ = ("vjp_fn", "inputs", "n_outputs", "name", "released", "out_avals")
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "name", "released",
+                 "out_avals", "out_refs")
 
     def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals: Sequence[Any],
                  name: str = "op"):
@@ -70,6 +71,10 @@ class GradNode:
         self.n_outputs = len(self.out_avals)
         self.name = name
         self.released = False
+        # weakrefs to output Tensors, filled by Tensor.__init__ — lets
+        # backward fire Tensor.register_hook with the ACCUMULATED
+        # cotangent at node-pop time (outputs only here; no ref cycle)
+        self.out_refs = [None] * self.n_outputs
 
     def _zero_cots(self):
         # jax.vjp requires float0 cotangents for non-differentiable (int/bool)
@@ -152,11 +157,41 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
     pending = {id(root): [None] * root.n_outputs}
     pending[id(root)][tensor._out_index] = seed
 
+    def _apply_hooks(t, g):
+        """Tensor.register_hook chain on an ACCUMULATED gradient."""
+        hooks = getattr(t, "_grad_hooks", None)
+        if not hooks:
+            return g
+        for hook in list(hooks["fns"].values()):
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+        return g
+
+    # leaves with hooks: per-backward sums collected here so the hook
+    # fires ONCE with the full accumulated gradient at the end (the
+    # reference's AccumulateGrad timing), not per incoming edge
+    hooked_leaf_sums: dict = {}
+    hooked_leaf_tensors: dict = {}
+
     order = _toposort(root)
     for node in reversed(order):
         cots = pending.pop(id(node), None)
         if cots is None or node.released:
             continue
+        # a node's output cotangents are COMPLETE when it pops (all
+        # consumers processed first) — the hook point for intermediates
+        for i, c in enumerate(cots):
+            if c is None:
+                continue
+            ref = node.out_refs[i]
+            t_out = ref() if ref is not None else None
+            if t_out is not None and getattr(t_out, "_grad_hooks", None):
+                cots[i] = _apply_hooks(t_out, c)
+                if capture is not None and id(t_out) in capture:
+                    # replace the pre-hook per-edge sums with the
+                    # hook-transformed total
+                    capture[id(t_out)] = cots[i]
         # jax.vjp requires a cotangent for every output; fill zeros.
         # We need output shapes: vjp_fn handles symbolic zeros poorly, so the
         # dispatcher stores output avals on the node via a closure default.
@@ -173,7 +208,11 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
                 continue
             n = getattr(t, "_grad_node", None)
             if n is None:
-                if capture is None:
+                if getattr(t, "_grad_hooks", None):
+                    hooked_leaf_sums[id(t)] = _accumulate(
+                        hooked_leaf_sums.get(id(t)), g)
+                    hooked_leaf_tensors[id(t)] = t
+                elif capture is None:
                     # leaf: accumulate into .grad
                     t._grad_value = _accumulate(t._grad_value, g)
             else:
@@ -181,6 +220,15 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
                 lst[t._out_index] = _accumulate(lst[t._out_index], g)
         if not retain_graph:
             node.release()
+
+    for tid, g in hooked_leaf_sums.items():
+        t = hooked_leaf_tensors[tid]
+        g = _apply_hooks(t, g)
+        if capture is not None:
+            if tid in capture:
+                capture[tid] = g  # hook-transformed total replaces sums
+        else:
+            t._grad_value = _accumulate(t._grad_value, g)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,

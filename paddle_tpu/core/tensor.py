@@ -48,6 +48,7 @@ class Tensor:
         "persistable",
         "_sharding_spec",
         "is_distributed",
+        "_grad_hooks",
         "__weakref__",
     )
 
@@ -66,6 +67,10 @@ class Tensor:
         self._value = value
         self.stop_gradient = stop_gradient
         self._grad_node = _grad_node
+        if _grad_node is not None and getattr(_grad_node, "out_refs", None) \
+                is not None:
+            import weakref
+            _grad_node.out_refs[_out_index] = weakref.ref(self)
         self._out_index = _out_index
         self._grad_value = None
         if name is None:
@@ -208,6 +213,29 @@ class Tensor:
 
     def __hash__(self):
         return id(self)
+
+    # -- grad hooks ---------------------------------------------------------
+    def register_hook(self, hook):
+        """Call ``hook(grad)`` when this tensor's gradient is computed
+        during backward; a non-None return replaces the gradient
+        (reference Tensor.register_hook). Returns a removable handle."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a grad hook on a tensor with "
+                "stop_gradient=True")
+        hooks = getattr(self, "_grad_hooks", None)
+        if hooks is None:
+            hooks = {"n": 0, "fns": {}}
+            self._grad_hooks = hooks
+        hid = hooks["n"]          # monotonic: a stale handle's second
+        hooks["n"] += 1           # remove() must never hit a newer hook
+        hooks["fns"][hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                hooks["fns"].pop(hid, None)
+
+        return _Handle()
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self):

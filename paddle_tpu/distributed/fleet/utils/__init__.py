@@ -4,3 +4,6 @@ from . import sequence_parallel_utils  # noqa: F401
 from . import mix_precision_utils  # noqa: F401
 from . import tensor_fusion_helper  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
+
+# reference import path: paddle.distributed.fleet.utils.recompute
+from ..recompute import recompute, recompute_sequential  # noqa: E402,F401

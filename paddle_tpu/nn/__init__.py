@@ -38,3 +38,7 @@ from .layers_extra import (  # noqa: F401
     MultiLabelSoftMarginLoss, MultiMarginLoss, PoissonNLLLoss,
     SoftMarginLoss, AdaptiveLogSoftmaxWithLoss,
 )
+
+# imported LAST: quant pulls paddle_tpu.quantization, whose QAT module
+# needs nn.Linear already bound (circular otherwise)
+from . import quant  # noqa: E402,F401

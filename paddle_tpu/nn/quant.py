@@ -1,0 +1,12 @@
+"""``paddle_tpu.nn.quant`` — the reference's quant-op namespace
+(python/paddle/nn/quant/quantized_linear.py:§0 exposes
+weight_only_linear / weight_quantize / weight_dequantize there; the
+implementations live in paddle_tpu.quantization)."""
+
+from ..quantization import (  # noqa: F401
+    WeightOnlyLinear, weight_dequantize, weight_only_linear,
+    weight_quantize,
+)
+
+__all__ = ["weight_only_linear", "weight_quantize", "weight_dequantize",
+           "WeightOnlyLinear"]

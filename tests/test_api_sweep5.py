@@ -549,3 +549,91 @@ class TestHub:
         U.remove_weight_norm(lin, "weight")
         np.testing.assert_allclose(np.asarray(lin.weight._value), w0,
                                    rtol=1e-5, atol=1e-7)
+
+
+class TestGradHooksAndAliases:
+    def test_register_hook_observe_and_replace(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        seen = {}
+        x.register_hook(lambda g: seen.setdefault(
+            "g", np.asarray(g._value)))
+        (x * 3.0).sum().backward()
+        np.testing.assert_allclose(seen["g"], [3.0, 3.0])
+        np.testing.assert_allclose(np.asarray(x.grad._value), [3.0, 3.0])
+
+        y = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        y.register_hook(lambda g: g * 10.0)
+        (y * 2.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(y.grad._value), [20.0])
+
+    def test_register_hook_intermediate_and_remove(self):
+        a = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        b = a * 3.0
+        b.register_hook(lambda g: g * 100.0)
+        (b * 1.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(a.grad._value), [300.0])
+
+        c = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        h = c.register_hook(lambda g: g * 5.0)
+        h.remove()
+        (c * 2.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(c.grad._value), [2.0])
+
+    def test_register_hook_requires_grad(self):
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(RuntimeError, match="stop_gradient"):
+            t.register_hook(lambda g: g)
+
+    def test_namespace_aliases(self):
+        import paddle_tpu.distributed.fleet as fleet
+        import paddle_tpu.nn as nn
+        assert nn.quant.weight_only_linear is not None
+        assert nn.quant.weight_quantize is not None
+        assert callable(fleet.utils.recompute)
+
+    def test_hook_fires_once_with_accumulated_grad(self):
+        """Review r5: a multi-use tensor's hook gets the ACCUMULATED
+        gradient once, not per-edge partials."""
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        calls = []
+        x.register_hook(lambda g: calls.append(np.asarray(g._value)))
+        (x * 2.0 + x * 3.0).sum().backward()
+        assert len(calls) == 1
+        np.testing.assert_allclose(calls[0], [5.0])
+        # non-linear hook sees the total (clip(5)=4, not clip2+clip3=5)
+        y = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        y.register_hook(lambda g: g.clip(max=4.0))
+        (y * 2.0 + y * 3.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(y.grad._value), [4.0])
+
+    def test_hook_on_backward_root_fires_with_seed(self):
+        a = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        b = a * 2.0
+        b.register_hook(lambda g: g * 10.0)
+        b.backward()
+        np.testing.assert_allclose(np.asarray(a.grad._value), [20.0])
+
+    def test_stale_handle_remove_is_noop(self):
+        t = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        h1 = t.register_hook(lambda g: g)
+        h1.remove()
+        t.register_hook(lambda g: g * 7.0)
+        h1.remove()   # must not delete the newer hook
+        (t * 1.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(t.grad._value), [7.0])
+
+    def test_hook_with_paddle_grad_capture(self):
+        from paddle_tpu.autograd import grad
+        q = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        q.register_hook(lambda g: g * 10.0)
+        (gq,) = grad((q * 3.0).sum(), q)
+        np.testing.assert_allclose(np.asarray(gq._value), [30.0])
