@@ -16,6 +16,28 @@ from .core import (  # noqa: F401
     set_device, get_device, is_compiled_with_tpu,
     no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
 )
+from .core.autograd import grad  # noqa: F401  (paddle.grad top level)
+
+
+def in_dynamic_mode() -> bool:
+    """Always True: execution is eager-first; static graphs exist only
+    as traced StableHLO programs (reference in_dynamic_mode)."""
+    return not _static_mode[0]
+
+
+_static_mode = [False]
+
+
+def enable_static():
+    """Reference enable_static: here only flips the mode QUERY — ops
+    stay eager (the static surface is paddle.static over traces), so
+    code gated on in_dynamic_mode() behaves consistently."""
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
 from .core.dtype import (  # noqa: F401
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
     float32, float64, complex64, complex128,

@@ -637,3 +637,20 @@ class TestGradHooksAndAliases:
         q.register_hook(lambda g: g * 10.0)
         (gq,) = grad((q * 3.0).sum(), q)
         np.testing.assert_allclose(np.asarray(gq._value), [30.0])
+
+
+class TestTopLevelModeAPIs:
+    def test_paddle_grad_top_level(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        (g,) = paddle.grad((x * x).sum(), x)
+        np.testing.assert_allclose(np.asarray(g._value), [2.0, 4.0])
+
+    def test_static_mode_toggles(self):
+        assert paddle.in_dynamic_mode()
+        paddle.enable_static()
+        try:
+            assert not paddle.in_dynamic_mode()
+        finally:
+            paddle.disable_static()
+        assert paddle.in_dynamic_mode()
