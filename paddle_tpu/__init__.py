@@ -18,26 +18,6 @@ from .core import (  # noqa: F401
 )
 from .core.autograd import grad  # noqa: F401  (paddle.grad top level)
 
-
-def in_dynamic_mode() -> bool:
-    """Always True: execution is eager-first; static graphs exist only
-    as traced StableHLO programs (reference in_dynamic_mode)."""
-    return not _static_mode[0]
-
-
-_static_mode = [False]
-
-
-def enable_static():
-    """Reference enable_static: here only flips the mode QUERY — ops
-    stay eager (the static surface is paddle.static over traces), so
-    code gated on in_dynamic_mode() behaves consistently."""
-    _static_mode[0] = True
-
-
-def disable_static():
-    _static_mode[0] = False
-
 from .core.dtype import (  # noqa: F401
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
     float32, float64, complex64, complex128,
@@ -75,6 +55,27 @@ from . import autograd  # noqa: F401
 from . import jit  # noqa: F401
 from . import framework  # noqa: F401
 from .framework.io_save import save, load  # noqa: F401
+
+_static_mode = [False]
+
+
+def in_dynamic_mode() -> bool:
+    """True unless enable_static() was called. Execution stays
+    eager-first either way; static graphs exist only as traced
+    StableHLO programs (paddle.static), so the flag is a mode QUERY
+    for gated user code, not an execution switch."""
+    return not _static_mode[0]
+
+
+def enable_static():
+    """Reference enable_static: flips the in_dynamic_mode() query —
+    ops stay eager (the static surface is paddle.static over traces)."""
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
 
 # subpackages imported lazily by user code: distributed, vision, hapi, parallel,
 # incubate, profiler (kept out of the base import to keep import time low)

@@ -232,7 +232,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
-         allow_unused=True):
+         allow_unused=False):
     """Functional gradient query, parity with ``paddle.grad``.
 
     Implemented by running the tape backward and reading leaf grads without
