@@ -61,7 +61,7 @@ def collective_volume(hlo_text):
 def main():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from paddle_tpu.core.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from functools import partial
 

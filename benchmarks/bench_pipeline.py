@@ -21,6 +21,7 @@ import numpy as np  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 from paddle_tpu.parallel import pipeline as ppipe  # noqa: E402
+from paddle_tpu.core.compat import shard_map
 
 S, H, MB, M = 4, 256, 8, 32
 V = 2  # interleave chunks
@@ -73,7 +74,7 @@ def build(kind, mesh):
                 return jnp.mean(jax.vmap(loss_fn)(out, lab))
             return jax.value_and_grad(loss_of)(params)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         prog, mesh=mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
         out_specs=(P(), {"w": P("pp"), "b": P("pp")}),

@@ -92,7 +92,8 @@ _LINALG_TOPLEVEL = frozenset((
 def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
-                "profiler", "models", "inference", "static", "quantization",
+                "profiler", "models", "inference", "serving", "static",
+                "quantization",
                 "linalg", "fft", "sparse", "distribution", "signal",
                 "audio", "text", "utils", "onnx", "geometric",
                 "device", "regularizer", "callbacks", "version", "hub"):

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..core.compat import distributed_is_initialized, shard_map
 
 from ..core.tensor import Tensor
 from ..parallel import mesh as _mesh
@@ -327,7 +327,7 @@ def _store_exchange(obj, op: str):
     world = _env.get_world_size()
     if world <= 1:
         return [obj]
-    if not jax.distributed.is_initialized():
+    if not distributed_is_initialized():
         raise RuntimeError(
             "object collectives need the coordination service; call "
             "paddle.distributed.init_parallel_env() first")

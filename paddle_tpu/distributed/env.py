@@ -13,6 +13,7 @@ import os
 from typing import Optional
 
 import jax
+from ..core.compat import distributed_is_initialized
 
 _initialized = [False]
 
@@ -53,7 +54,7 @@ def init_parallel_env(strategy=None, timeout_s: Optional[int] = None
     # XLA backend, after which jax.distributed.initialize() refuses to run
     # (found by the round-3 two-process rehearsal, tests/test_launch.py).
     # is_initialized() only checks the coordination-service client handle.
-    if nprocs > 1 and not jax.distributed.is_initialized():
+    if nprocs > 1 and not distributed_is_initialized():
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         master = coordinator_address()
         kwargs = {}

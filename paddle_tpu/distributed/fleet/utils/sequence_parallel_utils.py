@@ -42,6 +42,7 @@ from ...topology import get_hybrid_communicate_group
 from ...meta_parallel.mp_layers import (  # noqa: F401  (re-export parity)
     mark_as_sequence_parallel_parameter,
 )
+from ....core.compat import axis_size
 
 SEQ_AXIS = 0  # [s, b, h] layout, as in the reference
 
@@ -59,7 +60,7 @@ def _mp_degree() -> int:
 # ---------------------------------------------------------------------------
 
 def _slice_to_rank(v, axis_name: str, dim: int):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     size = v.shape[dim] // n
     return lax.dynamic_slice_in_dim(v, idx * size, size, dim)

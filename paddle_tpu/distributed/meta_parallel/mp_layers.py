@@ -31,6 +31,7 @@ from ...nn import initializer as I
 from ...nn.layer import Layer
 from ...parallel import pcontext, mesh as _mesh
 from ..topology import get_hybrid_communicate_group
+from ...core.compat import axis_size
 
 
 def _mp_degree() -> int:
@@ -111,7 +112,7 @@ class RowParallelLinear(Layer):
             def fn(xv, wv, *rest):
                 if not self.input_is_parallel:
                     # split the full activation to this rank's slice
-                    n = lax.axis_size(ax)
+                    n = axis_size(ax)
                     idx = lax.axis_index(ax)
                     size = xv.shape[-1] // n
                     xv = lax.dynamic_slice_in_dim(xv, idx * size, size, xv.ndim - 1)
@@ -145,7 +146,7 @@ class VocabParallelEmbedding(Layer):
         ax = pcontext.manual_axis("mp")
         if pcontext.in_manual_mode() and ax is not None:
             def fn(ids, wv):
-                n = lax.axis_size(ax)
+                n = axis_size(ax)
                 idx = lax.axis_index(ax)
                 per = wv.shape[0]  # local vocab size
                 start = idx * per

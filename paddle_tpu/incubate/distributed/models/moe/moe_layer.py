@@ -26,6 +26,7 @@ from .....core.tensor import Tensor
 from .....nn.layer import Layer, LayerList
 from .....ops import moe_ops
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .....core.compat import shard_map
 
 
 _ACTS = {"GELU": "gelu", "ReLU": "relu", "SiLU": "silu", "Silu": "silu"}
@@ -87,7 +88,7 @@ def _ep_program(mesh, axis: str, num_experts: int, capacity: int,
                 xl, idx, prob, w1, w2, axis, num_experts, capacity, act=act)
         n_in = 5
 
-    shmap = jax.shard_map(fn, mesh=mesh, in_specs=(P(axis),) * n_in,
+    shmap = shard_map(fn, mesh=mesh, in_specs=(P(axis),) * n_in,
                           out_specs=P(axis), check_vma=False)
     return jax.jit(shmap)
 

@@ -270,6 +270,7 @@ class _Request:
     prompt: np.ndarray
     tokens: list = field(default_factory=list)
     done: bool = False
+    max_new_tokens: Optional[int] = None  # None -> engine config default
 
 
 class ContinuousBatchingEngine:
@@ -336,6 +337,12 @@ class ContinuousBatchingEngine:
         self._rng = jax.random.key(self.config.seed)
         self._compiled_prefill: Dict[Tuple[int, int], Callable] = {}
         self._decode_chunk = None
+        # serving-layer hooks (paddle_tpu.serving): both default to None so
+        # the plain submit/step/collect/serve surface is byte-identical.
+        # token_callback(rid, token) fires for every KEPT token as step()
+        # unpacks a chunk; finish_callback(rid, tokens) fires at _retire.
+        self.token_callback: Optional[Callable[[int, int], None]] = None
+        self.finish_callback: Optional[Callable[[int, list], None]] = None
 
     # -- compiled programs --------------------------------------------------
 
@@ -381,19 +388,48 @@ class ContinuousBatchingEngine:
 
     # -- service API --------------------------------------------------------
 
-    def submit(self, prompt) -> int:
+    def _budget(self, req: "_Request") -> int:
+        """Per-request new-token budget (submit() override or config)."""
+        return (req.max_new_tokens if req.max_new_tokens is not None
+                else self.config.max_new_tokens)
+
+    @property
+    def num_free_slots(self) -> int:
+        """Slots not occupied by a live sequence (pending queue not counted)."""
+        return self._slot_rid.count(None)
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+        budget = (max_new_tokens if max_new_tokens is not None
+                  else self.config.max_new_tokens)
         prompt = np.asarray(prompt, np.int32)
-        if len(prompt) + self.config.max_new_tokens > self.max_seq_len:
+        if len(prompt) + budget > self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens + max_new_tokens="
-                f"{self.config.max_new_tokens} exceeds the engine's "
+                f"{budget} exceeds the engine's "
                 f"max_seq_len={self.max_seq_len}; raise max_seq_len or "
                 "truncate the prompt (silent page clamping would corrupt "
                 "the sequence's KV)")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt))
+        self._queue.append(_Request(rid, prompt,
+                                    max_new_tokens=max_new_tokens))
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a request mid-flight. Queued: dropped before admission.
+        Live: the slot is retired immediately — pages return to the pool,
+        the block-table row points back at the garbage page, and nothing
+        lands in the finished map (the caller initiated the abort, so no
+        finish_callback fires either). Returns False for unknown/done rids.
+        """
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                self._queue.pop(i)
+                return True
+        if rid in self._live:
+            self._retire(self._slot_rid.index(rid), cancelled=True)
+            return True
+        return False
 
     def _admit(self, params):
         """Fill free slots from the queue: allocate pages, prefill into the
@@ -413,7 +449,7 @@ class ContinuousBatchingEngine:
                 continue
             req = self._queue[0]
             lp = len(req.prompt)
-            total = lp + cfg.max_new_tokens      # submit() bounds this
+            total = lp + self._budget(req)       # submit() bounds this
             if not self.mgr.can_allocate(total):
                 if not self._live and not picked:
                     raise MemoryError(
@@ -468,18 +504,24 @@ class ContinuousBatchingEngine:
 
     def _complete(self, req) -> bool:
         cfg = self.config
-        if len(req.tokens) >= cfg.max_new_tokens:
+        if len(req.tokens) >= self._budget(req):
             return True
         return (cfg.eos_token_id is not None
                 and req.tokens and req.tokens[-1] == cfg.eos_token_id)
 
-    def _retire(self, s):
-        """Free a finished slot: pages back to the pool, output to the
-        finished map, slot table pointed at the reserved garbage page."""
+    def _retire(self, s, cancelled: bool = False):
+        """Free a finished (or cancelled) slot: pages back to the pool,
+        output to the finished map, slot table pointed at the reserved
+        garbage page. Cancelled slots free resources but produce no
+        finished entry and no finish_callback."""
         rid = self._slot_rid[s]
         req = self._live.pop(rid)
         req.done = True
-        self._finished[rid] = req.tokens[:self.config.max_new_tokens]
+        if not cancelled:
+            out = req.tokens[:self._budget(req)]
+            self._finished[rid] = out
+            if self.finish_callback is not None:
+                self.finish_callback(rid, out)
         self.mgr.free(rid)
         self._slot_rid[s] = None
         self._bt[s] = 0
@@ -506,8 +548,14 @@ class ContinuousBatchingEngine:
             req = self._live[rid]
             for t in toks[s]:
                 req.tokens.append(int(t))
+                if self.token_callback is not None:
+                    self.token_callback(rid, int(t))
+                    if self._slot_rid[s] != rid:
+                        break   # callback cancelled this request in-place
                 if self._complete(req):
                     break
+            if self._slot_rid[s] != rid:
+                continue        # already retired by a reentrant cancel
             if self._complete(req):
                 self._retire(s)
             else:

@@ -210,14 +210,17 @@ def convert_bool_op(op: str, loc: str, *thunks):
             try:
                 v2 = t2()
             except Exception as e:
+                note = (
+                    f"dy2static {loc}: an earlier operand of this "
+                    f"`{op}` is a traced tensor, so short-circuit "
+                    "evaluation does not apply — later operands run "
+                    "unconditionally under tracing. Guard the "
+                    "failing operand (e.g. hoist it above the "
+                    "bool-op) if it must be skipped.")
                 if hasattr(e, "add_note"):
-                    e.add_note(
-                        f"dy2static {loc}: an earlier operand of this "
-                        f"`{op}` is a traced tensor, so short-circuit "
-                        "evaluation does not apply — later operands run "
-                        "unconditionally under tracing. Guard the "
-                        "failing operand (e.g. hoist it above the "
-                        "bool-op) if it must be skipped.")
+                    e.add_note(note)
+                else:  # PEP 678 shim for Python < 3.11
+                    e.__notes__ = getattr(e, "__notes__", []) + [note]
                 raise
             v2 = v2._value if isinstance(v2, Tensor) else v2
             nxt = jnp.asarray(v2).astype(bool)

@@ -26,6 +26,7 @@ from .functional import bind, buffer_arrays, param_arrays, tree_unwrap
 
 
 from ..static import InputSpec  # noqa: E402  (re-export parity)
+from ..core.compat import jax_export
 
 
 def _as_sds(spec) -> jax.ShapeDtypeStruct:
@@ -63,7 +64,7 @@ def save(layer, path: str, input_spec: Optional[List] = None, **config) -> None:
     in_sds = [_as_sds(s) for s in input_spec]
     p_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in params.items()}
     b_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in buffers.items()}
-    exported = jax.export.export(jax.jit(pure))(p_sds, b_sds, *in_sds)
+    exported = jax_export().export(jax.jit(pure))(p_sds, b_sds, *in_sds)
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
@@ -116,7 +117,7 @@ class TranslatedLayer:
 
 def load(path: str) -> TranslatedLayer:
     with open(path + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export().deserialize(f.read())
     data = np.load(path + ".pdiparams.npz")
     params = {k[3:]: data[k] for k in data.files if k.startswith("p::")}
     buffers = {k[3:]: data[k] for k in data.files if k.startswith("b::")}

@@ -40,6 +40,7 @@ from ..ops import flash_attention as fa
 from ..ops.rms_norm import rms_norm_array
 from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..core.compat import shard_map
 
 #: per-layer tensors in the stacked functional layout (leading L axis).
 LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln1", "ln2")
@@ -734,14 +735,14 @@ def build_hybrid_train_step(config: LlamaConfig, mesh: Mesh,
                       "sep" if sep_axis is not None else None)
 
     def loss_shardmapped(params, ids, labels):
-        f = jax.shard_map(
+        f = shard_map(
             spmd_loss, mesh=mesh,
             in_specs=(specs, batch_in_spec, batch_in_spec),
             out_specs=P(), check_vma=False)
         return f(params, ids, labels)
 
     def loss_and_grads_1f1b(params, ids, labels):
-        f = jax.shard_map(
+        f = shard_map(
             spmd_1f1b_loss_grads, mesh=mesh,
             in_specs=(specs, batch_in_spec, batch_in_spec),
             out_specs=(P(), specs), check_vma=False)

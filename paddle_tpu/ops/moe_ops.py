@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..core.compat import axis_size
 
 
 def number_count(gate_idx, upper_range: int):
@@ -151,7 +152,7 @@ def expert_parallel_apply(x_local, gate_idx_local, gate_prob_local,
     """
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if num_experts % n:
         raise ValueError(f"num_experts {num_experts} must be divisible by "
                          f"'{axis_name}' axis size {n}")

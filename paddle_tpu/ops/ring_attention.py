@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..core.compat import shard_map
 
 from ._common import use_pallas
 from ..core.dispatch import apply

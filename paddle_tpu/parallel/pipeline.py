@@ -22,6 +22,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..core.compat import axis_size
 
 
 def pipeline_spmd(stage_fn: Callable, stage_params: Any, microbatches,
@@ -44,14 +45,14 @@ def pipeline_spmd(stage_fn: Callable, stage_params: Any, microbatches,
 
 def last_stage_broadcast(x, axis_name: str = "pp"):
     """Broadcast the last pp-stage's value to all stages (psum of a mask)."""
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     sid = lax.axis_index(axis_name)
     return lax.psum(jnp.where(sid == S - 1, x, jnp.zeros_like(x)), axis_name)
 
 
 def stage_slice_info(axis_name: str = "pp"):
     """(stage_id, num_stages) inside shard_map."""
-    return lax.axis_index(axis_name), lax.axis_size(axis_name)
+    return lax.axis_index(axis_name), axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -93,7 +94,7 @@ def pipeline_spmd_interleaved(chunk_fn, chunk_params, microbatches,
     microbatches: (M, ...) with M % S == 0, replicated over the pp axis.
     Returns (M, ...) outputs — valid on the LAST stage, zeros elsewhere.
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     d = lax.axis_index(axis_name)
     v = num_chunks
     M = microbatches.shape[0]
@@ -210,7 +211,7 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: Any, microbatches,
     per-stage-asynchronous (multi-executable) runtime, which trades away the
     XLA-fused single program; deliberately out of scope.
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     d = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     depth = 2 * S

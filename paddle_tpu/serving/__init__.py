@@ -1,0 +1,37 @@
+"""``paddle_tpu.serving`` — SLO-aware serving runtime over the
+continuous-batching engine.
+
+The inference stack's ``ContinuousBatchingEngine`` is a closed batch loop;
+this package adds the request-serving layer the ROADMAP north star calls
+for: a priority/deadline admission scheduler with load shedding and
+cancellation (:mod:`.scheduler`), per-request streaming token delivery
+(:mod:`.stream`), and TTFT/ITL/utilization metrics exported as Prometheus
+text and profiler trace events (:mod:`.metrics`).
+
+Quick start::
+
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.serving import ServingScheduler
+
+    eng = ContinuousBatchingEngine(model_cfg, GenerationConfig(
+        max_new_tokens=64), num_slots=8)
+    sched = ServingScheduler(eng)
+    handle = sched.submit(prompt_ids, priority=0, deadline_ms=500,
+                          on_token=lambda t: print(t, end=" "))
+    while sched.pending:
+        sched.step(params)
+    tokens = handle.stream.result()
+    print(sched.metrics.to_prometheus_text())
+"""
+
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .scheduler import (  # noqa: F401
+    RequestState, SchedulerConfig, ServingRequest, ServingScheduler,
+)
+from .stream import ServingError, TokenStream  # noqa: F401
+
+__all__ = [
+    "Histogram", "ServingMetrics", "RequestState", "SchedulerConfig",
+    "ServingRequest", "ServingScheduler", "ServingError", "TokenStream",
+]

@@ -1,0 +1,229 @@
+"""Serving metrics: latency histograms, utilization gauges, counters.
+
+The serving scheduler records into one :class:`ServingMetrics` sink:
+
+* **TTFT** (time-to-first-token) and **ITL** (inter-token latency)
+  histograms per request. Tokens reach the host one decode *chunk* at a
+  time (the engine's single fence per round), so ITL shows the chunk
+  cadence: the first token of a chunk carries the device round's latency,
+  the rest are ~0. That is the true serving profile, not an artifact.
+* queue depth, slot and page utilization, sampled once per scheduler step
+  (gauge = last value, histogram = distribution over the run).
+* counters: submitted/completed/shed (by reason)/cancelled, step retries
+  and failures, generated tokens.
+
+Export surfaces:
+
+* :meth:`ServingMetrics.to_prometheus_text` — Prometheus exposition text
+  (histogram ``_bucket``/``_sum``/``_count`` plus exact-percentile
+  ``_quantile`` gauges as a separate family — mixing quantile samples
+  into a histogram family is invalid exposition format) ready for a
+  /metrics endpoint or a scrape file.
+* trace events — :meth:`ServingMetrics.span` returns a profiler
+  ``RecordEvent`` so scheduler phases land in the host-span recorder (and
+  in the XLA xplane trace when a profiler capture is active), correlated
+  with device activity.
+
+Thread-safe: the scheduler may run ``engine.step`` on a watchdog thread
+(step timeouts), so every mutation takes the sink's lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..profiler.record import RecordEvent
+
+#: default latency bucket upper bounds (milliseconds)
+DEFAULT_BOUNDS_MS: Tuple[float, ...] = (
+    0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000)
+
+#: default quantiles reported in summaries and the Prometheus dump
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+
+class Histogram:
+    """Fixed-bucket histogram that also keeps raw samples (ring buffer,
+    ``max_samples`` cap) so small/medium runs report *exact* percentiles;
+    beyond the cap the ring keeps the most recent window."""
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS_MS,
+                 max_samples: int = 65536):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # +inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._samples: List[float] = []
+        self._cap = max_samples
+        self._sorted: Optional[List[float]] = None   # cache for percentile()
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        i = 0
+        for b in self.bounds:
+            if value <= b:
+                break
+            i += 1
+        self.bucket_counts[i] += 1
+        if len(self._samples) < self._cap:
+            self._samples.append(value)
+        else:
+            self._samples[self.count % self._cap] = value
+        self._sorted = None
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    def percentile(self, q: float) -> float:
+        """Exact percentile over the retained samples (nearest-rank).
+        The sort is cached until the next record() so a multi-quantile
+        export costs one sort per histogram, not one per quantile — the
+        per-token hot path shares the sink's lock with exports."""
+        if not self._samples:
+            return 0.0
+        if self._sorted is None:
+            self._sorted = sorted(self._samples)
+        ordered = self._sorted
+        rank = max(0, min(len(ordered) - 1,
+                          int(round(q * (len(ordered) - 1)))))
+        return ordered[rank]
+
+    def summary(self, quantiles: Sequence[float] = DEFAULT_QUANTILES
+                ) -> Dict[str, float]:
+        out = {"count": float(self.count), "sum": self.sum,
+               "min": self.min or 0.0, "max": self.max or 0.0,
+               "mean": (self.sum / self.count) if self.count else 0.0}
+        for q in quantiles:
+            out[f"p{int(q * 100)}"] = self.percentile(q)
+        return out
+
+
+class ServingMetrics:
+    """Process-local metrics sink for one :class:`ServingScheduler`."""
+
+    def __init__(self, namespace: str = "paddle_serving"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self.histograms: Dict[str, Histogram] = {
+            "ttft_ms": Histogram(),
+            "itl_ms": Histogram(),
+            "e2e_ms": Histogram(),
+            "queue_wait_ms": Histogram(),
+            "step_ms": Histogram(),
+            "queue_depth": Histogram(bounds=(0, 1, 2, 4, 8, 16, 32, 64,
+                                             128, 256)),
+        }
+        self.counters: Dict[str, float] = {
+            "requests_submitted_total": 0,
+            "requests_completed_total": 0,
+            "requests_cancelled_total": 0,
+            "step_retries_total": 0,
+            "step_failures_total": 0,
+            "steps_total": 0,
+            "tokens_generated_total": 0,
+        }
+        #: shed counts keyed by reason ("queue_full", "deadline", ...)
+        self.shed: Dict[str, float] = {}
+        #: last-value gauges (utilizations in [0, 1], depths in requests)
+        self.gauges: Dict[str, float] = {
+            "queue_depth": 0.0,
+            "slot_utilization": 0.0,
+            "page_utilization": 0.0,
+            "inflight": 0.0,
+            "degraded": 0.0,
+        }
+
+    # -- recording ----------------------------------------------------------
+
+    def observe(self, hist: str, value: float) -> None:
+        with self._lock:
+            self.histograms[hist].record(value)
+
+    def inc(self, counter: str, by: float = 1) -> None:
+        with self._lock:
+            self.counters[counter] = self.counters.get(counter, 0) + by
+
+    def inc_shed(self, reason: str) -> None:
+        with self._lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+
+    def set_gauge(self, gauge: str, value: float) -> None:
+        with self._lock:
+            self.gauges[gauge] = float(value)
+
+    def span(self, name: str, event_type: str = "UserDefined") -> RecordEvent:
+        """A profiler span (``with metrics.span('serving.step'): ...``);
+        shows up in the host recorder / xplane trace under
+        ``<namespace>.<name>``."""
+        return RecordEvent(f"{self.namespace}.{name}", event_type)
+
+    def mark(self, name: str) -> None:
+        """Zero-length trace event (shed/cancel/retry markers)."""
+        ev = self.span(name)
+        ev.begin()
+        ev.end()
+
+    # -- export -------------------------------------------------------------
+
+    @property
+    def shed_total(self) -> float:
+        with self._lock:
+            return sum(self.shed.values())
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Nested dict summary (histogram percentiles + counters + gauges)."""
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {
+                name: h.summary() for name, h in self.histograms.items()}
+            out["counters"] = dict(self.counters)
+            out["counters"]["requests_shed_total"] = sum(self.shed.values())
+            for reason, n in self.shed.items():
+                out["counters"][f"requests_shed_total[{reason}]"] = n
+            out["gauges"] = dict(self.gauges)
+        return out
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus exposition format: every histogram as ``_bucket``/
+        ``_sum``/``_count`` plus a sibling ``<name>_quantile`` gauge
+        family with exact percentiles, counters as ``_total``, gauges as
+        plain gauges."""
+        ns = self.namespace
+        lines: List[str] = []
+        with self._lock:
+            for name, h in self.histograms.items():
+                metric = f"{ns}_{name}"
+                lines.append(f"# HELP {metric} serving {name} distribution")
+                lines.append(f"# TYPE {metric} histogram")
+                acc = 0
+                for bound, n in zip(h.bounds, h.bucket_counts):
+                    acc += n
+                    lines.append(
+                        f'{metric}_bucket{{le="{bound:g}"}} {acc}')
+                lines.append(
+                    f'{metric}_bucket{{le="+Inf"}} {h.count}')
+                lines.append(f"{metric}_sum {h.sum:g}")
+                lines.append(f"{metric}_count {h.count}")
+                lines.append(f"# TYPE {metric}_quantile gauge")
+                for q in DEFAULT_QUANTILES:
+                    lines.append(
+                        f'{metric}_quantile{{quantile="{q:g}"}} '
+                        f"{h.percentile(q):g}")
+            for name, v in self.counters.items():
+                metric = f"{ns}_{name}"
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(f"{metric} {v:g}")
+            # labeled per-reason series only: an unlabeled grand-total
+            # sibling would double-count sum() queries over the family
+            metric = f"{ns}_requests_shed_total"
+            lines.append(f"# TYPE {metric} counter")
+            for reason, n in sorted(self.shed.items()):
+                lines.append(f'{metric}{{reason="{reason}"}} {n:g}')
+            for name, v in self.gauges.items():
+                metric = f"{ns}_{name}_gauge"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric} {v:g}")
+        return "\n".join(lines) + "\n"

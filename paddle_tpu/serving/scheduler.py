@@ -1,0 +1,484 @@
+"""SLO-aware request scheduler over the continuous-batching engine.
+
+The engine (`paddle_tpu.inference.decoding.ContinuousBatchingEngine`) is a
+closed batch loop: fixed decode slots, its own FIFO, one compiled decode
+chunk per round. This module adds the request lifecycle a serving runtime
+needs on top of it:
+
+* **admission queue** — priority classes (lower number = more urgent),
+  FIFO within a class, per-request ``deadline_ms`` and
+  ``max_new_tokens``;
+* **load shedding** — when queue depth exceeds ``max_queue_depth`` the
+  victim is the *lowest-priority, latest-deadline* queued request (a
+  no-deadline request sheds before any deadlined peer in the same
+  class); queued requests whose deadline lapses before admission are
+  shed as ``deadline``;
+* **cancellation** — queued or mid-decode; a live cancel retires the
+  engine slot immediately and returns its pages to the pool;
+* **robustness** — optional per-step wall-clock timeout and bounded
+  retry-with-exponential-backoff around ``engine.step``; after the retry
+  budget is spent the scheduler *degrades gracefully*: every in-flight
+  and queued request is drained with a structured
+  :class:`~paddle_tpu.serving.stream.ServingError` instead of the loop
+  crashing;
+* **streaming** — tokens are pushed into each request's
+  :class:`~paddle_tpu.serving.stream.TokenStream` as the engine unpacks
+  each decode chunk (via the engine's ``token_callback``), so consumers
+  see tokens at chunk cadence rather than at final ``collect()``;
+* **metrics** — TTFT/ITL/e2e/queue-wait histograms, queue-depth and
+  slot/page-utilization samples, shed/cancel/retry counters, plus
+  profiler ``RecordEvent`` spans (``paddle_serving.step`` etc.) so
+  scheduler phases correlate with device activity in traces.
+
+Determinism: scheduling order depends only on (priority, arrival order)
+and on deadline comparisons against the injected ``clock``; with a fixed
+engine seed and a deterministic clock, outputs are reproducible.
+
+Typical single-threaded driving loop::
+
+    sched = ServingScheduler(engine)
+    h = sched.submit(prompt, priority=0, deadline_ms=500,
+                     on_token=print)
+    while sched.pending:
+        sched.step(params)
+    print(h.stream.result(), sched.metrics.to_prometheus_text())
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .metrics import ServingMetrics
+from .stream import ServingError, TokenStream
+
+
+class RequestState:
+    """Lifecycle states of a :class:`ServingRequest`."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    SHED = "shed"
+    FAILED = "failed"
+
+
+@dataclass
+class SchedulerConfig:
+    """Scheduler knobs.
+
+    ``max_queue_depth``: admission-queue cap; beyond it the scheduler
+    sheds lowest-priority-latest-deadline first.
+    ``step_timeout_s``: optional wall-clock budget per ``engine.step``;
+    the step runs on a watchdog thread and a timeout counts as a failure
+    (the hung attempt itself cannot be interrupted — on real hangs the
+    retries exhaust and the scheduler degrades). Two engine steps never
+    run concurrently: while a timed-out attempt is still executing,
+    retries wait on it instead of launching a second step, and a slow
+    attempt that eventually completes counts as the step.
+    ``max_step_retries``: failed steps are retried this many times with
+    exponential backoff (``retry_backoff_s * retry_backoff_multiplier**i``)
+    before the scheduler degrades.
+    """
+
+    max_queue_depth: int = 64
+    step_timeout_s: Optional[float] = None
+    max_step_retries: int = 3
+    retry_backoff_s: float = 0.05
+    retry_backoff_multiplier: float = 2.0
+
+
+@dataclass
+class ServingRequest:
+    """Handle for one submitted request (returned by ``submit``)."""
+
+    rid: int
+    prompt: np.ndarray
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    max_new_tokens: Optional[int] = None
+    stream: TokenStream = None
+    state: str = RequestState.QUEUED
+    engine_rid: Optional[int] = None
+    submit_t: float = 0.0
+    deadline_t: Optional[float] = None    # absolute, scheduler clock
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    _span: Any = field(default=None, repr=False)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.CANCELLED,
+                              RequestState.SHED, RequestState.FAILED)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.submit_t) * 1e3
+
+
+class ServingScheduler:
+    """Priority/deadline-aware admission + robust step loop over a
+    ``ContinuousBatchingEngine`` (see module docstring)."""
+
+    def __init__(self, engine, config: Optional[SchedulerConfig] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.engine = engine
+        self.config = config or SchedulerConfig()
+        self.metrics = metrics or ServingMetrics()
+        self._clock = clock
+        self._sleep = sleep
+        self._next_rid = 0
+        self._seq = 0                       # FIFO tiebreak within priority
+        self._queue: List[ServingRequest] = []   # sorted by (priority, seq)
+        self._order: List[tuple] = []            # parallel (priority, seq)
+        self._requests: Dict[int, ServingRequest] = {}
+        self._by_engine_rid: Dict[int, ServingRequest] = {}
+        self._watchdog: Optional[tuple] = None   # (thread, result box)
+        self.degraded = False
+        # engine hooks: route chunk tokens / retirements into the streams
+        engine.token_callback = self._on_engine_token
+        engine.finish_callback = self._on_engine_finish
+
+    def _engine_budget(self, max_new_tokens: Optional[int]) -> int:
+        """Per-request new-token budget (override or engine default)."""
+        return (max_new_tokens if max_new_tokens is not None
+                else self.engine.config.max_new_tokens)
+
+    # -- submission & cancellation ------------------------------------------
+
+    def submit(self, prompt, priority: int = 0,
+               deadline_ms: Optional[float] = None,
+               max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> ServingRequest:
+        """Queue a request. ``priority`` is a class (0 = most urgent, FIFO
+        within a class); ``deadline_ms`` is the admission SLO relative to
+        now — a request still queued past it is shed; ``max_new_tokens``
+        overrides the engine default budget; ``on_token`` streams tokens
+        synchronously as chunks unpack. Returns the request handle (its
+        ``.stream`` is the consumption surface). The handle may come back
+        already shed if the queue cap evicts it immediately.
+
+        Infeasible requests — prompt + budget beyond the engine's
+        ``max_seq_len``, or needing more KV pages than the whole pool
+        holds — raise ``ValueError`` here instead of being queued: they
+        could never be admitted, and letting them reach the engine would
+        either leak a never-closed stream or (for the page case) turn a
+        permanent per-request error into repeated step failures that
+        degrade the whole scheduler."""
+        if self.degraded:
+            raise ServingError(
+                "engine_failure",
+                "scheduler is degraded after repeated step failures; "
+                "create a fresh engine+scheduler")
+        prompt = np.asarray(prompt, np.int32)
+        total = len(prompt) + self._engine_budget(max_new_tokens)
+        if total > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens + max_new_tokens="
+                f"{self._engine_budget(max_new_tokens)} exceeds the "
+                f"engine's max_seq_len={self.engine.max_seq_len}; raise "
+                "max_seq_len or truncate the prompt")
+        mgr = self.engine.mgr
+        if mgr._pages_for(total) > mgr.num_pages - 1:   # page 0 reserved
+            raise ValueError(
+                f"request of {total} total tokens needs "
+                f"{mgr._pages_for(total)} KV pages but the engine pool "
+                f"only holds {mgr.num_pages - 1}; enlarge num_pages or "
+                "shrink the request")
+        now = self._clock()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = ServingRequest(
+            rid=rid, prompt=prompt,
+            priority=int(priority), deadline_ms=deadline_ms,
+            max_new_tokens=max_new_tokens,
+            stream=TokenStream(rid, on_token=on_token),
+            submit_t=now,
+            deadline_t=None if deadline_ms is None
+            else now + deadline_ms / 1e3)
+        req._span = self.metrics.span("request")
+        req._span.begin()
+        self._requests[rid] = req
+        key = (req.priority, self._seq)
+        self._seq += 1
+        i = bisect.bisect(self._order, key)
+        self._order.insert(i, key)
+        self._queue.insert(i, req)
+        self.metrics.inc("requests_submitted_total")
+        self._shed_overflow()
+        return req
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or running request; frees its engine slot and
+        pages immediately when mid-decode. False if unknown/finished."""
+        req = self._requests.get(rid)
+        if req is None or req.done:
+            return False
+        if req.state == RequestState.QUEUED:
+            i = self._queue.index(req)
+            self._queue.pop(i)
+            self._order.pop(i)
+        elif req.state == RequestState.RUNNING:
+            self.engine.cancel(req.engine_rid)
+            self._by_engine_rid.pop(req.engine_rid, None)
+        self._finish(req, RequestState.CANCELLED, "cancelled")
+        self.metrics.inc("requests_cancelled_total")
+        self.metrics.mark("cancel")
+        return True
+
+    # -- queue policy -------------------------------------------------------
+
+    def _shed_overflow(self) -> None:
+        while len(self._queue) > self.config.max_queue_depth:
+            # victim: lowest priority class (max number), then latest
+            # deadline (None = +inf sheds first), then latest arrival
+            def badness(iq):
+                i, r = iq
+                dl = float("inf") if r.deadline_t is None else r.deadline_t
+                return (r.priority, dl, self._order[i][1])
+            i, victim = max(enumerate(self._queue), key=badness)
+            self._queue.pop(i)
+            self._order.pop(i)
+            self._shed(victim, "queue_full")
+
+    def _expire_deadlines(self) -> None:
+        now = self._clock()
+        keep_q, keep_o = [], []
+        for req, key in zip(self._queue, self._order):
+            if req.deadline_t is not None and now > req.deadline_t:
+                self._shed(req, "deadline")
+            else:
+                keep_q.append(req)
+                keep_o.append(key)
+        self._queue, self._order = keep_q, keep_o
+
+    def _shed(self, req: ServingRequest, reason: str) -> None:
+        self._finish(req, RequestState.SHED, f"shed:{reason}",
+                     ServingError(f"shed_{reason}",
+                                  f"request {req.rid} shed ({reason})",
+                                  rid=req.rid))
+        self.metrics.inc_shed(reason)
+        self.metrics.mark(f"shed.{reason}")
+
+    def _finish(self, req: ServingRequest, state: str, reason: str,
+                error: Optional[ServingError] = None) -> None:
+        req.state = state
+        req.finish_t = self._clock()
+        req.stream.close(reason, error)
+        if req._span is not None:
+            req._span.end()
+            req._span = None
+        # evict from the registry or a long-running server leaks every
+        # prompt/stream ever submitted; the caller keeps the handle
+        self._requests.pop(req.rid, None)
+
+    # -- the serving loop ---------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Requests still queued or mid-decode."""
+        return len(self._queue) + len(self._by_engine_rid)
+
+    def step(self, params) -> int:
+        """One scheduler round: expire deadlines, admit into free slots,
+        run a robust engine step, account. Returns ``pending``."""
+        if self.degraded:
+            return 0
+        with self.metrics.span("step"):
+            self._expire_deadlines()
+            self._admit()
+            if self._by_engine_rid:
+                t0 = self._clock()
+                ok = self._robust_step(params)
+                self.metrics.observe("step_ms",
+                                     (self._clock() - t0) * 1e3)
+                self.metrics.inc("steps_total")
+                if ok:
+                    self.engine.collect()   # streams own the tokens
+            self._sample_gauges()
+        return self.pending
+
+    def run(self, params, max_steps: Optional[int] = None) -> None:
+        """Drive ``step`` until every request resolves (or degradation)."""
+        steps = 0
+        while self.pending and not self.degraded:
+            self.step(params)
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"serving loop exceeded max_steps={max_steps} with "
+                    f"{self.pending} requests pending")
+
+    def _admit(self) -> None:
+        """Feed the engine only requests it can place THIS step — a free
+        slot AND enough free KV pages — in (priority, FIFO) order. The
+        engine's internal FIFO must stay empty or priority inversions
+        sneak in behind it: a request parked there (slot free but pages
+        scarce) would be served before any later, higher-priority
+        submission the moment pages return."""
+        now = self._clock()
+        headroom = self.engine.num_free_slots - len(self.engine._queue)
+        free_pages = self.engine.mgr.num_free_pages
+        while headroom > 0 and self._queue:
+            req = self._queue[0]
+            need = self.engine.mgr._pages_for(
+                len(req.prompt) + self._engine_budget(req.max_new_tokens))
+            if need > free_pages:
+                break               # wait for a completion to free pages
+            self._queue.pop(0)
+            self._order.pop(0)
+            req.engine_rid = self.engine.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens)
+            req.state = RequestState.RUNNING
+            self._by_engine_rid[req.engine_rid] = req
+            self.metrics.observe("queue_wait_ms",
+                                 (now - req.submit_t) * 1e3)
+            headroom -= 1
+            free_pages -= need
+
+    # -- robustness ---------------------------------------------------------
+
+    def _robust_step(self, params) -> bool:
+        """engine.step with timeout + bounded exponential backoff; on
+        exhaustion degrade (drain everything with a structured error)
+        instead of raising. True if the step eventually succeeded."""
+        cfg = self.config
+        delay = cfg.retry_backoff_s
+        last_err: Optional[BaseException] = None
+        for attempt in range(cfg.max_step_retries + 1):
+            try:
+                self._timed_step(params)
+                return True
+            except Exception as e:              # noqa: BLE001 - rethrown
+                last_err = e
+                self.metrics.inc("step_failures_total")
+                if attempt < cfg.max_step_retries:
+                    self.metrics.inc("step_retries_total")
+                    self.metrics.mark("step_retry")
+                    self._sleep(delay)
+                    delay *= cfg.retry_backoff_multiplier
+        self._degrade(last_err)
+        return False
+
+    def _timed_step(self, params) -> None:
+        timeout = self.config.step_timeout_s
+        if timeout is None:
+            self.engine.step(params)
+            return
+        if self._watchdog is not None:
+            prev, prev_box = self._watchdog
+            if prev.is_alive():
+                # a timed-out attempt is still executing inside the
+                # engine; NEVER start a second concurrent engine.step
+                # (they would race on slots/pages/rng). Spend this
+                # attempt's budget waiting for the straggler instead.
+                prev.join(timeout)
+            if prev.is_alive():
+                raise ServingError(
+                    "engine_failure",
+                    f"engine.step still running past another "
+                    f"step_timeout_s={timeout} window; refusing a "
+                    "concurrent step")
+            self._watchdog = None
+            if "error" in prev_box:
+                raise prev_box["error"]
+            return          # straggler completed: that WAS the step
+        box: Dict[str, Any] = {}
+
+        def worker():
+            try:
+                box["result"] = self.engine.step(params)
+            except BaseException as e:          # noqa: BLE001 - rethrown
+                box["error"] = e
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="serving-step")
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            self._watchdog = (t, box)
+            raise ServingError(
+                "engine_failure",
+                f"engine.step exceeded step_timeout_s={timeout}")
+        if "error" in box:
+            raise box["error"]
+
+    def _degrade(self, err: Optional[BaseException]) -> None:
+        """Repeated step failure: drain every in-flight and queued request
+        with a structured error; the loop survives, the scheduler refuses
+        new work."""
+        self.degraded = True
+        self.metrics.set_gauge("degraded", 1.0)
+        self.metrics.mark("degraded")
+        cause = f": {err}" if err is not None else ""
+        for req in list(self._by_engine_rid.values()):
+            try:
+                self.engine.cancel(req.engine_rid)  # reclaim slot + pages
+            except Exception:   # noqa: BLE001 - engine state may be torn
+                pass
+            self._finish(req, RequestState.FAILED, "failed",
+                         ServingError("engine_failure",
+                                      f"engine step failed repeatedly"
+                                      f"{cause}", rid=req.rid))
+        self._by_engine_rid.clear()
+        for req in self._queue:
+            self._finish(req, RequestState.FAILED, "failed",
+                         ServingError("engine_failure",
+                                      f"engine degraded before admission"
+                                      f"{cause}", rid=req.rid))
+        self._queue.clear()
+        self._order.clear()
+
+    # -- engine hook targets ------------------------------------------------
+
+    def _on_engine_token(self, engine_rid: int, token: int) -> None:
+        req = self._by_engine_rid.get(engine_rid)
+        if req is None:
+            return
+        now = self._clock()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self.metrics.observe("ttft_ms", (now - req.submit_t) * 1e3)
+        else:
+            self.metrics.observe("itl_ms",
+                                 (now - req.last_token_t) * 1e3)
+        req.last_token_t = now
+        self.metrics.inc("tokens_generated_total")
+        req.stream.push(int(token))
+
+    def _on_engine_finish(self, engine_rid: int, tokens: list) -> None:
+        req = self._by_engine_rid.pop(engine_rid, None)
+        if req is None:
+            return
+        self._finish(req, RequestState.DONE, "complete")
+        self.metrics.inc("requests_completed_total")
+        self.metrics.observe("e2e_ms",
+                             (req.finish_t - req.submit_t) * 1e3)
+
+    # -- accounting ---------------------------------------------------------
+
+    def _sample_gauges(self) -> None:
+        m = self.metrics
+        depth = len(self._queue)
+        m.set_gauge("queue_depth", depth)
+        m.observe("queue_depth", depth)
+        m.set_gauge("inflight", len(self._by_engine_rid))
+        slots = self.engine.num_slots
+        m.set_gauge("slot_utilization",
+                    (slots - self.engine.num_free_slots) / slots)
+        mgr = self.engine.mgr
+        usable = mgr.num_pages - 1          # page 0 is reserved
+        m.set_gauge("page_utilization",
+                    1.0 - mgr.num_free_pages / usable if usable else 0.0)

@@ -1,0 +1,124 @@
+"""Per-request incremental token delivery.
+
+A :class:`TokenStream` is the consumer-facing half of a serving request:
+tokens surface as each engine decode chunk completes (pushed by the
+scheduler via the engine's ``token_callback``), not at ``collect()`` time.
+
+Consumption models, all safe to mix:
+
+* **callback** — ``submit(..., on_token=fn)``: ``fn(token)`` fires
+  synchronously as the chunk is unpacked (lowest latency, runs on the
+  scheduler thread — keep it cheap).
+* **polling / same-thread driving** — ``stream.drain()`` returns the
+  tokens that arrived since the previous drain; natural when one thread
+  alternates ``scheduler.step(params)`` / ``stream.drain()``.
+* **blocking iteration** — ``for tok in stream:`` from another thread
+  blocks until tokens arrive and stops at end-of-stream.
+
+End of stream carries a reason (``"complete"``, ``"cancelled"``,
+``"shed:queue_full"``, ``"shed:deadline"``, ``"failed"``) and, for
+failures, a structured :class:`ServingError`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, List, Optional
+
+
+class ServingError(RuntimeError):
+    """Structured serving-layer error (shed / engine failure).
+
+    ``code`` is machine-readable (``"shed_queue_full"``,
+    ``"shed_deadline"``, ``"cancelled"``, ``"engine_failure"``); ``rid``
+    is the serving request id the error applies to (None for
+    scheduler-wide failures)."""
+
+    def __init__(self, code: str, message: str, rid: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+        self.rid = rid
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"ServingError(code={self.code!r}, rid={self.rid}, " \
+               f"message={self.args[0]!r})"
+
+
+class TokenStream:
+    """Thread-safe incremental token channel for one request."""
+
+    def __init__(self, rid: int,
+                 on_token: Optional[Callable[[int], None]] = None):
+        self.rid = rid
+        self._on_token = on_token
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []      # everything delivered so far
+        self._cursor = 0                  # drain()/iterator position
+        self.finished = False
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[ServingError] = None
+
+    # -- producer side (scheduler) ------------------------------------------
+
+    def push(self, token: int) -> None:
+        with self._cond:
+            if self.finished:
+                return
+            self._tokens.append(token)
+            self._cond.notify_all()
+        if self._on_token is not None:
+            self._on_token(token)
+
+    def close(self, reason: str, error: Optional[ServingError] = None
+              ) -> None:
+        with self._cond:
+            if self.finished:
+                return
+            self.finished = True
+            self.finish_reason = reason
+            self.error = error
+            self._cond.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+
+    @property
+    def tokens(self) -> List[int]:
+        """Snapshot of every token delivered so far."""
+        with self._cond:
+            return list(self._tokens)
+
+    def drain(self) -> List[int]:
+        """Non-blocking: tokens that arrived since the previous drain."""
+        with self._cond:
+            new = self._tokens[self._cursor:]
+            self._cursor = len(self._tokens)
+            return new
+
+    def get(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Blocking: next undrained token, or None at end-of-stream (or
+        on timeout)."""
+        with self._cond:
+            while self._cursor >= len(self._tokens):
+                if self.finished:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+            tok = self._tokens[self._cursor]
+            self._cursor += 1
+            return tok
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            tok = self.get()
+            if tok is None:
+                if self.finished:
+                    return
+                continue  # pragma: no cover - spurious wakeup only
+            yield tok
+
+    def result(self) -> List[int]:
+        """All tokens, raising the stream's ServingError if it failed.
+        Non-blocking — call after the scheduler has drained."""
+        if self.error is not None:
+            raise self.error
+        return self.tokens

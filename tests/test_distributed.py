@@ -13,6 +13,7 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu import distributed as dist
 from paddle_tpu.distributed import fleet
 from paddle_tpu.parallel import mesh as pmesh, pipeline as ppipe, pcontext
+from paddle_tpu.core.compat import shard_map
 
 
 @pytest.fixture(autouse=True)
@@ -228,7 +229,7 @@ def test_manual_mp_layers_inside_shard_map():
             y = lax.psum(y, "mp")
         return y
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fn, mesh=mesh,
         in_specs=(P(), P(None, "mp"), P("mp", None)),
         out_specs=P(), check_vma=False))
@@ -254,7 +255,7 @@ def test_pipeline_spmd_matches_serial():
                                   axis_name="pp")
         return ppipe.last_stage_broadcast(out, "pp")
 
-    f = jax.jit(jax.shard_map(pp_fn, mesh=mesh,
+    f = jax.jit(shard_map(pp_fn, mesh=mesh,
                               in_specs=(P("pp"), P()), out_specs=P(),
                               check_vma=False))
     out = np.asarray(f(ws, x))
@@ -279,7 +280,7 @@ def test_pipeline_spmd_gradients():
             out = ppipe.last_stage_broadcast(out, "pp")
             # replicated loss
             return jnp.sum(out ** 2)
-        f = jax.shard_map(pp_fn, mesh=mesh, in_specs=(P("pp"), P()),
+        f = shard_map(pp_fn, mesh=mesh, in_specs=(P("pp"), P()),
                           out_specs=P(), check_vma=False)
         return f(w, xin)
 

@@ -11,6 +11,7 @@ import sys
 import textwrap
 
 import pytest
+from paddle_tpu.core.compat import shard_map
 
 pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
@@ -189,7 +190,7 @@ PAYLOAD_JAX_DIST = textwrap.dedent("""
     local = np.full((len(jax.local_devices()),), float(rank + 1), np.float32)
     garr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("dp")), local)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda v: jax.lax.psum(v.sum(), "dp"), mesh=mesh,
         in_specs=P("dp"), out_specs=P()),
         out_shardings=NamedSharding(mesh, P()))
@@ -256,7 +257,7 @@ PAYLOAD_MULTIDEV = textwrap.dedent("""
     flat = NamedSharding(mesh, P(("dp", "mp")))
     local = (np.arange(4, dtype=np.float32) + rank * 4)
     x = jax.make_array_from_process_local_data(flat, local)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda v: jax.lax.psum(v.sum(), ("dp", "mp")), mesh=mesh,
         in_specs=P(("dp", "mp")), out_specs=P()),
         out_shardings=NamedSharding(mesh, P()))
@@ -267,7 +268,7 @@ PAYLOAD_MULTIDEV = textwrap.dedent("""
     w_shard = NamedSharding(mesh, P("dp", "mp"))
     wl = np.full((1, 4), float(rank), np.float32)
     w = jax.make_array_from_process_local_data(w_shard, wl)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         lambda v: v + 1.0, mesh=mesh, in_specs=P("dp", "mp"),
         out_specs=P("dp", "mp")))
     w = step(w)
@@ -358,7 +359,7 @@ PAYLOAD_ELASTIC_RESUME = textwrap.dedent("""
             g = 2.0 * (wv - tv)
             loss = jax.lax.psum(jnp.sum((wv - tv) ** 2), "dp")
             return wv - LR * g, loss
-        return jax.shard_map(local, mesh=mesh, in_specs=(P("dp"), P("dp")),
+        return shard_map(local, mesh=mesh, in_specs=(P("dp"), P("dp")),
                              out_specs=(P("dp"), P()))(w, tgt)
 
     def tgt_arr(t):
@@ -390,7 +391,7 @@ PAYLOAD_ELASTIC_RESUME = textwrap.dedent("""
         # psum barrier: every rank's shard is on disk before the step counts
         one = jax.make_array_from_process_local_data(
             shard, np.ones(per, np.float32))
-        bar = jax.jit(jax.shard_map(
+        bar = jax.jit(shard_map(
             lambda v: jax.lax.psum(jnp.sum(v), "dp"), mesh=mesh,
             in_specs=P("dp"), out_specs=P()),
             out_shardings=NamedSharding(mesh, P()))

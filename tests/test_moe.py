@@ -12,6 +12,7 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.distributed.models.moe import (
     ClipGradForMOEByGlobalNorm, GShardGate, MoELayer, NaiveGate, SwitchGate)
 from paddle_tpu.ops import moe_ops
+from paddle_tpu.core.compat import shard_map
 
 pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
@@ -207,7 +208,7 @@ def test_expert_parallel_ffn_matches_dense():
         return mo.expert_parallel_ffn(xl, logits, w1l, w2l, "expert",
                                       num_experts=E, capacity=CAP, topk=1)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fn, mesh=mesh,
         in_specs=(P("expert"), P(), P("expert"), P("expert")),
         out_specs=P("expert"), check_vma=False))

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.ops import moe_ops as mo
+from paddle_tpu.core.compat import shard_map
 
 
 def _skewed_logits(rs, T, E, hot=(0, 1), hot_frac=0.9):
@@ -117,7 +118,7 @@ class TestSkewAccounting:
             return mo.expert_parallel_ffn(xl, lg, w1l, w2l, "expert",
                                           num_experts=E, capacity=C, topk=2)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             fn, mesh=mesh,
             in_specs=(P("expert"), P("expert"), P("expert"), P("expert")),
             out_specs=P("expert"), check_vma=False))

@@ -11,6 +11,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.parallel import pipeline as ppipe
+from paddle_tpu.core.compat import shard_map
 
 S, H, MB = 4, 16, 4  # stages, width, per-microbatch rows
 
@@ -53,7 +54,7 @@ def _build_1f1b(mesh, M):
                                           _loss_fn, axis_name="pp")
         return ppipe.last_stage_broadcast(loss, "pp"), grads
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         prog, mesh=mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
         out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
@@ -90,7 +91,7 @@ def _fill_drain_step(mesh):
             return jnp.mean(jax.vmap(_loss_fn)(out, lab))
         return jax.value_and_grad(loss_of)(params)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         prog, mesh=mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P(), P()),
         out_specs=(P(), {"w": P("pp"), "b": P("pp")}),

@@ -12,6 +12,7 @@ from paddle_tpu.parallel import mesh as pmesh
 from paddle_tpu.parallel.pipeline import (
     interleave_chunk_order, pipeline_spmd_interleaved, pipeline_spmd,
 )
+from paddle_tpu.core.compat import shard_map
 
 pytestmark = pytest.mark.slow  # core tier: -m 'not slow'
 
@@ -55,7 +56,7 @@ def test_interleaved_matches_serial():
             _chunk_fn, {"w": wl, "b": bl}, mb, V, axis_name="pp")
         return last_stage_broadcast(out, "pp")
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
         out_specs=P(), check_vma=False))
     out = np.asarray(f(w_perm, b_perm, x))
@@ -77,7 +78,7 @@ def test_interleaved_gradients_match_serial():
 
     # grads w.r.t. the pp-sharded chunk weights; scalar loss psum'd per
     # device then divided (each device contributes its shard's cotangents)
-    g = jax.jit(jax.shard_map(
+    g = jax.jit(shard_map(
         jax.grad(pipe_loss, argnums=(0, 1)), mesh=mesh,
         in_specs=(P("pp"), P("pp"), P()),
         out_specs=(P("pp"), P("pp")), check_vma=False))
@@ -102,7 +103,7 @@ def test_validation_errors():
     order = interleave_chunk_order(S, V)
 
     def run(mb, wl):
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             lambda wl, bl, m: pipeline_spmd_interleaved(
                 _chunk_fn, {"w": wl, "b": bl}, m, V, axis_name="pp"),
             mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
@@ -132,7 +133,7 @@ def test_filldrain_is_v1_special_case():
         out = pipeline_spmd(stage_fn, {"w": wl, "b": bl}, mb, axis_name="pp")
         return last_stage_broadcast(out, "pp")
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
         out_specs=P(), check_vma=False))
     out = np.asarray(f(w[:S], b[:S], x[:M_odd]))

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.core.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as paddle

@@ -10,6 +10,7 @@ from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel import mesh as pmesh, pcontext
 from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+from paddle_tpu.core.compat import shard_map
 
 S, B, H, FF = 16, 2, 8, 32  # seq divisible by mp=8
 
@@ -29,7 +30,7 @@ def test_scatter_gather_roundtrip():
         assert shard.shape == (S // 8, B, H)
         return spu.gather_array(shard, "mp")       # back to full
 
-    f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+    f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
                               check_vma=False))
     np.testing.assert_array_equal(np.asarray(f(x)), x)
 
@@ -46,7 +47,7 @@ def test_all_gather_reduce_scatter_grads():
         full = spu.all_gather_array(xs, "mp")     # [S, B] assembled
         return jnp.sum(full * wf)
 
-    g = jax.jit(jax.shard_map(jax.grad(loss_fn), mesh=mesh,
+    g = jax.jit(shard_map(jax.grad(loss_fn), mesh=mesh,
                               in_specs=(P("mp"), P()), out_specs=P("mp"),
                               check_vma=False))(x, w)
     # every device's local loss counts each x shard once (the loss is
@@ -58,7 +59,7 @@ def test_all_gather_reduce_scatter_grads():
         red = spu.reduce_scatter_array(xf, "mp")  # [S/8, B] on each rank
         return jnp.sum(red * spu.scatter_array(wf, "mp"))
 
-    g2 = jax.jit(jax.shard_map(jax.grad(loss_rs), mesh=mesh,
+    g2 = jax.jit(shard_map(jax.grad(loss_rs), mesh=mesh,
                                in_specs=(P(), P()), out_specs=P(),
                                check_vma=False))(x, w)
     # bwd(reduce_scatter) = all_gather of the per-rank cotangent slices:
@@ -90,7 +91,7 @@ def test_sp_mlp_matches_dense():
         l, g = vg(xs, w1l, w2l)
         return l[None], g
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         wrapped, mesh=mesh,
         in_specs=(P("mp"), P(None, "mp"), P("mp", None)),
         out_specs=(P("mp"), P("mp")), check_vma=False))
