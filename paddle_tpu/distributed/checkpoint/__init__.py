@@ -3,7 +3,8 @@ reshard-on-load (reference: python/paddle/distributed/checkpoint/ —
 SURVEY.md §5.4 tier 3)."""
 
 from .save_state_dict import save_state_dict  # noqa: F401
-from .load_state_dict import load_state_dict  # noqa: F401
+from .load_state_dict import load_state_dict, read_metadata  # noqa: F401
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
-from .utils import flatten_state_dict, unflatten_state_dict  # noqa: F401
+from .utils import (flatten_state_dict, unflatten_state_dict,  # noqa: F401
+                    CheckpointCorruptError)
 from .async_save import async_save_state_dict, AsyncSaveFuture, TrainState  # noqa: F401

@@ -27,16 +27,37 @@ class AsyncSaveFuture:
     def __init__(self):
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
+        self._ok = False  # set by the writer only after a successful commit
         self.path: Optional[str] = None
 
     def result(self, timeout: Optional[float] = None) -> str:
+        """Join the writer. Raises ``TimeoutError`` if it is still running
+        after ``timeout`` seconds, re-raises the writer's exception if it
+        failed, and only returns ``path`` once the write actually
+        completed — never a path to bytes that were not written."""
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
-                raise TimeoutError("async checkpoint still writing")
+                raise TimeoutError(
+                    f"async checkpoint to {self.path!r} still writing "
+                    f"after {timeout}s")
         if self._exc is not None:
             raise self._exc
+        if not self._ok:
+            raise RuntimeError(
+                f"async checkpoint to {self.path!r} never ran to completion")
         return self.path
+
+    def exception(self, timeout: Optional[float] = None):
+        """Join and return the writer's exception (None on success);
+        TimeoutError still raises — 'no result yet' is not 'no error'."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"async checkpoint to {self.path!r} still writing "
+                    f"after {timeout}s")
+        return self._exc
 
     def done(self) -> bool:
         return self._thread is None or not self._thread.is_alive()
@@ -45,42 +66,53 @@ class AsyncSaveFuture:
 _last_save = [None]  # serialize overlapping async saves
 
 
-def async_save_state_dict(state_dict: Dict[str, Any], path: str,
-                          process_group=None, coordinator_rank: int = 0
-                          ) -> AsyncSaveFuture:
-    """Device→host snapshot now; file writes on a background thread.
+def host_snapshot(state_dict):
+    """Materialise a nested state dict to host numpy arrays NOW (the
+    blocking device→host copy of the async-save pattern)."""
+    if isinstance(state_dict, dict):
+        return {k: host_snapshot(v) for k, v in state_dict.items()}
+    v = state_dict
+    if hasattr(v, "_value"):
+        v = v._value
+    return np.asarray(v)
 
-    A second async save issued while one is in flight waits for the first
-    to *finish* (ordering must be preserved for resume correctness) but
-    does not re-raise its error — that belongs to the caller holding that
-    future, and a failed save must not wedge subsequent ones.
+
+def spawn_async_writer(fut: AsyncSaveFuture, write) -> AsyncSaveFuture:
+    """Run ``write()`` on a daemon thread, serialized after any in-flight
+    async save (ordering must be preserved for resume correctness). A
+    previous save's error is NOT re-raised here — it belongs to the caller
+    holding that future, and a failed save must not wedge subsequent ones.
     """
     prev = _last_save[0]
     if prev is not None and prev._thread is not None:
         prev._thread.join()
 
-    def to_host(v):
-        if isinstance(v, dict):
-            return {k: to_host(x) for k, x in v.items()}
-        if hasattr(v, "_value"):
-            v = v._value
-        return np.asarray(v)  # materialises device→host NOW
-
-    snapshot = to_host(state_dict)
-    fut = AsyncSaveFuture()
-    fut.path = path
-
-    def writer():
+    def runner():
         try:
-            save_state_dict(snapshot, path, process_group=process_group,
-                            coordinator_rank=coordinator_rank)
+            write()
+            fut._ok = True
         except BaseException as e:  # surfaced at result()
             fut._exc = e
 
-    fut._thread = threading.Thread(target=writer, daemon=True)
+    fut._thread = threading.Thread(target=runner, daemon=True)
     fut._thread.start()
     _last_save[0] = fut
     return fut
+
+
+def async_save_state_dict(state_dict: Dict[str, Any], path: str,
+                          process_group=None, coordinator_rank: int = 0
+                          ) -> AsyncSaveFuture:
+    """Device→host snapshot now; file writes on a background thread."""
+    snapshot = host_snapshot(state_dict)
+    fut = AsyncSaveFuture()
+    fut.path = path
+
+    def write():
+        save_state_dict(snapshot, path, process_group=process_group,
+                        coordinator_rank=coordinator_rank)
+
+    return spawn_async_writer(fut, write)
 
 
 def _wrap_leaves(tree):

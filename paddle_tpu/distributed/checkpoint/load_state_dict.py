@@ -13,7 +13,7 @@ from __future__ import annotations
 import glob
 import os
 import pickle
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 import jax
@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from .metadata import Metadata
 from .save_state_dict import _BF16
-from .utils import flatten_state_dict
+from .utils import CheckpointCorruptError, flatten_state_dict, verify_crc32
 
 
 def _read_metadata(path: str, unique_id=None) -> Metadata:
@@ -43,8 +43,16 @@ def _read_metadata(path: str, unique_id=None) -> Metadata:
             f"no .metadata for unique_id={unique_id} under {path!r}")
     merged = Metadata()
     for f in files:
-        with open(f, "rb") as fh:
-            m = pickle.load(fh)
+        try:
+            with open(f, "rb") as fh:
+                m = pickle.load(fh)
+        except Exception as e:  # truncated/torn metadata = corrupt checkpoint
+            raise CheckpointCorruptError(
+                f"unreadable checkpoint metadata {f!r}: {e}") from e
+        if not isinstance(m, Metadata):
+            raise CheckpointCorruptError(
+                f"{f!r} does not contain checkpoint Metadata "
+                f"(got {type(m).__name__})")
         # shard lists must EXTEND across ranks (each rank records only the
         # shards it owns), deduped by offset
         for key, shards in m.state_dict_metadata.items():
@@ -54,25 +62,54 @@ def _read_metadata(path: str, unique_id=None) -> Metadata:
         merged.storage_metadata.update(m.storage_metadata)
         merged.flat_mapping.update(m.flat_mapping)
         merged.aux.update(getattr(m, "aux", {}))
+        merged.checksums.update(getattr(m, "checksums", {}))
     return merged
 
 
-class _DataFiles:
-    """Lazy npz readers, one per data file."""
+def read_metadata(path: str, unique_id=None) -> Metadata:
+    """Public merged-metadata reader (the resilience layer uses it to build
+    a full-coverage load target from the checkpoint's own key set)."""
+    return _read_metadata(path, unique_id)
 
-    def __init__(self, path: str):
+
+class _DataFiles:
+    """Lazy npz readers, one per data file; each file's recorded CRC32 is
+    verified once, on first open, before any shard from it is trusted."""
+
+    def __init__(self, path: str, checksums: Optional[Dict[str, int]] = None):
         self.path = path
+        self.checksums = checksums or {}
         self._files: Dict[str, "np.lib.npyio.NpzFile"] = {}
         self._dtypes: Dict[str, Dict[str, str]] = {}
+
+    def _verify(self, name: str) -> None:
+        if name in self.checksums:  # pre-checksum checkpoints: nothing to check
+            verify_crc32(os.path.join(self.path, name), self.checksums[name])
 
     def read(self, ref: str) -> np.ndarray:
         fname, name = ref.split("::", 1)
         if fname not in self._files:
-            self._files[fname] = np.load(os.path.join(self.path, fname + ".npz"))
-            dt_path = os.path.join(self.path, fname + ".dtypes")
-            with open(dt_path, "rb") as f:
-                self._dtypes[fname] = pickle.load(f)
-        arr = self._files[fname][name]
+            self._verify(fname + ".npz")
+            self._verify(fname + ".dtypes")
+            try:
+                self._files[fname] = np.load(
+                    os.path.join(self.path, fname + ".npz"))
+                dt_path = os.path.join(self.path, fname + ".dtypes")
+                with open(dt_path, "rb") as f:
+                    self._dtypes[fname] = pickle.load(f)
+            except CheckpointCorruptError:
+                raise
+            except FileNotFoundError:
+                raise
+            except Exception as e:  # undecodable zip/pickle = corrupt shard
+                raise CheckpointCorruptError(
+                    f"unreadable shard file {fname!r} under "
+                    f"{self.path!r}: {e}") from e
+        try:
+            arr = self._files[fname][name]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"shard {name!r} missing/undecodable in {fname!r}: {e}") from e
         if self._dtypes[fname].get(name) == _BF16:
             arr = arr.view(jnp.bfloat16)
         return arr
@@ -85,7 +122,7 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     ``path``, resharding saved pieces into each target tensor's current
     global shape and sharding."""
     meta = _read_metadata(path, unique_id)
-    data = _DataFiles(path)
+    data = _DataFiles(path, getattr(meta, "checksums", {}))
     flat, mapping = flatten_state_dict(state_dict)
     storage = {(i.tensor_key, i.global_offset): ref
                for i, ref in meta.storage_metadata.items()}

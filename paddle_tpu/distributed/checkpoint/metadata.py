@@ -39,3 +39,8 @@ class Metadata:
     # non-tensor state (step counters, lr-scheduler scalars, …), stored
     # directly in the metadata pickle
     aux: Dict[str, object] = field(default_factory=dict)
+    # data-file name (as on disk, relative to the checkpoint dir) -> CRC32
+    # of its bytes; load verifies before trusting a shard. Absent on
+    # pre-checksum checkpoints — read with ``getattr(meta, "checksums", {})``
+    # since old pickles restore without the field.
+    checksums: Dict[str, int] = field(default_factory=dict)

@@ -20,7 +20,8 @@ import jax
 
 from ...core.tensor import Tensor
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
-from .utils import flatten_state_dict, offsets_from_index, to_array
+from .utils import (atomic_write, flatten_state_dict, fsync_dir,
+                    offsets_from_index, to_array)
 
 _BF16 = "bfloat16"
 
@@ -90,11 +91,21 @@ def save_state_dict(state_dict: Dict, path: str,
                 f"{data_file}::{name}"
         meta.state_dict_metadata[key] = shards_meta
 
-    np.savez(os.path.join(path, data_file), **payload)
-    with open(os.path.join(path, f"{data_file}.dtypes"), "wb") as f:
-        pickle.dump(dtypes, f)
+    # Every file goes through atomic_write (stage + fsync + rename): a crash
+    # mid-save leaves only *.tmp litter, never a torn file the loader could
+    # half-read. Data files land first, metadata LAST — its presence is the
+    # rank-local commit point — and the recorded CRC32s let load verify each
+    # shard file before trusting it.
+    npz_name = data_file + ".npz"  # np.savez appends .npz to str paths; we
+    # pass a handle, so name the staged file explicitly
+    meta.checksums[npz_name] = atomic_write(
+        os.path.join(path, npz_name), lambda f: np.savez(f, **payload))
+    meta.checksums[f"{data_file}.dtypes"] = atomic_write(
+        os.path.join(path, f"{data_file}.dtypes"),
+        lambda f: pickle.dump(dtypes, f))
     # every rank writes its own metadata covering the shards it owns; the
     # loader merges all *.metadata files, so multi-host checkpoints stay
     # complete without a gather step
-    with open(os.path.join(path, f"{rank}_{uid}.metadata"), "wb") as f:
-        pickle.dump(meta, f)
+    atomic_write(os.path.join(path, f"{rank}_{uid}.metadata"),
+                 lambda f: pickle.dump(meta, f))
+    fsync_dir(path)

@@ -9,6 +9,7 @@ user checkpoints, exactly as in the reference.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -82,6 +83,31 @@ class CollectiveController:
 
     RESTART = "restart"
 
+    def _fail(self, reason: str, **detail) -> Dict:
+        """Record the structured reason the job is giving up: merged onto
+        any container-level failure, logged as one JSON line, and written
+        to ``<log_dir>/failure.json`` for supervisors to consume."""
+        info: Dict = {"job_id": self.job.id, "node_rank": self.node_rank,
+                      "gen": self.gen, "reason": reason}
+        info.update(detail)
+        if self.job.pod.failure:
+            container = dict(self.job.pod.failure)
+            container.pop("log_tail", None)  # keep the json line readable
+            info["container"] = container
+        self.job.failure = info
+        logger.error("job failed: %s", json.dumps(info, default=str))
+        log_dir = getattr(self.ctx.args, "log_dir", None)
+        if log_dir:
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                tmp = os.path.join(log_dir, "failure.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(info, f, default=str, indent=2)
+                os.replace(tmp, os.path.join(log_dir, "failure.json"))
+            except OSError as e:
+                logger.warning("could not write failure.json: %s", e)
+        return info
+
     def _safe_get_gen(self) -> int:
         """Poll the generation counter; master loss reads as 'no change'
         (the hosting node may legitimately finish first)."""
@@ -104,6 +130,7 @@ class CollectiveController:
                 logger.error("rendezvous failed (gen %d): %s", self.gen, e)
                 if max_restart == 0 or \
                         self.job.pod.restart_count >= restart_budget:
+                    self._fail("rendezvous_failed", error=str(e))
                     self.master.close()
                     return 1
                 # A failed rendezvous poisons its generation (half-written
@@ -130,7 +157,15 @@ class CollectiveController:
                 for c in failed:
                     logger.error("rank %d failed (exit %s); last log:\n%s",
                                  c.rank, c.exit_code, c.logs(tail=2048))
-                over_budget = self.job.pod.restart_count >= max_restart
+                # in-place peer restarts and full redeploys draw on the
+                # same budget: --max_restart bounds total recovery attempts
+                spent = (self.job.pod.restart_count +
+                         self.job.pod.container_restarts)
+                over_budget = spent >= max_restart
+                if over_budget:
+                    reason = (self.job.pod.failure or {}).get(
+                        "reason", "container_failed")
+                    self._fail(reason)
                 if max_restart > 0:
                     try:
                         # signal peers even when leaving for good (scale-in)
@@ -143,6 +178,8 @@ class CollectiveController:
                     return 1
             else:  # RESTART requested by a peer's gen bump
                 if self.job.pod.restart_count >= restart_budget:
+                    self._fail("pod_restart_budget_exhausted",
+                               restart_budget=restart_budget)
                     self.job.pod.stop(force=True)
                     self.master.close()
                     return 1
@@ -154,21 +191,40 @@ class CollectiveController:
     def watch(self, poll_interval: float = 0.2) -> str:
         """Reference watcher loop: poll container liveness/exit codes.
 
-        Multi-node elastic: also poll the store's generation counter — a
-        peer node bumping it means the whole job is re-forming, so stop the
-        local pod and re-rendezvous (reference: etcd membership watch,
-        SURVEY §3.6).
+        Elastic single-node: dead peers are restarted *in place* with
+        exponential backoff (``Pod.restart_failed``) up to the
+        ``max_restart`` budget — no re-rendezvous needed since endpoints
+        are unchanged; past the budget the job fails with a structured
+        reason. Multi-node elastic: also poll the store's generation
+        counter — a peer node bumping it means the whole job is
+        re-forming, so stop the local pod and re-rendezvous (reference:
+        etcd membership watch, SURVEY §3.6).
         """
         ctx = self.ctx
         pod = self.job.pod
         elastic = ctx.args.elastic_level >= 1 and ctx.is_multi_node
+        max_restart = (ctx.args.max_restart
+                       if ctx.args.elastic_level >= 1 else 0)
+        # In-place peer restart keeps every endpoint/env intact, so it is
+        # only sound when there is no cross-node generation to re-form.
+        in_place = max_restart > 0 and not ctx.is_multi_node
         last_gen_check = time.monotonic()
         while True:
             s = pod.status()
             if s == Status.COMPLETED:
                 return s
             if s == Status.FAILED:
-                # fail fast: tear down remaining live containers
+                if in_place and pod.restart_failed(max_restart):
+                    logger.warning(
+                        "restarted dead peers in place (%d/%d)",
+                        pod.container_restarts, max_restart)
+                    continue
+                # budget spent (restart_failed recorded the reason) or
+                # restarts disabled: tear down remaining live containers.
+                # Only record here — run() may still recover via a full
+                # elastic redeploy, and failure.json is a give-up artifact.
+                if pod.failure is None:
+                    pod.record_failure("container_failed")
                 pod.stop(force=False)
                 return s
             if elastic and time.monotonic() - last_gen_check >= 1.0:

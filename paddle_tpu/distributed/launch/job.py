@@ -11,7 +11,6 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
-import sys
 import time
 from typing import Dict, List, Optional
 
@@ -70,6 +69,14 @@ class Container:
         self.proc = subprocess.Popen(self.entrypoint, env=env,
                                      stdout=stdout, stderr=stderr)
 
+    def restart(self) -> None:
+        """Relaunch this container in place (same entrypoint/endpoint; env
+        may have been updated, e.g. PADDLE_RESTART_COUNT). The log file is
+        reopened in append mode so both generations' output survives."""
+        self.terminate(force=True)
+        self.proc = None
+        self.start()
+
     @property
     def exit_code(self) -> Optional[int]:
         return self.proc.poll() if self.proc else None
@@ -125,10 +132,57 @@ class Pod:
     def __init__(self, name: str = ""):
         self.name = name or f"pod-{os.getpid()}"
         self.containers: List[Container] = []
-        self.restart_count = 0
+        self.restart_count = 0           # full pod re-deployments
+        self.container_restarts = 0      # in-place dead-peer restarts
+        self.failure: Optional[Dict] = None  # structured give-up reason
 
     def add_container(self, entrypoint, env, log_path=None, rank=-1):
         self.containers.append(Container(entrypoint, env, log_path, rank))
+
+    def failed_containers(self) -> List[Container]:
+        return [c for c in self.containers if c.status() == Status.FAILED]
+
+    def record_failure(self, reason: str, **detail) -> Dict:
+        """Build + store the structured reason the job is giving up."""
+        info: Dict = {"reason": reason, "pod": self.name,
+                      "pod_restarts": self.restart_count,
+                      "container_restarts": self.container_restarts}
+        info.update(detail)
+        failed = self.failed_containers()
+        if failed:
+            c = failed[0]
+            info.setdefault("rank", c.rank)
+            info.setdefault("exit_code", c.exit_code)
+            info.setdefault("log_tail", c.logs(tail=1024))
+        self.failure = info
+        return info
+
+    def restart_failed(self, max_restarts: int, backoff_base: float = 0.5,
+                       backoff_cap: float = 8.0, sleep=time.sleep) -> bool:
+        """Restart dead containers in place with exponential backoff.
+
+        Returns True when the dead peers were relaunched (budget left) and
+        False when the restart budget is spent — in which case a structured
+        failure reason is recorded on ``self.failure``. Restarted
+        containers see a bumped ``PADDLE_RESTART_COUNT`` so trainers can
+        tell generations apart (e.g. to resume from a checkpoint).
+        """
+        failed = self.failed_containers()
+        if not failed:
+            return True
+        if self.container_restarts >= max_restarts:
+            self.record_failure("restart_budget_exhausted",
+                                max_restarts=max_restarts)
+            return False
+        delay = min(backoff_base * (2 ** self.container_restarts),
+                    backoff_cap)
+        sleep(delay)
+        self.container_restarts += 1
+        gen = self.restart_count + self.container_restarts
+        for c in failed:
+            c.env["PADDLE_RESTART_COUNT"] = str(gen)
+            c.restart()
+        return True
 
     def deploy(self) -> None:
         for c in self.containers:
@@ -157,13 +211,19 @@ class Pod:
             c.terminate(force=force)
 
     def reset(self) -> None:
-        """Drop dead containers so the pod can be rebuilt for a restart."""
+        """Drop dead containers so the pod can be rebuilt for a restart.
+        The recorded failure is per-generation (a recovered job must not
+        carry a stale reason forward), but ``container_restarts`` is
+        cumulative — in-place and full-redeploy restarts share one
+        ``max_restart`` budget, never multiply it."""
         self.stop(force=True)
         self.containers = []
         self.restart_count += 1
+        self.failure = None
 
 
 class Job:
     def __init__(self, job_id: str = "default"):
         self.id = job_id
         self.pod = Pod()
+        self.failure: Optional[Dict] = None  # structured give-up reason

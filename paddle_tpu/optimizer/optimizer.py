@@ -14,6 +14,7 @@ bf16/fp16 params — parity with the reference's master-weight path.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -142,11 +143,40 @@ class Optimizer:
         if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])
         by_name = {p.name: p for p in self._parameter_list}
+        # Positional fallback: auto-generated param names (generated_tensor_N)
+        # differ across processes, so a checkpoint resumed in a fresh process
+        # would silently drop every accumulator on the name match alone.
+        # state_dict() emits slots grouped per parameter in _parameter_list
+        # order, so the i-th distinct saved name maps to the i-th parameter.
+        # All-or-nothing: positional is used for EVERY key as soon as any
+        # saved name is unknown here (and the group count matches) — mixing
+        # the two maps would bind partially-overlapping generated names to
+        # the wrong parameters.
+        saved_names: list = []
+        for key in state:
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            pname = key.rpartition(".")[0]
+            if pname not in saved_names:
+                saved_names.append(pname)
+        # Key order out of a checkpoint is not trustworthy (multi-rank
+        # metadata merges interleave it); auto-generated names carry the
+        # saving process's creation counter, so sort by it to recover the
+        # true parameter order before zipping positionally.
+        suffixes = [re.search(r"(\d+)$", n) for n in saved_names]
+        if all(suffixes):
+            saved_names.sort(key=lambda n: int(re.search(r"(\d+)$", n)
+                                               .group(1)))
+        by_pos = {}
+        if len(saved_names) == len(self._parameter_list) and \
+                any(n not in by_name for n in saved_names):
+            by_pos = {n: p for n, p in zip(saved_names, self._parameter_list)}
+        lookup = by_pos or by_name
         for key, v in state.items():
             if key in ("@step", "LR_Scheduler"):
                 continue
             pname, _, slot = key.rpartition(".")
-            p = by_name.get(pname)
+            p = lookup.get(pname)
             if p is None:
                 continue
             s = self._state_of(p)

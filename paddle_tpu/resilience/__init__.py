@@ -1,0 +1,50 @@
+"""``paddle_tpu.resilience`` — fault-tolerant training runtime.
+
+Makes a multi-hour run survive crashes, preemptions and corrupted
+checkpoints without human intervention (ISSUE 2 tentpole; the MPK lesson
+from PAPERS.md 2512.22219: the runtime, not the user loop, owns failure
+recovery).
+
+Checkpoint layout (``durable.py``)
+----------------------------------
+::
+
+    <root>/
+      .tmp_step_<N>/        # staging dir while a save is in flight
+      step_<N>/             # committed checkpoint (atomic dir rename)
+        0_0.distcp.npz      # shard payload  (per-file CRC32 in metadata)
+        0_0.distcp.dtypes
+        0_0.metadata        # written LAST = rank-local commit point
+      LATEST                # text marker "step_<N>", atomically replaced
+
+Every file inside a checkpoint is written via
+``distributed.checkpoint.utils.atomic_write`` (stage + fsync + rename),
+the whole staging dir is renamed to ``step_<N>`` only once complete, and
+``LATEST`` flips afterwards — so a crash at ANY instant leaves either the
+previous checkpoint or a fully-committed new one, never a torn state.
+Retention GC keeps the newest ``keep`` checkpoints. On load, per-shard
+CRC32s are verified and a truncated/corrupt checkpoint is transparently
+skipped in favor of the newest *intact* one.
+
+Pieces
+------
+* ``durable``  — ``save_checkpoint`` / ``async_save_checkpoint`` /
+  ``load_latest_checkpoint`` / ``restore_train_state`` / ``gc_checkpoints``.
+* ``trainer``  — ``ResilientTrainer``: auto-resume, SIGTERM/preemption
+  flush-and-exit, NaN/Inf loss rollback-and-replay, bounded step retry.
+* ``faults``   — deterministic ``FaultInjector`` (seeded schedule of write
+  failures, shard truncation, step exceptions, simulated preemption) used
+  by the tests and the chaos-mode flag.
+* ``metrics``  — counters + save-latency histogram, Prometheus text.
+"""
+
+from .durable import (  # noqa: F401
+    async_save_checkpoint, checkpoint_path, gc_checkpoints, latest_step,
+    list_checkpoints, load_latest_checkpoint, restore_train_state,
+    save_checkpoint,
+)
+from .faults import ChaosError, Fault, FaultInjector  # noqa: F401
+from .metrics import ResilienceMetrics  # noqa: F401
+from .trainer import (  # noqa: F401
+    Preempted, ResilienceConfig, ResilientTrainer, TrainingAborted,
+)

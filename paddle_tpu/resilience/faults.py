@@ -1,0 +1,103 @@
+"""Deterministic fault injection for the training runtime.
+
+A :class:`FaultInjector` holds a *schedule* of faults — either written out
+explicitly or generated from a seed — and the runtime asks it at
+well-known points whether a fault fires:
+
+===================  ======================================================
+event                asked by
+===================  ======================================================
+``write_fail``       ``durable._commit`` before writing (mid-save crash:
+                     raises IOError, leaving only partial staging litter)
+``truncate_shard``   ``durable._commit`` after commit (bitrot/torn-disk
+                     simulation: truncates a committed shard file in place)
+``step_error``       ``ResilientTrainer`` before running a step (the step
+                     raises; exercises bounded retry)
+``preempt``          ``ResilientTrainer`` before running a step (SIGTERM
+                     to self — the real preemption signal path)
+===================  ======================================================
+
+Each scheduled fault fires exactly once (``fire`` consumes it), so a
+rollback-and-replay of the same step proceeds clean — which is what makes
+chaos runs deterministic and byte-identical to uninterrupted ones. Tests
+may schedule custom events (e.g. ``nan``) and query them from their own
+step functions. ``fired`` records every (event, step) that triggered.
+
+This module is also the only place allowed to write checkpoint bytes
+outside the atomic-write helper — it exists to corrupt them on purpose.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+
+class ChaosError(RuntimeError):
+    """The injected step exception."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: ``event`` fires when the runtime reaches
+    ``step`` (for save events, the step being saved)."""
+    event: str
+    step: int
+
+
+@dataclass
+class FaultInjector:
+    schedule: List[Fault] = field(default_factory=list)
+    fired: List[Tuple[str, int]] = field(default_factory=list)
+
+    @classmethod
+    def seeded(cls, seed: int, num_steps: int,
+               events: Sequence[str] = ("write_fail", "truncate_shard",
+                                        "step_error", "preempt"),
+               n_faults: int = 4) -> "FaultInjector":
+        """A reproducible random schedule: same seed → same faults."""
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        steps = rng.choice(max(num_steps, 1), size=n_faults, replace=True)
+        kinds = rng.choice(len(events), size=n_faults)
+        faults = sorted((Fault(events[int(k)], int(s))
+                         for s, k in zip(steps, kinds)),
+                        key=lambda f: (f.step, f.event))
+        return cls(schedule=list(faults))
+
+    def pending(self, event: Optional[str] = None) -> List[Fault]:
+        return [f for f in self.schedule
+                if event is None or f.event == event]
+
+    def fire(self, event: str, step: int) -> bool:
+        """True (and consume) iff a fault for (event, step) is scheduled."""
+        for f in self.schedule:
+            if f.event == event and f.step == int(step):
+                self.schedule.remove(f)
+                self.fired.append((event, int(step)))
+                return True
+        return False
+
+    # -- corruption tools (deliberately non-atomic writes) ------------------
+
+    def leave_partial_staging(self, staging_dir: str) -> None:
+        """Simulate a crash mid-save: a half-written shard in the staging
+        dir that never gets committed."""
+        os.makedirs(staging_dir, exist_ok=True)
+        with open(os.path.join(staging_dir, "0_0.distcp.npz"), "wb") as f:
+            f.write(b"PK\x03\x04 torn write, process died here")
+
+    def truncate_shard(self, ckpt_dir: str) -> str:
+        """Truncate a committed shard file to half its size, as a torn disk
+        or partial upload would — the checkpoint must now fail checksum
+        verification and be skipped on load."""
+        shards = sorted(n for n in os.listdir(ckpt_dir)
+                        if n.endswith(".distcp.npz"))
+        if not shards:
+            raise FileNotFoundError(f"no shard files under {ckpt_dir!r}")
+        victim = os.path.join(ckpt_dir, shards[0])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        return victim

@@ -1,0 +1,51 @@
+"""Process-local metrics for the fault-tolerant training runtime.
+
+Counters (restores, corrupt checkpoints skipped, step retries, NaN
+rollbacks, skipped steps, preempt flushes, save failures) plus a
+save-latency histogram, exported the same two ways the serving sink is:
+``summary()`` dict and Prometheus text.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core.histogram import Histogram
+
+
+class ResilienceMetrics:
+    def __init__(self, namespace: str = "paddle_resilience"):
+        self.namespace = namespace
+        self.counters: Dict[str, float] = {}
+        self.save_latency_ms = Histogram()
+
+    def inc(self, counter: str, by: float = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0.0) + by
+
+    def get(self, counter: str) -> float:
+        return self.counters.get(counter, 0.0)
+
+    def observe_save_ms(self, value_ms: float) -> None:
+        self.save_latency_ms.record(value_ms)
+        self.inc("saves")
+
+    def summary(self) -> Dict[str, object]:
+        return {"counters": dict(self.counters),
+                "save_latency_ms": self.save_latency_ms.summary()}
+
+    def to_prometheus_text(self) -> str:
+        ns = self.namespace
+        lines = []
+        for name in sorted(self.counters):
+            lines.append(f"# TYPE {ns}_{name}_total counter")
+            lines.append(f"{ns}_{name}_total {self.counters[name]:g}")
+        h = self.save_latency_ms
+        lines.append(f"# TYPE {ns}_save_latency_ms histogram")
+        acc = 0
+        for bound, n in zip(h.bounds, h.bucket_counts):
+            acc += n
+            lines.append(f'{ns}_save_latency_ms_bucket{{le="{bound:g}"}} {acc}')
+        lines.append(f'{ns}_save_latency_ms_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{ns}_save_latency_ms_sum {h.sum:g}")
+        lines.append(f"{ns}_save_latency_ms_count {h.count}")
+        return "\n".join(lines) + "\n"

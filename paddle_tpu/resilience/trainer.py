@@ -1,0 +1,312 @@
+"""``ResilientTrainer`` — the training loop that owns failure recovery.
+
+Wraps a :class:`~paddle_tpu.distributed.checkpoint.TrainState` and a user
+step function and guarantees forward progress through:
+
+* **auto-resume** — on start, restore the newest *intact* durable
+  checkpoint (corrupt ones skipped via checksums) and continue from its
+  step;
+* **preemption** — SIGTERM (or an injected preemption) finishes the
+  current step, flushes a final durable save, and raises
+  :class:`Preempted` so the supervisor can reschedule; nothing is lost;
+* **NaN/Inf loss** — the offending step is rolled back by reloading the
+  last good checkpoint and replaying (faults are one-shot, so the replay
+  is clean); a step that keeps producing NaN beyond the budget is skipped;
+* **transient step failures** — exceptions retry with bounded exponential
+  backoff, then abort with a structured :class:`TrainingAborted`.
+
+Because checkpoint round-trips are bit-exact (fp32/bf16 shards via npz)
+and replay re-executes the same step function at the same step indices, a
+chaos run converges to the *byte-identical* final state of an
+uninterrupted run — the acceptance property tested in
+``tests/test_resilience.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Set
+
+import numpy as np
+
+from .durable import (async_save_checkpoint, checkpoint_path, latest_step,
+                      restore_train_state, save_checkpoint)
+from .faults import ChaosError, FaultInjector
+from .metrics import ResilienceMetrics
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+
+class Preempted(RuntimeError):
+    """Raised after a preemption was handled cleanly: the final checkpoint
+    is durable at ``checkpoint``; re-running the trainer resumes there."""
+
+    def __init__(self, step: int, checkpoint: Optional[str]):
+        super().__init__(
+            f"preempted at step {step}; state flushed to {checkpoint!r}")
+        self.step = step
+        self.checkpoint = checkpoint
+
+
+class TrainingAborted(RuntimeError):
+    """Training gave up, with a structured reason."""
+
+    def __init__(self, reason: str, step: int, **info: Any):
+        super().__init__(f"training aborted at step {step}: {reason} "
+                         f"{info or ''}".rstrip())
+        self.reason = reason
+        self.step = step
+        self.info = info
+
+
+@dataclass
+class ResilienceConfig:
+    checkpoint_dir: str
+    save_interval: int = 100         # steps between durable saves
+    keep: int = 3                    # retention: newest N checkpoints
+    async_save: bool = True          # overlap shard IO with training
+    max_step_retries: int = 3        # per-step exception retries
+    retry_backoff: float = 0.05      # seconds; doubles per attempt
+    retry_backoff_cap: float = 2.0
+    max_nan_rollbacks: int = 2       # per-step; beyond it the step is skipped
+    install_signal_handlers: bool = True
+    fault_injector: Optional[FaultInjector] = None
+    chaos_seed: Optional[int] = None  # build a seeded injector at run()
+                                      # scaled to the actual run length
+
+
+class ResilientTrainer:
+    def __init__(self, state, config: ResilienceConfig,
+                 metrics: Optional[ResilienceMetrics] = None):
+        self.state = state
+        self.cfg = config
+        self.metrics = metrics or ResilienceMetrics()
+        self.last_loss: Optional[float] = None
+        self.resumed_from: Optional[int] = None
+        self._pending = None           # in-flight AsyncSaveFuture
+        self._pending_step: Optional[int] = None
+        self._preempt_requested = False
+        self._prev_handler = None
+        self._handlers_installed = False
+        self._nan_counts: Dict[int, int] = {}
+        self._skip_steps: Set[int] = set()
+
+    # -- checkpointing ------------------------------------------------------
+
+    def resume(self) -> Optional[int]:
+        """Restore the newest intact checkpoint into ``self.state``;
+        returns the restored global step (None if nothing loadable)."""
+        self._harvest(block=True)
+        step = restore_train_state(self.state, self.cfg.checkpoint_dir,
+                                   self.metrics)
+        if step is not None:
+            logger.info("auto-resume: restored step %d from %s", step,
+                        self.cfg.checkpoint_dir)
+        self.resumed_from = step
+        return step
+
+    def save(self, block: bool = False) -> Optional[str]:
+        """Durable save at the current global step (async unless ``block``
+        or the config says sync). Returns the committed path for a blocking
+        save (None when it failed — failure is logged + counted; an interval
+        save failing degrades durability but must not kill training)."""
+        self._harvest(block=True)  # a new save serializes after the last one
+        step = self.state.global_step
+        sd = self.state.state_dict()
+        if self.cfg.async_save and not block:
+            self._pending = async_save_checkpoint(
+                sd, self.cfg.checkpoint_dir, step, keep=self.cfg.keep,
+                fault_injector=self.cfg.fault_injector)
+            self._pending_step = step
+            return None
+        t0 = time.perf_counter()
+        try:
+            path = save_checkpoint(sd, self.cfg.checkpoint_dir, step,
+                                   keep=self.cfg.keep,
+                                   fault_injector=self.cfg.fault_injector)
+        except Exception as e:
+            self.metrics.inc("save_failures")
+            logger.warning("checkpoint save at step %d failed: %s", step, e)
+            return None
+        self.metrics.observe_save_ms((time.perf_counter() - t0) * 1e3)
+        return path
+
+    def _harvest(self, block: bool) -> None:
+        """Collect the outcome of the in-flight async save, if any. A
+        failed save degrades durability (logged + counted) but must not
+        kill training — the next interval save re-establishes it."""
+        fut = self._pending
+        if fut is None:
+            return
+        if not block and not fut.done():
+            return
+        try:
+            fut.result()
+            self.metrics.observe_save_ms(
+                getattr(fut, "elapsed_s", 0.0) * 1e3)
+        except Exception as e:
+            self.metrics.inc("save_failures")
+            logger.warning("async checkpoint save at step %s failed: %s",
+                           self._pending_step, e)
+        self._pending = None
+        self._pending_step = None
+
+    # -- signals / preemption -----------------------------------------------
+
+    def _on_sigterm(self, signum, frame):  # noqa: ARG002 (signal signature)
+        self._preempt_requested = True
+
+    def _install_handlers(self) -> None:
+        if not self.cfg.install_signal_handlers:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        try:
+            self._prev_handler = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+            self._handlers_installed = True
+        except ValueError:  # non-main interpreter thread
+            self._handlers_installed = False
+
+    def _restore_handlers(self) -> None:
+        if self._handlers_installed:
+            signal.signal(signal.SIGTERM, self._prev_handler)
+            self._handlers_installed = False
+
+    def _simulate_preemption(self) -> None:
+        self.metrics.inc("preemptions")
+        if self._handlers_installed:
+            os.kill(os.getpid(), signal.SIGTERM)  # the real signal path
+        else:
+            self._preempt_requested = True
+
+    def _preempt_exit(self) -> "Preempted":
+        """Flush a final durable checkpoint and build the Preempted error.
+        If the flush itself fails, Preempted must NOT advertise a path that
+        was never written — it points at the newest intact checkpoint
+        instead (the one a rerun will actually resume from)."""
+        self._harvest(block=True)
+        path = self.save(block=True)
+        self.metrics.inc("preempt_flushes")
+        if path is None:
+            intact = latest_step(self.cfg.checkpoint_dir)
+            path = (checkpoint_path(self.cfg.checkpoint_dir, intact)
+                    if intact is not None else None)
+        return Preempted(self.state.global_step, path)
+
+    # -- failure handling ----------------------------------------------------
+
+    def _step_with_retry(self, step_fn: Callable[[int], Any], step: int):
+        delay = self.cfg.retry_backoff
+        attempt = 0
+        while True:
+            try:
+                fi = self.cfg.fault_injector
+                if fi is not None and fi.fire("step_error", step):
+                    raise ChaosError(f"injected step failure at step {step}")
+                return step_fn(step)
+            except (Preempted, TrainingAborted):
+                raise
+            except Exception as e:
+                if attempt >= self.cfg.max_step_retries:
+                    raise TrainingAborted(
+                        "step_failed_after_retries", step,
+                        retries=attempt, error=repr(e)) from e
+                attempt += 1
+                self.metrics.inc("step_retries")
+                logger.warning("step %d failed (%s); retry %d/%d in %.2fs",
+                               step, e, attempt, self.cfg.max_step_retries,
+                               delay)
+                time.sleep(delay)
+                delay = min(delay * 2, self.cfg.retry_backoff_cap)
+
+    def _rollback(self, offending_step: int, reason: str) -> None:
+        """Reload the last good checkpoint and let the loop replay forward.
+        One-shot faults will not re-fire during the replay, so a transient
+        NaN converges back onto the uninterrupted trajectory."""
+        self._harvest(block=True)
+        self.metrics.inc("nan_rollbacks")
+        restored = restore_train_state(self.state, self.cfg.checkpoint_dir,
+                                       self.metrics)
+        if restored is None:
+            raise TrainingAborted("no_intact_checkpoint", offending_step,
+                                  detail=reason)
+        logger.warning("rolled back to step %d after %s at step %d",
+                       restored, reason, offending_step)
+
+    def _note_nan(self, step: int) -> None:
+        n = self._nan_counts.get(step, 0) + 1
+        self._nan_counts[step] = n
+        if n > self.cfg.max_nan_rollbacks:
+            # genuinely divergent, not transient: skip it on replay
+            self._skip_steps.add(step)
+            self.metrics.inc("steps_skipped")
+            logger.error("step %d produced NaN/Inf %d times; skipping it",
+                         step, n)
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, step_fn: Callable[[int], Any], num_steps: int,
+            resume: bool = True) -> Dict[str, Any]:
+        """Drive ``step_fn(step) -> loss`` until ``global_step`` reaches
+        ``num_steps``, surviving crashes/preemptions/corruption along the
+        way. Raises :class:`Preempted` after a clean preemption flush and
+        :class:`TrainingAborted` when the failure budget is exhausted."""
+        cfg = self.cfg
+        if cfg.fault_injector is None and cfg.chaos_seed is not None:
+            # built here, where the real run length is known — seeding over
+            # a huge fixed step space would schedule faults that never fire
+            cfg.fault_injector = FaultInjector.seeded(cfg.chaos_seed,
+                                                      num_steps=num_steps)
+        if resume:
+            self.resume()
+        if latest_step(cfg.checkpoint_dir) is None:
+            # seed checkpoint: the rollback/preemption target must exist
+            # before the first interval save
+            self.save(block=True)
+        self._install_handlers()
+        try:
+            while self.state.global_step < num_steps:
+                step = self.state.global_step
+                if self._preempt_requested:
+                    raise self._preempt_exit()
+                fi = cfg.fault_injector
+                if fi is not None and fi.fire("preempt", step):
+                    self._simulate_preemption()
+                if step in self._skip_steps:
+                    self.state.step()
+                    continue
+                loss = self._step_with_retry(step_fn, step)
+                lv = loss._value if hasattr(loss, "_value") else loss
+                lf = float(np.asarray(lv))
+                if not np.isfinite(lf):
+                    self._note_nan(step)
+                    self._rollback(step, "nan_loss")
+                    continue
+                self.last_loss = lf
+                self.state.step()
+                gs = self.state.global_step
+                if cfg.save_interval and gs % cfg.save_interval == 0 \
+                        and gs < num_steps:
+                    self.save()
+                if self._preempt_requested:
+                    raise self._preempt_exit()
+            # final state is always durable: a failed flush retries once
+            # (a transient/injected fault is consumed) then aborts loudly
+            # rather than reporting completion without a durable result
+            if self.save(block=True) is None and self.save(block=True) is None:
+                raise TrainingAborted("final_save_failed",
+                                      self.state.global_step)
+        finally:
+            self._restore_handlers()
+            self._harvest(block=True)
+        return {"resumed_from": self.resumed_from,
+                "end_step": self.state.global_step,
+                "last_loss": self.last_loss,
+                "skipped_steps": sorted(self._skip_steps),
+                "metrics": self.metrics.summary()}

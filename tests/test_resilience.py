@@ -1,0 +1,653 @@
+"""Fault-tolerant training runtime (ISSUE 2): durable checkpoints with
+atomic commit + CRC32 verification + corrupt-fallback, ResilientTrainer
+auto-resume/NaN-rollback/preemption-flush/step-retry, deterministic
+FaultInjector chaos runs, and in-place dead-peer restart in the launcher.
+"""
+
+import logging
+import os
+import re
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorruptError, TrainState, load_state_dict, save_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.utils import (
+    atomic_write, file_crc32, verify_crc32,
+)
+from paddle_tpu.distributed.launch.job import Pod, Status
+from paddle_tpu.resilience import (
+    Fault, FaultInjector, Preempted, ResilienceConfig, ResilienceMetrics,
+    ResilientTrainer, TrainingAborted, checkpoint_path, gc_checkpoints,
+    latest_step, list_checkpoints, load_latest_checkpoint,
+    restore_train_state, save_checkpoint,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _make_ts(seed=21, lr=1e-2):
+    """Fresh (net, optimizer, TrainState) with deterministic init."""
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = optimizer.AdamW(learning_rate=lr, parameters=net.parameters())
+    return net, opt, TrainState(net, opt)
+
+
+def _step_fn(net, opt, injector=None):
+    """Deterministic training step: data is a pure function of the step
+    index, so replay after a rollback retraces the same trajectory."""
+
+    def step(i):
+        if injector is not None and injector.fire("nan", i):
+            return float("nan")
+        x = paddle.to_tensor(
+            np.random.RandomState(1000 + i).randn(8, 4).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+def _param_bytes(net):
+    return [np.asarray(p._value).tobytes() for p in net.parameters()]
+
+
+# ---------------------------------------------------------------------------
+# atomic write + checksums
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_and_crc(tmp_path):
+    path = str(tmp_path / "blob")
+    crc = atomic_write(path, lambda f: f.write(b"hello durable world"))
+    assert os.path.exists(path) and not os.path.exists(path + ".tmp")
+    assert crc == file_crc32(path)
+    verify_crc32(path, crc)
+    with open(path, "r+b") as f:  # bitrot
+        f.truncate(4)
+    with pytest.raises(CheckpointCorruptError):
+        verify_crc32(path, crc)
+
+
+def test_atomic_write_failure_preserves_old_file(tmp_path):
+    path = str(tmp_path / "blob")
+    atomic_write(path, lambda f: f.write(b"generation one"))
+
+    def boom(f):
+        f.write(b"gener")  # torn write, then the process "dies"
+        raise IOError("disk died")
+
+    with pytest.raises(IOError):
+        atomic_write(path, boom)
+    with open(path, "rb") as f:
+        assert f.read() == b"generation one"
+
+
+def test_sync_save_crash_leaves_previous_checkpoint_intact(
+        tmp_path, monkeypatch):
+    """A crash mid-``save_state_dict`` must leave the previous committed
+    files readable — never a half-written shard the loader trusts."""
+    net, _, _ = _make_ts()
+    ck = str(tmp_path / "ck")
+    save_state_dict(net.state_dict(), ck)
+    want = _param_bytes(net)
+
+    import importlib
+    S = importlib.import_module(
+        "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+    def torn_savez(f, **payload):
+        f.write(b"PK\x03\x04 half a zip")
+        raise IOError("crash mid-save")
+
+    monkeypatch.setattr(S.np, "savez", torn_savez)
+    net[0].weight.set_value(np.zeros(net[0].weight.shape, np.float32))
+    with pytest.raises(IOError):
+        save_state_dict(net.state_dict(), ck)
+    monkeypatch.undo()
+
+    net2, _, _ = _make_ts(seed=99)
+    target = net2.state_dict()
+    load_state_dict(target, ck)
+    net2.set_state_dict(target)
+    assert _param_bytes(net2) == want
+
+
+def test_load_rejects_truncated_shard(tmp_path):
+    net, _, _ = _make_ts()
+    ck = str(tmp_path / "ck")
+    save_state_dict(net.state_dict(), ck)
+    FaultInjector().truncate_shard(ck)
+    with pytest.raises(CheckpointCorruptError):
+        load_state_dict(net.state_dict(), ck)
+
+
+# ---------------------------------------------------------------------------
+# AsyncSaveFuture: timeout + writer-exception propagation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_async_future_timeout_then_result(tmp_path, monkeypatch):
+    import paddle_tpu.distributed.checkpoint.async_save as A
+    gate = threading.Event()
+    real = A.save_state_dict
+
+    def slow(sd, path, **kw):
+        assert gate.wait(30)
+        return real(sd, path, **kw)
+
+    monkeypatch.setattr(A, "save_state_dict", slow)
+    net, _, _ = _make_ts()
+    fut = A.async_save_state_dict(net.state_dict(), str(tmp_path / "a"))
+    with pytest.raises(TimeoutError):
+        fut.result(timeout=0.05)
+    gate.set()
+    assert fut.result(timeout=30) == str(tmp_path / "a")
+    assert fut.exception() is None
+
+
+def test_async_future_propagates_writer_exception(tmp_path, monkeypatch):
+    import paddle_tpu.distributed.checkpoint.async_save as A
+
+    def fail(sd, path, **kw):
+        raise IOError("quota exceeded")
+
+    monkeypatch.setattr(A, "save_state_dict", fail)
+    net, _, _ = _make_ts()
+    fut = A.async_save_state_dict(net.state_dict(), str(tmp_path / "b"))
+    with pytest.raises(IOError, match="quota exceeded"):
+        fut.result(timeout=30)
+    assert isinstance(fut.exception(), IOError)
+    # result() never hands back a path whose bytes were not written
+    with pytest.raises(IOError):
+        fut.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# durable checkpoint layer
+# ---------------------------------------------------------------------------
+
+def test_durable_save_latest_marker_and_gc(tmp_path):
+    net, opt, ts = _make_ts()
+    root = str(tmp_path / "ckpts")
+    step_fn = _step_fn(net, opt)
+    for i in range(5):
+        step_fn(i)
+        ts.step()
+        save_checkpoint(ts.state_dict(), root, step=ts.global_step, keep=2)
+    assert [s for s, _ in list_checkpoints(root)] == [4, 5]
+    assert latest_step(root) == 5
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "step_5"
+
+    net2, opt2, ts2 = _make_ts(seed=99)
+    assert restore_train_state(ts2, root) == 5
+    assert ts2.global_step == 5
+    assert _param_bytes(net2) == _param_bytes(net)
+
+
+def test_restore_covers_optimizer_state_in_fresh_process(tmp_path):
+    """Optimizer moments must round-trip into a process that has not run a
+    step yet (fresh param names, no materialised accumulators)."""
+    net, opt, ts = _make_ts()
+    step = _step_fn(net, opt)
+    for i in range(3):
+        step(i)
+        ts.step()
+    root = str(tmp_path / "ckpts")
+    save_checkpoint(ts.state_dict(), root, step=ts.global_step)
+
+    net2, opt2, ts2 = _make_ts(seed=99)  # fresh: no opt state materialised
+    assert restore_train_state(ts2, root) == 3
+    # both continue one identical step; equal params proves the moments
+    # (not just the weights) were restored
+    _step_fn(net, opt)(3)
+    _step_fn(net2, opt2)(3)
+    assert _param_bytes(net2) == _param_bytes(net)
+
+
+def test_corrupt_latest_falls_back_to_previous_intact(tmp_path, caplog):
+    net, opt, ts = _make_ts()
+    root = str(tmp_path / "ckpts")
+    step = _step_fn(net, opt)
+    step(0); ts.step()
+    save_checkpoint(ts.state_dict(), root, step=1)
+    good = _param_bytes(net)
+    step(1); ts.step()
+    save_checkpoint(ts.state_dict(), root, step=2)
+    FaultInjector().truncate_shard(checkpoint_path(root, 2))
+
+    metrics = ResilienceMetrics()
+    net2, opt2, ts2 = _make_ts(seed=99)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.resilience"):
+        assert restore_train_state(ts2, root, metrics) == 1
+    assert metrics.get("corrupt_checkpoints_skipped") >= 1
+    assert any("step_2" in r.message for r in caplog.records)
+    assert _param_bytes(net2) == good
+
+
+def test_injected_write_failure_never_commits(tmp_path):
+    net, opt, ts = _make_ts()
+    root = str(tmp_path / "ckpts")
+    fi = FaultInjector([Fault("write_fail", 1)])
+    save_checkpoint(ts.state_dict(), root, step=0, fault_injector=fi)
+    with pytest.raises(IOError, match="injected write failure"):
+        save_checkpoint(ts.state_dict(), root, step=1, fault_injector=fi)
+    # the failed save left staging litter but no committed step_1
+    assert latest_step(root) == 0
+    assert not os.path.isdir(checkpoint_path(root, 1))
+    assert any(n.startswith(".tmp_") for n in os.listdir(root))
+    gc_checkpoints(root, keep=4)
+    assert not any(n.startswith(".tmp_") for n in os.listdir(root))
+    # and the intact step_0 still loads
+    assert load_latest_checkpoint(ts.state_dict(), root) == 0
+
+
+def test_seeded_injector_is_reproducible():
+    a = FaultInjector.seeded(7, num_steps=100)
+    b = FaultInjector.seeded(7, num_steps=100)
+    assert a.schedule == b.schedule and len(a.schedule) == 4
+    assert FaultInjector.seeded(8, num_steps=100).schedule != a.schedule
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch resume determinism (satellite): the resumed run must see
+# exactly the batches an uninterrupted run would — same RNG, same order
+# ---------------------------------------------------------------------------
+
+def test_mid_epoch_resume_sees_identical_batches(tmp_path):
+    from paddle_tpu import io
+    ds = io.TensorDataset([np.arange(32, dtype=np.float32).reshape(32, 1)])
+
+    def make_loader():
+        return io.DataLoader(ds, batch_size=4, shuffle=True)
+
+    def batches_of_epoch(epoch):
+        loader = make_loader()
+        loader.batch_sampler.set_epoch(epoch)
+        return [np.asarray(b).ravel().tolist() for b in loader]
+
+    # uninterrupted reference: epochs 0 and 1 back to back
+    ref = [(e, b) for e in range(2) for b in batches_of_epoch(e)]
+
+    # interrupted run: consume epoch 0 fully + 3 batches of epoch 1, then
+    # checkpoint the position durably and "crash"
+    ts = TrainState()
+    seen = []
+    loader = make_loader()
+    loader.batch_sampler.set_epoch(0)
+    for b in loader:
+        seen.append((0, np.asarray(b).ravel().tolist()))
+        ts.step()
+    ts.next_epoch()
+    loader = make_loader()
+    loader.batch_sampler.set_epoch(1)
+    it = iter(loader)
+    for _ in range(3):
+        seen.append((1, np.asarray(next(it)).ravel().tolist()))
+        ts.step()
+    root = str(tmp_path / "pos")
+    save_checkpoint(ts.state_dict(), root, step=ts.global_step)
+
+    # resume in a "fresh process": restore position, fast-forward a fresh
+    # loader, finish the epoch
+    ts2 = TrainState()
+    target = ts2.state_dict()
+    assert load_latest_checkpoint(target, root) == ts.global_step
+    ts2.set_state_dict(target)
+    assert (ts2.epoch, ts2.batch_in_epoch) == (1, 3)
+    it2 = ts2.skip_batches(make_loader())
+    for b in it2:
+        seen.append((1, np.asarray(b).ravel().tolist()))
+    assert seen == ref
+
+
+# ---------------------------------------------------------------------------
+# ResilientTrainer
+# ---------------------------------------------------------------------------
+
+def _trainer(tmp_path, net, opt, ts, **kw):
+    kw.setdefault("save_interval", 5)
+    kw.setdefault("keep", 3)
+    kw.setdefault("retry_backoff", 0.001)
+    cfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ckpts"), **kw)
+    return ResilientTrainer(ts, cfg)
+
+
+def _reference_run(tmp_path, num_steps, seed=21):
+    net, opt, ts = _make_ts(seed)
+    tr = _trainer(tmp_path / "ref", net, opt, ts)
+    res = tr.run(_step_fn(net, opt), num_steps)
+    return net, res
+
+
+def test_trainer_plain_run_and_autoresume(tmp_path):
+    net, opt, ts = _make_ts()
+    tr = _trainer(tmp_path, net, opt, ts, save_interval=3)
+    res = tr.run(_step_fn(net, opt), 7)
+    assert res["end_step"] == 7 and res["resumed_from"] is None
+    assert latest_step(str(tmp_path / "ckpts")) == 7
+
+    # a fresh trainer at the same dir resumes instead of restarting
+    net2, opt2, ts2 = _make_ts(seed=99)
+    tr2 = _trainer(tmp_path, net2, opt2, ts2, save_interval=3)
+    res2 = tr2.run(_step_fn(net2, opt2), 10)
+    assert res2["resumed_from"] == 7 and res2["end_step"] == 10
+    ref_net, ref = _reference_run(tmp_path, 10)
+    assert _param_bytes(net2) == _param_bytes(ref_net)
+    assert res2["last_loss"] == ref["last_loss"]
+
+
+def test_trainer_retries_transient_step_error(tmp_path):
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("step_error", 2)])
+    tr = _trainer(tmp_path, net, opt, ts, fault_injector=fi)
+    res = tr.run(_step_fn(net, opt), 5)
+    assert res["end_step"] == 5
+    assert tr.metrics.get("step_retries") == 1
+    assert ("step_error", 2) in fi.fired
+    ref_net, _ = _reference_run(tmp_path, 5)
+    assert _param_bytes(net) == _param_bytes(ref_net)
+
+
+def test_trainer_aborts_after_retry_budget(tmp_path):
+    net, opt, ts = _make_ts()
+    tr = _trainer(tmp_path, net, opt, ts, max_step_retries=2)
+
+    def always_boom(i):
+        raise ValueError("hardware on fire")
+
+    with pytest.raises(TrainingAborted) as ei:
+        tr.run(always_boom, 3)
+    assert ei.value.reason == "step_failed_after_retries"
+    assert ei.value.info["retries"] == 2
+    assert tr.metrics.get("step_retries") == 2
+
+
+def test_trainer_nan_rollback_replays_clean(tmp_path):
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("nan", 3)])
+    tr = _trainer(tmp_path, net, opt, ts, save_interval=2, fault_injector=fi)
+    res = tr.run(_step_fn(net, opt, fi), 6)
+    assert res["end_step"] == 6 and res["skipped_steps"] == []
+    assert tr.metrics.get("nan_rollbacks") == 1
+    ref_net, ref = _reference_run(tmp_path, 6)
+    assert _param_bytes(net) == _param_bytes(ref_net)
+    assert res["last_loss"] == ref["last_loss"]
+
+
+def test_trainer_skips_persistently_divergent_step(tmp_path):
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("nan", 2)] * 3)
+    tr = _trainer(tmp_path, net, opt, ts, save_interval=1,
+                  max_nan_rollbacks=2, fault_injector=fi)
+    res = tr.run(_step_fn(net, opt, fi), 4)
+    assert res["end_step"] == 4 and res["skipped_steps"] == [2]
+    assert tr.metrics.get("steps_skipped") == 1
+    assert tr.metrics.get("nan_rollbacks") == 3
+
+
+def test_trainer_preemption_flushes_then_resumes(tmp_path):
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("preempt", 3)])
+    tr = _trainer(tmp_path, net, opt, ts, fault_injector=fi)
+    with pytest.raises(Preempted) as ei:
+        tr.run(_step_fn(net, opt), 8)
+    # the preempt signal lands at step 3; that step still completes and the
+    # flush makes step 4 durable before exit
+    assert ei.value.step == 4
+    assert os.path.isdir(ei.value.checkpoint)
+    assert tr.metrics.get("preempt_flushes") == 1
+
+    net2, opt2, ts2 = _make_ts(seed=99)
+    tr2 = _trainer(tmp_path, net2, opt2, ts2)
+    res = tr2.run(_step_fn(net2, opt2), 8)
+    assert res["resumed_from"] == 4 and res["end_step"] == 8
+    ref_net, _ = _reference_run(tmp_path, 8)
+    assert _param_bytes(net2) == _param_bytes(ref_net)
+
+
+def test_chaos_seed_scales_to_run_length(tmp_path):
+    """chaos_seed builds the injector at run() against the ACTUAL step
+    count — faults must be able to fire on short runs."""
+    num_steps = 12
+    net, opt, ts = _make_ts()
+    tr = _trainer(tmp_path, net, opt, ts, save_interval=3, chaos_seed=3)
+    trainers, end = [tr], None
+    for _ in range(6):  # preemptions re-enter like a rescheduled process
+        t = trainers[-1]
+        try:
+            end = t.run(_step_fn(net, opt), num_steps)["end_step"]
+            break
+        except Preempted:
+            net, opt, ts = _make_ts(seed=99)
+            trainers.append(_trainer(tmp_path, net, opt, ts, save_interval=3,
+                                     fault_injector=tr.cfg.fault_injector))
+    fi = tr.cfg.fault_injector
+    assert fi is not None and len(fi.fired) + len(fi.schedule) == 4
+    assert all(s < num_steps for _, s in fi.fired)
+    assert all(f.step < num_steps for f in fi.schedule)
+    assert end == num_steps
+
+
+def test_preempt_flush_failure_reports_intact_checkpoint(tmp_path):
+    """A failed preemption flush must not advertise an unwritten path:
+    Preempted points at the newest checkpoint that actually exists."""
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("preempt", 3), Fault("write_fail", 4)])
+    tr = _trainer(tmp_path, net, opt, ts, fault_injector=fi)
+    with pytest.raises(Preempted) as ei:
+        tr.run(_step_fn(net, opt), 8)
+    assert ei.value.step == 4
+    # the flush at step 4 hit the injected write failure -> fall back to
+    # the seed checkpoint, the only intact one
+    assert ei.value.checkpoint.endswith("step_0")
+    assert os.path.isdir(ei.value.checkpoint)
+    assert tr.metrics.get("save_failures") == 1
+
+
+def test_final_save_failure_aborts_instead_of_lying(tmp_path):
+    net, opt, ts = _make_ts()
+    fi = FaultInjector([Fault("write_fail", 3)] * 2)  # retry fails too
+    tr = _trainer(tmp_path, net, opt, ts, fault_injector=fi)
+    with pytest.raises(TrainingAborted) as ei:
+        tr.run(_step_fn(net, opt), 3)
+    assert ei.value.reason == "final_save_failed" and ei.value.step == 3
+
+
+def test_optimizer_positional_restore_with_overlapping_names():
+    """Partially-overlapping generated names across processes must resolve
+    all-or-nothing positionally, never via a mixed name/position binding."""
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    _step_fn(net, opt)(0)
+    sd = opt.state_dict()
+    params = list(net.parameters())
+    # simulate a saving process whose name counter was shifted: position 0
+    # saved under the name the CURRENT process gives position 1 (collision)
+    # and the last position under a name unknown here
+    old = []
+    for k in sd:
+        name = k.rpartition(".")[0]
+        if k not in ("@step", "LR_Scheduler") and name not in old:
+            old.append(name)
+    shifted = dict(zip(old, old[1:] + ["generated_tensor_999999"]))
+    renamed = {}
+    for k, v in sd.items():
+        if k in ("@step", "LR_Scheduler"):
+            renamed[k] = v
+        else:
+            name, _, slot = k.rpartition(".")
+            renamed[f"{shifted[name]}.{slot}"] = v
+    want = [np.asarray(sd[f"{n}.moment1"]._value) for n in old]
+
+    opt2 = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    opt2.set_state_dict(renamed)
+    for p, w in zip(params, want):
+        got = np.asarray(opt2._state_of(p)["moment1"])
+        np.testing.assert_array_equal(got, w)
+
+    # key order out of a multi-rank metadata merge is scrambled: the
+    # generated-name counter, not dict order, must drive positions
+    scrambled = dict(reversed(list(renamed.items())))
+    opt3 = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    opt3.set_state_dict(scrambled)
+    for p, w in zip(params, want):
+        got = np.asarray(opt3._state_of(p)["moment1"])
+        np.testing.assert_array_equal(got, w)
+
+
+def test_pod_reset_clears_failure_but_not_restart_budget(tmp_path):
+    pod = _pod_with(tmp_path, "import sys; sys.exit(9)", n=1)
+    pod.deploy()
+    assert pod.join() == Status.FAILED
+    assert pod.restart_failed(max_restarts=2, sleep=lambda s: None)
+    assert pod.join() == Status.FAILED
+    assert not pod.restart_failed(max_restarts=1, sleep=lambda s: None)
+    assert pod.failure is not None
+    pod.reset()
+    # the stale reason must not leak into the next generation, but the
+    # spent in-place budget does: both restart kinds share --max_restart
+    assert pod.failure is None and pod.container_restarts == 1
+    assert pod.restart_count == 1
+
+
+def test_metrics_prometheus_text(tmp_path):
+    net, opt, ts = _make_ts()
+    tr = _trainer(tmp_path, net, opt, ts, save_interval=2)
+    tr.run(_step_fn(net, opt), 4)
+    text = tr.metrics.to_prometheus_text()
+    assert re.search(r"paddle_resilience_saves_total [1-9]", text)
+    assert "paddle_resilience_save_latency_ms_count" in text
+    assert tr.metrics.summary()["save_latency_ms"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: >=3 faults (mid-save crash, truncated shard, NaN step,
+# preemption); auto-resume completes to the target step count and the final
+# state is byte-identical to an uninterrupted run at the same seed
+# ---------------------------------------------------------------------------
+
+def test_chaos_run_matches_uninterrupted_byte_identical(tmp_path, caplog):
+    num_steps = 30
+    schedule = [Fault("write_fail", 10),     # mid-save crash (no commit)
+                Fault("truncate_shard", 15),  # committed shard torn on disk
+                Fault("nan", 17),            # loss spike -> rollback+replay
+                Fault("preempt", 25)]        # SIGTERM to self
+    fi = FaultInjector(list(schedule))
+
+    net, opt, ts = _make_ts()
+    tr = _trainer(tmp_path / "chaos", net, opt, ts, fault_injector=fi)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.resilience"):
+        with pytest.raises(Preempted) as ei:
+            tr.run(_step_fn(net, opt, fi), num_steps)
+
+        # every scheduled fault actually fired
+        assert sorted(fi.fired) == sorted((f.event, f.step) for f in schedule)
+        # the NaN rollback found step_15 corrupt and fell back to step_5
+        assert tr.metrics.get("corrupt_checkpoints_skipped") >= 1
+        assert any("step_15" in r.message for r in caplog.records
+                   if "skipping unusable checkpoint" in r.message)
+        assert tr.metrics.get("save_failures") >= 1   # the write_fail save
+        assert tr.metrics.get("nan_rollbacks") == 1
+        assert tr.metrics.get("preempt_flushes") == 1
+
+        # "new process" after the preemption: fresh model/optimizer/trainer
+        net2, opt2, ts2 = _make_ts(seed=99)
+        tr2 = _trainer(tmp_path / "chaos", net2, opt2, ts2,
+                       fault_injector=fi)
+        res = tr2.run(_step_fn(net2, opt2, fi), num_steps)
+
+    assert res["resumed_from"] == ei.value.step
+    assert res["end_step"] == num_steps and res["skipped_steps"] == []
+
+    ref_net, ref = _reference_run(tmp_path, num_steps)
+    assert _param_bytes(net2) == _param_bytes(ref_net)
+    assert res["last_loss"] == ref["last_loss"]
+
+
+# ---------------------------------------------------------------------------
+# launcher: in-place dead-peer restart with backoff + structured failure
+# ---------------------------------------------------------------------------
+
+def _pod_with(tmp_path, script, n=2):
+    pod = Pod()
+    for rank in range(n):
+        pod.add_container(
+            [sys.executable, "-c", script],
+            env={"PADDLE_TRAINER_ID": str(rank), "PADDLE_RESTART_COUNT": "0"},
+            log_path=str(tmp_path / f"workerlog.{rank}"), rank=rank)
+    return pod
+
+
+def test_pod_restarts_dead_peers_in_place(tmp_path):
+    script = textwrap.dedent(f"""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        m = os.path.join({str(tmp_path)!r}, "attempted" + rank)
+        if rank == "0" and not os.path.exists(m):
+            open(m, "w").close()
+            sys.exit(7)   # rank 0's first generation dies
+        sys.exit(0)
+    """)
+    pod = _pod_with(tmp_path, script)
+    pod.deploy()
+    assert pod.join() == Status.FAILED
+    delays = []
+    assert pod.restart_failed(max_restarts=3, sleep=delays.append)
+    assert pod.join() == Status.COMPLETED
+    assert pod.container_restarts >= 1 and delays == [0.5] * len(delays)
+    assert all(c.env["PADDLE_RESTART_COUNT"] != "0"
+               for c in pod.containers if c.rank == 0)
+    assert pod.failure is None
+
+
+def test_pod_restart_budget_exhausted_records_structured_reason(tmp_path):
+    pod = _pod_with(tmp_path, "import sys; sys.exit(9)", n=1)
+    pod.deploy()
+    delays = []
+    restarts = 0
+    while pod.join() == Status.FAILED:
+        if not pod.restart_failed(max_restarts=2, sleep=delays.append):
+            break
+        restarts += 1
+    assert restarts == 2 and delays == [0.5, 1.0]  # exponential backoff
+    assert pod.failure["reason"] == "restart_budget_exhausted"
+    assert pod.failure["max_restarts"] == 2
+    assert pod.failure["exit_code"] == 9 and pod.failure["rank"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lint: every write inside distributed/checkpoint/ goes through the
+# atomic stage+fsync+rename helper — no direct open(..., "wb")
+# ---------------------------------------------------------------------------
+
+def test_no_unstaged_writes_in_checkpoint_package():
+    """Forbid direct write-mode ``open`` under
+    ``paddle_tpu/distributed/checkpoint/``; ``utils.atomic_write`` is the
+    single durable write path (stage + fsync + CRC32 + rename)."""
+    write_open = re.compile(r"""open\([^)]*,\s*["'](?:[wax]b?\+?|r\+b?)["']""")
+    pkg = REPO / "paddle_tpu" / "distributed" / "checkpoint"
+    allowed = {pkg / "utils.py"}  # atomic_write's own staging handle
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        if path in allowed:
+            continue
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if write_open.search(line):
+                offenders.append(f"{path.relative_to(REPO)}:{i}")
+    assert not offenders, (
+        f"unstaged write-mode open() in {offenders}; use "
+        "paddle_tpu.distributed.checkpoint.utils.atomic_write so a crash "
+        "can never leave a torn checkpoint file")
