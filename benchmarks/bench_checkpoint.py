@@ -93,6 +93,16 @@ def main():
     shutil.rmtree(root, ignore_errors=True)
 
     overhead = (overlapped_step_ms - base_step_ms) / max(base_step_ms, 1e-9)
+    # unified-telemetry snapshot: dispatch + recompile counters from the
+    # process-global registry (what a /metrics scrape would report)
+    from paddle_tpu.observability import get_registry
+    snap = get_registry().snapshot()
+    metrics_snapshot = {
+        "recompiles_total": snap.get("paddle_runtime_recompiles_total", {}),
+        "op_dispatch_total": sum(
+            snap.get("paddle_runtime_ops", {})
+            .get("op_dispatch_total", {}).values()),
+    }
     print(json.dumps({
         "bench": "checkpoint",
         "platform": "tpu" if on_tpu else "cpu",
@@ -103,6 +113,7 @@ def main():
         "step_ms_baseline": round(base_step_ms, 4),
         "step_ms_during_async_save": round(overlapped_step_ms, 4),
         "async_overlap_overhead_pct": round(overhead * 100, 2),
+        "metrics_snapshot": metrics_snapshot,
     }))
 
 

@@ -87,6 +87,18 @@ def main():
             m.histograms["queue_wait_ms"].percentile(0.99), 3),
         "step_ms_p50": round(m.histograms["step_ms"].percentile(0.5), 3),
     }
+    # unified-telemetry snapshot: per-op dispatch counts, recompiles,
+    # serving sink — the registry view a /metrics scrape would see
+    from paddle_tpu.observability import get_registry
+    snap = get_registry().snapshot()
+    out["metrics_snapshot"] = {
+        "recompiles_total": snap.get("paddle_runtime_recompiles_total", {}),
+        "op_dispatch_total": sum(
+            snap.get("paddle_runtime_ops", {})
+            .get("op_dispatch_total", {}).values()),
+        "serving_counters": snap.get("paddle_serving", {}).get("counters"),
+        "step_timer": sched.step_timer.summary()["step_ms"],
+    }
     assert all(h.done for h in handles)
     print(json.dumps(out))
 

@@ -60,9 +60,9 @@ class Histogram:
         per-token hot path shares the sink's lock with exports."""
         if not self._samples:
             return 0.0
-        if self._sorted is None:
-            self._sorted = sorted(self._samples)
         ordered = self._sorted
+        if ordered is None:   # bind locally: a concurrent record() may
+            ordered = self._sorted = sorted(self._samples)  # null the cache
         rank = max(0, min(len(ordered) - 1,
                           int(round(q * (len(ordered) - 1)))))
         return ordered[rank]

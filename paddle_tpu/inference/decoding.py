@@ -14,12 +14,16 @@ replaces symbolic shapes).
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..observability.runtime import recompiles
+from ..profiler.record import emit_span, host_recorder
 
 
 @dataclass
@@ -141,6 +145,7 @@ class GenerationEngine:
         # until decode overwrites each position before first attending to it
         key = (bucket, cfg.max_new_tokens, b)
         if key not in self._compiled:
+            recompiles.record_miss("generation_engine.run", key)
             self._compiled[key] = self._build(bucket, cfg.max_new_tokens)
         cache = self._init_cache(b, bucket + cfg.max_new_tokens)
         if isinstance(cache, KVCache):
@@ -253,6 +258,7 @@ class PagedGenerationEngine:
 
         key = (t_bucket, cfg.max_new_tokens, b, bt.shape[1])
         if key not in self._compiled:
+            recompiles.record_miss("paged_engine.run", key)
             self._compiled[key] = self._build(cfg.max_new_tokens)
         rng = jax.random.key(cfg.seed)
         toks, _, _ = self._compiled[key](
@@ -271,6 +277,7 @@ class _Request:
     tokens: list = field(default_factory=list)
     done: bool = False
     max_new_tokens: Optional[int] = None  # None -> engine config default
+    trace_id: str = ""                    # serving-layer trace correlation
 
 
 class ContinuousBatchingEngine:
@@ -398,7 +405,8 @@ class ContinuousBatchingEngine:
         """Slots not occupied by a live sequence (pending queue not counted)."""
         return self._slot_rid.count(None)
 
-    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               trace_id: str = "") -> int:
         budget = (max_new_tokens if max_new_tokens is not None
                   else self.config.max_new_tokens)
         prompt = np.asarray(prompt, np.int32)
@@ -412,7 +420,8 @@ class ContinuousBatchingEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt,
-                                    max_new_tokens=max_new_tokens))
+                                    max_new_tokens=max_new_tokens,
+                                    trace_id=trace_id))
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -485,13 +494,25 @@ class ContinuousBatchingEngine:
                 lens[i] = lp
             key = (bucket, b_pad)
             if key not in self._compiled_prefill:
+                recompiles.record_miss("cbe.prefill", key)
                 self._compiled_prefill[key] = self._build_prefill(bucket)
             self._rng, sub = jax.random.split(self._rng)
+            t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
             tok, self.mgr.k_pages, self.mgr.v_pages = \
                 self._compiled_prefill[key](
                     params, jnp.asarray(ids), jnp.asarray(lens),
                     self.mgr.k_pages, self.mgr.v_pages, jnp.asarray(rows),
                     sub)
+            if t0_ns:
+                # one batched prefill serves several requests: emit one
+                # span per admitted request so each trace-id lane shows
+                # its own prefill segment
+                t1_ns = time.perf_counter_ns()
+                for s, req, pages, lp in items:
+                    emit_span("engine.prefill", t0_ns, t1_ns,
+                              event_type="Operator", trace_id=req.trace_id,
+                              args={"request_id": req.rid, "bucket": bucket,
+                                    "prompt_len": lp})
             # NO host readback: prefill tokens are written into the slots
             # lazily and reach the host with the next chunk's emissions
             slot_idx = jnp.asarray([s for s, *_ in items], jnp.int32)
@@ -534,13 +555,27 @@ class ContinuousBatchingEngine:
         if not self._live:
             return 0
         if self._decode_chunk is None:
+            recompiles.record_miss("cbe.decode_chunk",
+                                   (self.num_slots, self.chunk))
             self._decode_chunk = self._build_decode_chunk()
         self._rng, sub = jax.random.split(self._rng)
+        t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
         toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
             self._decode_chunk(params, self._tok_dev,
                                jnp.asarray(self._pos), self.mgr.k_pages,
                                self.mgr.v_pages, jnp.asarray(self._bt), sub)
         toks = np.asarray(toks)                    # the one fence
+        if t0_ns:
+            t1_ns = time.perf_counter_ns()
+            for s in range(self.num_slots):
+                rid = self._slot_rid[s]
+                if rid is None:
+                    continue
+                req = self._live[rid]
+                emit_span("engine.decode_chunk", t0_ns, t1_ns,
+                          event_type="Operator", trace_id=req.trace_id,
+                          args={"request_id": rid, "slot": s,
+                                "chunk": self.chunk})
         for s in range(self.num_slots):
             rid = self._slot_rid[s]
             if rid is None:

@@ -64,6 +64,13 @@ class CompileGuard:
             return False
         miss = bool(self._sigs)
         self._sigs.add(sig)
+        # every cache miss (first compile included) lands in the global
+        # trace-cache-miss counter + event log with the shape signature.
+        # record_miss, not note: self._sigs already dedupes per instance,
+        # and two same-named guards (e.g. two models' "forward") must each
+        # count their own real recompiles
+        from ..observability.runtime import recompiles
+        recompiles.record_miss(f"jit.{self.name}", sig)
         if miss:
             self.recompile_count += 1
             warnings.warn(

@@ -1,0 +1,59 @@
+"""``paddle_tpu.observability`` — unified telemetry for the whole tree.
+
+PR 1 and PR 2 each grew a metrics island (``serving.metrics``,
+``resilience.metrics``) and the profiler only sees inside explicit
+capture windows. This package is the attribution layer the north star
+needs (every future perf PR must be measurable):
+
+* :mod:`.registry` — ONE process-global :class:`MetricsRegistry`
+  (counters / gauges / histograms over ``core.histogram``); the serving
+  and resilience sinks re-register into it, so
+  ``get_registry().prometheus_text()`` is a single valid ``/metrics``
+  document and ``snapshot()`` its JSON twin.
+* :mod:`.trace` — trace-context propagation: an id minted per serving
+  request and per training step flows scheduler → engine step →
+  ``core.dispatch.apply`` RecordEvent spans via a contextvar, so
+  ``export_chrome_tracing`` emits per-request timelines (queue wait →
+  prefill → decode chunks) correlated by id, linked with Perfetto flow
+  events.
+* :mod:`.runtime` — always-on low-overhead dispatch telemetry (per-op
+  counters, sampled durations), recompile detection (trace-cache-miss
+  counter carrying op shapes), and the single-boolean fast-path flag the
+  dispatcher checks (< 3% overhead, guarded by
+  ``benchmarks/bench_dispatch_overhead.py``).
+* :mod:`.step_timer` — per-step host/device breakdown, tokens/sec and an
+  MFU estimate, wired into ``ResilientTrainer`` and the serving loop.
+* :mod:`.events` — structured JSON-lines event log (size-capped
+  rotation) shared by serving and resilience for shed / retry /
+  rollback / preempt / recompile events.
+
+Quick start::
+
+    from paddle_tpu.observability import (get_registry,
+                                          configure_event_log)
+    configure_event_log("/var/log/paddle/events.jsonl")
+    ...serve / train...
+    print(get_registry().prometheus_text())   # one /metrics document
+"""
+
+from . import format  # noqa: F401
+from .events import EventLog, configure_event_log, emit_event, event_log  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter, Gauge, HistogramMetric, MetricsRegistry, get_registry,
+)
+from .runtime import (  # noqa: F401
+    DispatchTelemetry, RecompileDetector, recompiles, telemetry,
+)
+from .step_timer import StepTimer  # noqa: F401
+from .trace import (  # noqa: F401
+    TraceContext, current_trace, current_trace_id, new_trace_id,
+    trace_context,
+)
+
+__all__ = [
+    "Counter", "Gauge", "HistogramMetric", "MetricsRegistry",
+    "get_registry", "DispatchTelemetry", "RecompileDetector", "recompiles",
+    "telemetry", "StepTimer", "TraceContext", "current_trace",
+    "current_trace_id", "new_trace_id", "trace_context", "EventLog",
+    "configure_event_log", "emit_event", "event_log", "format",
+]

@@ -66,7 +66,14 @@ def _default_scheduler(_step: int) -> ProfilerState:
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """Returns an on_trace_ready callback writing chrome://tracing JSON."""
+    """Returns an on_trace_ready callback writing chrome://tracing JSON.
+
+    Spans that share a trace id (one serving request / training step —
+    see ``observability.trace``) carry ``args.trace_id`` (and
+    ``args.request_id`` where known) so Perfetto can filter a single
+    request's timeline, and are linked with flow events (``ph: s/t/f``)
+    so the queue-wait → prefill → decode-chunk chain is drawn as arrows.
+    """
 
     def handler(prof: "Profiler") -> None:
         os.makedirs(dir_name, exist_ok=True)
@@ -74,13 +81,37 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
         path = os.path.join(
             dir_name, f"{worker}_time_{int(time.time()*1000)}.paddle_trace.json")
         events = []
+        by_trace = {}
         for sp in prof.collected_spans:
-            events.append({
+            ev = {
                 "name": sp.name, "cat": sp.event_type, "ph": "X",
                 "ts": sp.start_ns / 1000.0,
                 "dur": (sp.end_ns - sp.start_ns) / 1000.0,
                 "pid": sp.pid, "tid": sp.tid,
-            })
+            }
+            trace_id = getattr(sp, "trace_id", "")
+            args = dict(getattr(sp, "args", None) or {})
+            if trace_id:
+                args.setdefault("trace_id", trace_id)
+                by_trace.setdefault(trace_id, []).append(ev)
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        # flow events: one arrow chain per trace id, linking its spans in
+        # start-time order (s = first, t = intermediate, f = last)
+        for flow_id, (trace_id, chain) in enumerate(sorted(by_trace.items()),
+                                                    start=1):
+            if len(chain) < 2:
+                continue
+            chain.sort(key=lambda e: e["ts"])
+            for i, ev in enumerate(chain):
+                ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+                flow = {"name": f"trace/{trace_id}", "cat": "flow",
+                        "ph": ph, "id": flow_id, "ts": ev["ts"],
+                        "pid": ev["pid"], "tid": ev["tid"]}
+                if ph == "f":
+                    flow["bp"] = "e"
+                events.append(flow)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

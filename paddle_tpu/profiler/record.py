@@ -6,6 +6,12 @@ host_tracer.cc — SURVEY.md §5.1). Here the recorder is a process-global,
 thread-aware span list; when a capture is active each span additionally
 enters a ``jax.profiler.TraceAnnotation`` so it shows up in XLA xplane
 traces (TensorBoard) correlated with device activity.
+
+Spans carry the ambient trace id (``observability.trace``) so one serving
+request / training step can be followed across scheduler, engine and op
+dispatch in the chrome-tracing export. Outside a capture window,
+``RecordEvent.__enter__``/``__exit__`` short-circuit on a single boolean
+— the zero-overhead contract the dispatcher relies on.
 """
 
 from __future__ import annotations
@@ -15,6 +21,9 @@ import threading
 import time
 from typing import List, NamedTuple, Optional
 
+from ..observability import runtime as _obs_runtime
+from ..observability.trace import current_trace
+
 
 class HostSpan(NamedTuple):
     name: str
@@ -23,16 +32,29 @@ class HostSpan(NamedTuple):
     end_ns: int
     tid: int
     pid: int
+    trace_id: str = ""
+    args: Optional[dict] = None
 
 
 class _HostRecorder:
     """HostEventRecorder equivalent: lock-guarded span sink, armed only
-    while a Profiler capture window is active (zero overhead otherwise)."""
+    while a Profiler capture window is active (zero overhead otherwise).
+    Toggling ``enabled`` also re-arms the dispatcher's single-boolean
+    fast-path flag (observability.runtime.dispatch_armed)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._spans: List[HostSpan] = []
-        self.enabled = False
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._enabled = bool(value)
+        _obs_runtime.set_capture_active(self._enabled)
 
     def emit(self, span: HostSpan) -> None:
         with self._lock:
@@ -52,32 +74,63 @@ host_recorder = _HostRecorder()
 _MAIN_PID = threading.main_thread().ident or 0
 
 
+def emit_span(name: str, start_ns: int, end_ns: int,
+              event_type: str = "UserDefined",
+              trace_id: Optional[str] = None,
+              args: Optional[dict] = None) -> None:
+    """Emit a span with explicit timestamps (for retroactive spans like a
+    request's queue wait, whose start predates the emit site). No-op when
+    no capture window is active. ``trace_id=None`` picks up the ambient
+    trace context."""
+    if not host_recorder.enabled:
+        return
+    if trace_id is None:
+        ctx = current_trace()
+        trace_id = ctx.trace_id if ctx is not None else ""
+    host_recorder.emit(HostSpan(
+        name, event_type, start_ns, end_ns,
+        threading.get_ident(), _MAIN_PID, trace_id, args))
+
+
 class RecordEvent:
     """User annotation span (parity: paddle.profiler.RecordEvent).
 
     Usable as a context manager or via explicit begin()/end(). Event types
     mirror the reference's TracerEventType names (UserDefined, Operator,
     Dataloader, Communication, Forward, Backward, Optimization...).
+    ``args`` lands in the chrome-trace event's ``args`` (request ids etc);
+    ``trace_id`` overrides the ambient trace context.
     """
 
-    def __init__(self, name: str, event_type: str = "UserDefined"):
+    __slots__ = ("name", "event_type", "args", "_trace_id", "_start_ns",
+                 "_jax_ann")
+
+    def __init__(self, name: str, event_type: str = "UserDefined",
+                 args: Optional[dict] = None,
+                 trace_id: Optional[str] = None):
         self.name = name
         self.event_type = event_type
+        self.args = args
+        self._trace_id = trace_id
         self._start_ns: Optional[int] = None
         self._jax_ann = None
 
     def begin(self) -> None:
+        if not host_recorder.enabled:     # zero-overhead fast path
+            return
+        if self._trace_id is None:
+            ctx = current_trace()
+            self._trace_id = ctx.trace_id if ctx is not None else ""
         self._start_ns = time.perf_counter_ns()
-        if host_recorder.enabled:
-            try:
-                import jax.profiler as jprof
-                self._jax_ann = jprof.TraceAnnotation(self.name)
-                self._jax_ann.__enter__()
-            except Exception:
-                self._jax_ann = None
+        try:
+            import jax.profiler as jprof
+            self._jax_ann = jprof.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
 
     def end(self) -> None:
-        if self._start_ns is None:
+        if self._start_ns is None:        # never began (or capture was off)
             return
         if self._jax_ann is not None:
             try:
@@ -88,7 +141,8 @@ class RecordEvent:
             host_recorder.emit(HostSpan(
                 self.name, self.event_type, self._start_ns,
                 time.perf_counter_ns(),
-                threading.get_ident(), _MAIN_PID))
+                threading.get_ident(), _MAIN_PID,
+                self._trace_id or "", self.args))
         self._start_ns = None
 
     def __enter__(self) -> "RecordEvent":

@@ -3,14 +3,19 @@
 Counters (restores, corrupt checkpoints skipped, step retries, NaN
 rollbacks, skipped steps, preempt flushes, save failures) plus a
 save-latency histogram, exported the same two ways the serving sink is:
-``summary()`` dict and Prometheus text.
+``summary()`` dict and Prometheus text. The sink registers into the
+global :class:`~paddle_tpu.observability.registry.MetricsRegistry`
+(namespace replaces on re-creation), so the process-wide ``/metrics``
+document includes resilience alongside serving and runtime telemetry.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List
 
 from ..core.histogram import Histogram
+from ..observability import format as _fmt
+from ..observability.registry import get_registry
 
 
 class ResilienceMetrics:
@@ -18,6 +23,8 @@ class ResilienceMetrics:
         self.namespace = namespace
         self.counters: Dict[str, float] = {}
         self.save_latency_ms = Histogram()
+        get_registry().register_sink(self.namespace, self._prometheus_lines,
+                                     self.summary)
 
     def inc(self, counter: str, by: float = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0.0) + by
@@ -33,19 +40,15 @@ class ResilienceMetrics:
         return {"counters": dict(self.counters),
                 "save_latency_ms": self.save_latency_ms.summary()}
 
-    def to_prometheus_text(self) -> str:
+    def _prometheus_lines(self) -> List[str]:
         ns = self.namespace
-        lines = []
+        lines: List[str] = []
         for name in sorted(self.counters):
-            lines.append(f"# TYPE {ns}_{name}_total counter")
-            lines.append(f"{ns}_{name}_total {self.counters[name]:g}")
-        h = self.save_latency_ms
-        lines.append(f"# TYPE {ns}_save_latency_ms histogram")
-        acc = 0
-        for bound, n in zip(h.bounds, h.bucket_counts):
-            acc += n
-            lines.append(f'{ns}_save_latency_ms_bucket{{le="{bound:g}"}} {acc}')
-        lines.append(f'{ns}_save_latency_ms_bucket{{le="+Inf"}} {h.count}')
-        lines.append(f"{ns}_save_latency_ms_sum {h.sum:g}")
-        lines.append(f"{ns}_save_latency_ms_count {h.count}")
-        return "\n".join(lines) + "\n"
+            lines.extend(_fmt.counter_lines(f"{ns}_{name}_total",
+                                            value=self.counters[name]))
+        lines.extend(_fmt.histogram_lines(f"{ns}_save_latency_ms",
+                                          self.save_latency_ms))
+        return lines
+
+    def to_prometheus_text(self) -> str:
+        return "\n".join(self._prometheus_lines()) + "\n"

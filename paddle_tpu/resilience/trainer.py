@@ -34,6 +34,9 @@ from typing import Any, Callable, Dict, Optional, Set
 
 import numpy as np
 
+from ..observability.events import emit_event
+from ..observability.step_timer import StepTimer
+from ..observability.trace import trace_context
 from .durable import (async_save_checkpoint, checkpoint_path, latest_step,
                       restore_train_state, save_checkpoint)
 from .faults import ChaosError, FaultInjector
@@ -78,6 +81,12 @@ class ResilienceConfig:
     fault_injector: Optional[FaultInjector] = None
     chaos_seed: Optional[int] = None  # build a seeded injector at run()
                                       # scaled to the actual run length
+    # step-telemetry knobs (observability.StepTimer): tokens processed per
+    # step for tokens/sec, model FLOPs per step + chip peak for the MFU
+    # estimate; all optional (timer still reports host/device breakdown)
+    tokens_per_step: int = 0
+    flops_per_step: Optional[float] = None
+    peak_flops_per_s: Optional[float] = None
 
 
 class ResilientTrainer:
@@ -86,6 +95,8 @@ class ResilientTrainer:
         self.state = state
         self.cfg = config
         self.metrics = metrics or ResilienceMetrics()
+        self.step_timer = StepTimer(flops_per_step=config.flops_per_step,
+                                    peak_flops_per_s=config.peak_flops_per_s)
         self.last_loss: Optional[float] = None
         self.resumed_from: Optional[int] = None
         self._pending = None           # in-flight AsyncSaveFuture
@@ -131,6 +142,7 @@ class ResilientTrainer:
                                    fault_injector=self.cfg.fault_injector)
         except Exception as e:
             self.metrics.inc("save_failures")
+            emit_event("save_failure", step=step, error=repr(e))
             logger.warning("checkpoint save at step %d failed: %s", step, e)
             return None
         self.metrics.observe_save_ms((time.perf_counter() - t0) * 1e3)
@@ -151,6 +163,8 @@ class ResilientTrainer:
                 getattr(fut, "elapsed_s", 0.0) * 1e3)
         except Exception as e:
             self.metrics.inc("save_failures")
+            emit_event("save_failure", step=self._pending_step,
+                       error=repr(e), asynchronous=True)
             logger.warning("async checkpoint save at step %s failed: %s",
                            self._pending_step, e)
         self._pending = None
@@ -193,6 +207,8 @@ class ResilientTrainer:
         self._harvest(block=True)
         path = self.save(block=True)
         self.metrics.inc("preempt_flushes")
+        emit_event("preempt_flush", step=self.state.global_step,
+                   checkpoint=path)
         if path is None:
             intact = latest_step(self.cfg.checkpoint_dir)
             path = (checkpoint_path(self.cfg.checkpoint_dir, intact)
@@ -219,6 +235,8 @@ class ResilientTrainer:
                         retries=attempt, error=repr(e)) from e
                 attempt += 1
                 self.metrics.inc("step_retries")
+                emit_event("step_retry", step=step, attempt=attempt,
+                           error=repr(e), backoff_s=delay)
                 logger.warning("step %d failed (%s); retry %d/%d in %.2fs",
                                step, e, attempt, self.cfg.max_step_retries,
                                delay)
@@ -236,6 +254,8 @@ class ResilientTrainer:
         if restored is None:
             raise TrainingAborted("no_intact_checkpoint", offending_step,
                                   detail=reason)
+        emit_event("rollback", reason=reason, step=offending_step,
+                   restored_step=restored)
         logger.warning("rolled back to step %d after %s at step %d",
                        restored, reason, offending_step)
 
@@ -246,6 +266,7 @@ class ResilientTrainer:
             # genuinely divergent, not transient: skip it on replay
             self._skip_steps.add(step)
             self.metrics.inc("steps_skipped")
+            emit_event("step_skipped", step=step, nan_count=n)
             logger.error("step %d produced NaN/Inf %d times; skipping it",
                          step, n)
 
@@ -281,9 +302,13 @@ class ResilientTrainer:
                 if step in self._skip_steps:
                     self.state.step()
                     continue
-                loss = self._step_with_retry(step_fn, step)
-                lv = loss._value if hasattr(loss, "_value") else loss
-                lf = float(np.asarray(lv))
+                with trace_context(step=step):
+                    self.step_timer.begin()
+                    loss = self._step_with_retry(step_fn, step)
+                    lv = loss._value if hasattr(loss, "_value") else loss
+                    self.step_timer.host_done()   # dispatch done; the
+                    lf = float(np.asarray(lv))    # float() is the fence
+                    self.step_timer.end(tokens=cfg.tokens_per_step)
                 if not np.isfinite(lf):
                     self._note_nan(step)
                     self._rollback(step, "nan_loss")
@@ -309,4 +334,5 @@ class ResilientTrainer:
                 "end_step": self.state.global_step,
                 "last_loss": self.last_loss,
                 "skipped_steps": sorted(self._skip_steps),
-                "metrics": self.metrics.summary()}
+                "metrics": self.metrics.summary(),
+                "step_timer": self.step_timer.summary()}

@@ -35,10 +35,17 @@ from typing import Dict, List
 
 from ..core.histogram import (DEFAULT_BOUNDS_MS, DEFAULT_QUANTILES,  # noqa: F401
                               Histogram)
+from ..observability import format as _fmt
+from ..observability.registry import get_registry
 from ..profiler.record import RecordEvent
 
 class ServingMetrics:
-    """Process-local metrics sink for one :class:`ServingScheduler`."""
+    """Process-local metrics sink for one :class:`ServingScheduler`.
+
+    Registered into the global :class:`~paddle_tpu.observability.registry.
+    MetricsRegistry` under its namespace (a fresh sink replaces the
+    previous one — normal per-server lifecycle), so the process-wide
+    ``/metrics`` document includes serving without a second scrape."""
 
     def __init__(self, namespace: str = "paddle_serving"):
         self.namespace = namespace
@@ -71,6 +78,8 @@ class ServingMetrics:
             "inflight": 0.0,
             "degraded": 0.0,
         }
+        get_registry().register_sink(self.namespace, self._prometheus_lines,
+                                     self.summary)
 
     # -- recording ----------------------------------------------------------
 
@@ -90,11 +99,16 @@ class ServingMetrics:
         with self._lock:
             self.gauges[gauge] = float(value)
 
-    def span(self, name: str, event_type: str = "UserDefined") -> RecordEvent:
+    def span(self, name: str, event_type: str = "UserDefined",
+             args: Dict[str, object] = None,
+             trace_id: str = None) -> RecordEvent:
         """A profiler span (``with metrics.span('serving.step'): ...``);
         shows up in the host recorder / xplane trace under
-        ``<namespace>.<name>``."""
-        return RecordEvent(f"{self.namespace}.{name}", event_type)
+        ``<namespace>.<name>``. ``args``/``trace_id`` flow into the
+        chrome-trace event (trace_id=None picks up the ambient trace
+        context)."""
+        return RecordEvent(f"{self.namespace}.{name}", event_type,
+                           args=args, trace_id=trace_id)
 
     def mark(self, name: str) -> None:
         """Zero-length trace event (shed/cancel/retry markers)."""
@@ -121,44 +135,32 @@ class ServingMetrics:
             out["gauges"] = dict(self.gauges)
         return out
 
-    def to_prometheus_text(self) -> str:
-        """Prometheus exposition format: every histogram as ``_bucket``/
-        ``_sum``/``_count`` plus a sibling ``<name>_quantile`` gauge
-        family with exact percentiles, counters as ``_total``, gauges as
-        plain gauges."""
+    def _prometheus_lines(self) -> List[str]:
+        """Exposition lines (assembled by ``observability.format``, the
+        single formatter): every histogram as buckets/sum/count plus a
+        sibling ``<name>_quantile`` gauge family with exact percentiles,
+        counters as ``_total``, gauges as plain gauges."""
         ns = self.namespace
         lines: List[str] = []
         with self._lock:
             for name, h in self.histograms.items():
-                metric = f"{ns}_{name}"
-                lines.append(f"# HELP {metric} serving {name} distribution")
-                lines.append(f"# TYPE {metric} histogram")
-                acc = 0
-                for bound, n in zip(h.bounds, h.bucket_counts):
-                    acc += n
-                    lines.append(
-                        f'{metric}_bucket{{le="{bound:g}"}} {acc}')
-                lines.append(
-                    f'{metric}_bucket{{le="+Inf"}} {h.count}')
-                lines.append(f"{metric}_sum {h.sum:g}")
-                lines.append(f"{metric}_count {h.count}")
-                lines.append(f"# TYPE {metric}_quantile gauge")
-                for q in DEFAULT_QUANTILES:
-                    lines.append(
-                        f'{metric}_quantile{{quantile="{q:g}"}} '
-                        f"{h.percentile(q):g}")
+                lines.extend(_fmt.histogram_lines(
+                    f"{ns}_{name}", h,
+                    help=f"serving {name} distribution",
+                    quantiles=DEFAULT_QUANTILES))
             for name, v in self.counters.items():
-                metric = f"{ns}_{name}"
-                lines.append(f"# TYPE {metric} counter")
-                lines.append(f"{metric} {v:g}")
+                lines.extend(_fmt.counter_lines(f"{ns}_{name}", value=v))
             # labeled per-reason series only: an unlabeled grand-total
             # sibling would double-count sum() queries over the family
-            metric = f"{ns}_requests_shed_total"
-            lines.append(f"# TYPE {metric} counter")
-            for reason, n in sorted(self.shed.items()):
-                lines.append(f'{metric}{{reason="{reason}"}} {n:g}')
+            lines.extend(_fmt.counter_lines(
+                f"{ns}_requests_shed_total",
+                series=[({"reason": r}, n)
+                        for r, n in sorted(self.shed.items())]))
             for name, v in self.gauges.items():
-                metric = f"{ns}_{name}_gauge"
-                lines.append(f"# TYPE {metric} gauge")
-                lines.append(f"{metric} {v:g}")
-        return "\n".join(lines) + "\n"
+                lines.extend(_fmt.gauge_lines(f"{ns}_{name}_gauge", value=v))
+        return lines
+
+    def to_prometheus_text(self) -> str:
+        """This sink alone as Prometheus exposition text (the registry's
+        ``prometheus_text()`` gives the whole process)."""
+        return "\n".join(self._prometheus_lines()) + "\n"

@@ -54,6 +54,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..observability.events import emit_event
+from ..observability.step_timer import StepTimer
+from ..observability.trace import new_trace_id, trace_context
+from ..profiler.record import emit_span, host_recorder
 from .metrics import ServingMetrics
 from .stream import ServingError, TokenStream
 
@@ -111,7 +115,11 @@ class ServingRequest:
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None
     finish_t: Optional[float] = None
-    _span: Any = field(default=None, repr=False)
+    trace_id: str = ""                    # minted at submit; follows the
+    _span: Any = field(default=None, repr=False)  # request across layers
+    _submit_ns: int = field(default=0, repr=False)  # perf-clock twin of
+    # submit_t (submit_t may come from an injected/fake scheduler clock;
+    # trace spans need the real perf_counter_ns timeline)
 
     @property
     def done(self) -> bool:
@@ -145,6 +153,7 @@ class ServingScheduler:
         self._requests: Dict[int, ServingRequest] = {}
         self._by_engine_rid: Dict[int, ServingRequest] = {}
         self._watchdog: Optional[tuple] = None   # (thread, result box)
+        self.step_timer = StepTimer()            # host/device + tokens/s
         self.degraded = False
         # engine hooks: route chunk tokens / retirements into the streams
         engine.token_callback = self._on_engine_token
@@ -207,8 +216,12 @@ class ServingScheduler:
             stream=TokenStream(rid, on_token=on_token),
             submit_t=now,
             deadline_t=None if deadline_ms is None
-            else now + deadline_ms / 1e3)
-        req._span = self.metrics.span("request")
+            else now + deadline_ms / 1e3,
+            trace_id=new_trace_id("req"))
+        req._submit_ns = time.perf_counter_ns()
+        req._span = self.metrics.span("request",
+                                      args={"request_id": rid},
+                                      trace_id=req.trace_id)
         req._span.begin()
         self._requests[rid] = req
         key = (req.priority, self._seq)
@@ -236,6 +249,7 @@ class ServingScheduler:
         self._finish(req, RequestState.CANCELLED, "cancelled")
         self.metrics.inc("requests_cancelled_total")
         self.metrics.mark("cancel")
+        emit_event("cancel", request_id=req.rid, trace_id=req.trace_id)
         return True
 
     # -- queue policy -------------------------------------------------------
@@ -271,6 +285,8 @@ class ServingScheduler:
                                   rid=req.rid))
         self.metrics.inc_shed(reason)
         self.metrics.mark(f"shed.{reason}")
+        emit_event("shed", reason=reason, request_id=req.rid,
+                   trace_id=req.trace_id, priority=req.priority)
 
     def _finish(self, req: ServingRequest, state: str, reason: str,
                 error: Optional[ServingError] = None) -> None:
@@ -296,18 +312,29 @@ class ServingScheduler:
         run a robust engine step, account. Returns ``pending``."""
         if self.degraded:
             return 0
-        with self.metrics.span("step"):
-            self._expire_deadlines()
-            self._admit()
-            if self._by_engine_rid:
-                t0 = self._clock()
-                ok = self._robust_step(params)
-                self.metrics.observe("step_ms",
-                                     (self._clock() - t0) * 1e3)
-                self.metrics.inc("steps_total")
-                if ok:
-                    self.engine.collect()   # streams own the tokens
-            self._sample_gauges()
+        # each scheduler round gets its own trace id, so the step's op
+        # dispatches correlate in the chrome trace (per-request lanes use
+        # the request trace ids minted at submit)
+        with trace_context(step=int(self.metrics.counters.get(
+                "steps_total", 0))):
+            with self.metrics.span("step"):
+                self._expire_deadlines()
+                self._admit()
+                if self._by_engine_rid:
+                    t0 = self._clock()
+                    tokens_before = self.metrics.counters.get(
+                        "tokens_generated_total", 0)
+                    self.step_timer.begin()
+                    ok = self._robust_step(params)
+                    self.step_timer.end(
+                        tokens=int(self.metrics.counters.get(
+                            "tokens_generated_total", 0) - tokens_before))
+                    self.metrics.observe("step_ms",
+                                         (self._clock() - t0) * 1e3)
+                    self.metrics.inc("steps_total")
+                    if ok:
+                        self.engine.collect()   # streams own the tokens
+                self._sample_gauges()
         return self.pending
 
     def run(self, params, max_steps: Optional[int] = None) -> None:
@@ -340,9 +367,15 @@ class ServingScheduler:
             self._queue.pop(0)
             self._order.pop(0)
             req.engine_rid = self.engine.submit(
-                req.prompt, max_new_tokens=req.max_new_tokens)
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                trace_id=req.trace_id)
             req.state = RequestState.RUNNING
             self._by_engine_rid[req.engine_rid] = req
+            if host_recorder.enabled:
+                emit_span(f"{self.metrics.namespace}.queue_wait",
+                          req._submit_ns, time.perf_counter_ns(),
+                          trace_id=req.trace_id,
+                          args={"request_id": req.rid})
             self.metrics.observe("queue_wait_ms",
                                  (now - req.submit_t) * 1e3)
             headroom -= 1
@@ -367,6 +400,8 @@ class ServingScheduler:
                 if attempt < cfg.max_step_retries:
                     self.metrics.inc("step_retries_total")
                     self.metrics.mark("step_retry")
+                    emit_event("step_retry", attempt=attempt + 1,
+                               error=repr(e), backoff_s=delay)
                     self._sleep(delay)
                     delay *= cfg.retry_backoff_multiplier
         self._degrade(last_err)
@@ -422,6 +457,9 @@ class ServingScheduler:
         self.degraded = True
         self.metrics.set_gauge("degraded", 1.0)
         self.metrics.mark("degraded")
+        emit_event("degraded", error=repr(err) if err else None,
+                   inflight=len(self._by_engine_rid),
+                   queued=len(self._queue))
         cause = f": {err}" if err is not None else ""
         for req in list(self._by_engine_rid.values()):
             try:

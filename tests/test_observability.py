@@ -1,0 +1,542 @@
+"""paddle_tpu.observability (ISSUE 3): unified metrics registry,
+trace-context propagation, always-on dispatch telemetry, recompile
+detection, StepTimer, event log, and the chrome-trace acceptance run.
+
+The serving runs use the tiny stacked llama (same setup idiom as
+tests/test_serving.py); a fixed engine seed keeps assertions stable."""
+
+import json
+import os
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                           GenerationConfig)
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability import (MetricsRegistry, StepTimer,
+                                      current_trace, current_trace_id,
+                                      get_registry, new_trace_id,
+                                      recompiles, telemetry, trace_context)
+from paddle_tpu.observability.events import EventLog
+from paddle_tpu.observability.format import validate_exposition_text
+from paddle_tpu.observability.runtime import dispatch_armed
+from paddle_tpu.profiler import Profiler, ProfilerTarget, export_chrome_tracing
+from paddle_tpu.profiler.record import RecordEvent, host_recorder
+from paddle_tpu.resilience import ResilienceMetrics
+from paddle_tpu.serving import SchedulerConfig, ServingMetrics, ServingScheduler
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _setup(max_new=4, num_slots=2, chunk=2, seed=3, **sched_kw):
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=seed)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new, seed=seed),
+        num_slots=num_slots, page_size=4, max_seq_len=32, chunk=chunk)
+    sched = ServingScheduler(eng, SchedulerConfig(**sched_kw))
+    return cfg, params, eng, sched
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry: uniqueness, labels, exposition text, snapshot
+# ---------------------------------------------------------------------------
+
+def test_registry_name_uniqueness_and_idempotent_reuse():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x_total", "help", labels=("op",))
+    c2 = reg.counter("x_total", "other help", labels=("op",))
+    assert c1 is c2                         # same name+type+labels: reused
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")                # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("other",))  # label conflict
+
+
+def test_registry_labels_and_values():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total", labels=("op",))
+    c.inc(op="add")
+    c.inc(2, op="mul")
+    assert c.value(op="add") == 1 and c.value(op="mul") == 2
+    assert c.total == 3
+    with pytest.raises(ValueError):
+        c.inc(kernel="add")                 # undeclared label name
+    g = reg.gauge("depth")
+    g.set(7)
+    assert g.value() == 7
+    h = reg.histogram("lat_ms")
+    h.observe(3.0)
+    h.observe(40.0)
+    assert h.hist().count == 2
+
+
+def test_registry_prometheus_text_parses_and_is_complete():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "a counter", labels=("k",)).inc(k="v1")
+    reg.gauge("b_gauge", "a gauge").set(2.5)
+    reg.histogram("c_ms", "a histogram").observe(12.0)
+    reg.register_sink("sink_ns", lambda: ["# TYPE sink_up gauge",
+                                          "sink_up 1"])
+    text = reg.prometheus_text()
+    validate_exposition_text(text)
+    for needle in ('a_total{k="v1"} 1', "b_gauge 2.5", "c_ms_count 1",
+                   "sink_up 1"):
+        assert needle in text, text
+    snap = reg.snapshot()
+    assert snap["a_total"] == {"k=v1": 1.0}
+    assert snap["b_gauge"] == 2.5
+    assert snap["c_ms"]["count"] == 1.0
+    json.dumps(snap)                        # JSON-able end to end
+
+
+def test_registry_labeled_histogram_types_family_once():
+    """A labeled histogram family must carry ONE TYPE line no matter how
+    many label-sets it holds (duplicate TYPE is invalid exposition)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", "per-op latency", labels=("op",))
+    h.observe(1.0, op="a")
+    h.observe(2.0, op="b")
+    text = reg.prometheus_text()
+    validate_exposition_text(text)
+    assert text.count("# TYPE lat_ms histogram") == 1
+    assert 'lat_ms_bucket{op="a",le="+Inf"} 1' in text
+    assert 'lat_ms_bucket{op="b",le="+Inf"} 1' in text
+
+
+def test_compile_guard_counts_per_instance_recompiles():
+    """Two same-named guards both count their real recompiles (the global
+    detector must not swallow the second instance's misses)."""
+    from paddle_tpu.jit import CompileGuard
+    import warnings
+    before = recompiles.count("jit.fwd")
+    g1, g2 = CompileGuard("fwd"), CompileGuard("fwd")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g1.check(np.ones((2, 2)))
+        g1.check(np.ones((4, 2)))       # real recompile on g1
+        g2.check(np.ones((2, 2)))       # g2's first compile: a real miss
+        g2.check(np.ones((2, 2)))       # cached on g2: not a miss
+    assert recompiles.count("jit.fwd") - before == 3
+
+
+def test_registry_sink_replace_semantics():
+    reg = MetricsRegistry()
+    reg.register_sink("ns", lambda: ["# TYPE old counter", "old 1"])
+    reg.register_sink("ns", lambda: ["# TYPE new counter", "new 2"])
+    assert "new 2" in reg.prometheus_text()
+    assert "old 1" not in reg.prometheus_text()
+    with pytest.raises(ValueError):
+        reg.register_sink("ns", lambda: [], replace=False)
+
+
+def test_global_registry_covers_serving_resilience_and_dispatch():
+    """Acceptance: ONE exposition document containing serving metrics,
+    resilience metrics and per-op dispatch counters, and it parses."""
+    sm = ServingMetrics()                   # re-registers its sink
+    sm.observe("ttft_ms", 12.0)
+    sm.inc("requests_submitted_total")
+    rm = ResilienceMetrics()
+    rm.observe_save_ms(5.0)
+    assert telemetry.enabled
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    (x + x).numpy()                         # at least one dispatch counted
+
+    text = get_registry().prometheus_text()
+    validate_exposition_text(text)
+    assert "paddle_serving_ttft_ms_count" in text
+    assert "paddle_serving_requests_submitted_total 1" in text
+    assert "paddle_resilience_saves_total 1" in text
+    assert "paddle_resilience_save_latency_ms_count" in text
+    assert re.search(r'paddle_runtime_op_dispatch_total\{op="[a-z_]+"\} \d+',
+                     text), text
+    assert "paddle_runtime_recompiles_total" in text
+
+
+def test_sink_delegation_keeps_public_prometheus_text_shape():
+    """The PR 1/PR 2 sink surfaces must be unchanged by the delegation to
+    observability.format (existing dashboards parse this shape)."""
+    sm = ServingMetrics()
+    sm.observe("ttft_ms", 3.0)
+    sm.inc_shed("deadline")
+    text = sm.to_prometheus_text()
+    validate_exposition_text(text)
+    assert "# HELP paddle_serving_ttft_ms serving ttft_ms distribution" in text
+    assert 'paddle_serving_ttft_ms_bucket{le="+Inf"} 1' in text
+    assert 'paddle_serving_ttft_ms_quantile{quantile="0.99"} 3' in text
+    assert 'paddle_serving_requests_shed_total{reason="deadline"} 1' in text
+    rm = ResilienceMetrics()
+    rm.inc("restores")
+    rtext = rm.to_prometheus_text()
+    validate_exposition_text(rtext)
+    assert "paddle_resilience_restores_total 1" in rtext
+    assert 'paddle_resilience_save_latency_ms_bucket{le="+Inf"} 0' in rtext
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation
+# ---------------------------------------------------------------------------
+
+def test_trace_context_nesting_and_ids():
+    assert current_trace() is None
+    with trace_context(request_id=7) as outer:
+        assert current_trace_id() == outer.trace_id
+        assert current_trace().request_id == 7
+        with trace_context(step=3) as inner:
+            assert inner.trace_id != outer.trace_id
+            assert current_trace().step == 3
+        assert current_trace_id() == outer.trace_id
+    assert current_trace() is None
+    assert new_trace_id() != new_trace_id()
+
+
+def test_trace_id_flows_scheduler_engine_dispatch():
+    """A serving request's trace id lands on its queue-wait / prefill /
+    decode-chunk spans; the scheduler step's trace id lands on the op
+    dispatch (Operator) spans recorded inside the step."""
+    cfg, params, eng, sched = _setup()
+    host_recorder.enabled = True
+    host_recorder.clear()
+    try:
+        h = sched.submit(np.array([5, 6, 7], np.int32))
+        while sched.pending:
+            sched.step(params)
+    finally:
+        host_recorder.enabled = False
+    spans = host_recorder.drain()
+    assert h.trace_id
+    request_lane = [s for s in spans if s.trace_id == h.trace_id]
+    names = [s.name for s in request_lane]
+    assert "paddle_serving.queue_wait" in names
+    assert "engine.prefill" in names
+    assert "engine.decode_chunk" in names
+    assert "paddle_serving.request" in names
+    # every request-lane span carries the request id in args
+    for s in request_lane:
+        assert (s.args or {}).get("request_id") == h.rid
+    # the scheduler step span carries the step's (distinct) trace id
+    step_spans = [s for s in spans if s.name == "paddle_serving.step"]
+    assert step_spans
+    assert all(s.trace_id and s.trace_id != h.trace_id for s in step_spans)
+    # eager op dispatch (the training path) inherits the ambient trace id
+    # down in core.dispatch.apply's RecordEvent
+    host_recorder.enabled = True
+    host_recorder.clear()
+    try:
+        with trace_context(step=42) as tc:
+            x = paddle.to_tensor(np.ones((2, 2), np.float32))
+            (x + x) * x
+    finally:
+        host_recorder.enabled = False
+    op_spans = [s for s in host_recorder.drain()
+                if s.event_type == "Operator"]
+    assert {s.name for s in op_spans} >= {"add", "multiply"}
+    assert all(s.trace_id == tc.trace_id for s in op_spans)
+
+
+def test_training_step_trace_context(tmp_path):
+    """ResilientTrainer runs each step inside a step trace context."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.checkpoint import TrainState
+    from paddle_tpu.resilience import ResilienceConfig, ResilientTrainer
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    state = TrainState(net, opt)
+    seen = []
+
+    def step_fn(step):
+        ctx = current_trace()
+        seen.append((step, ctx.step if ctx else None,
+                     ctx.trace_id if ctx else None))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    tr = ResilientTrainer(state, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), save_interval=0,
+        install_signal_handlers=False, tokens_per_step=32))
+    out = tr.run(step_fn, num_steps=3)
+    assert [s[0] for s in seen] == [0, 1, 2]
+    assert all(s[0] == s[1] for s in seen)          # ctx.step == step
+    assert len({s[2] for s in seen}) == 3           # fresh id per step
+    st = out["step_timer"]
+    assert st["steps"] == 3 and st["tokens"] == 96
+    assert st["tokens_per_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# recompile detection
+# ---------------------------------------------------------------------------
+
+def test_recompile_counter_fires_exactly_once_per_new_shape():
+    before = recompiles.count("unit_fn")
+    assert recompiles.note("unit_fn", (8, 16)) is True
+    assert recompiles.note("unit_fn", (8, 16)) is False   # same shape: no-op
+    assert recompiles.note("unit_fn", (8, 32)) is True    # new shape: fires
+    assert recompiles.note("unit_fn", (8, 32)) is False
+    assert recompiles.count("unit_fn") - before == 2
+
+
+def test_engine_compile_cache_miss_counts_and_logs(tmp_path):
+    from paddle_tpu.observability import events as events_mod
+    old = events_mod.event_log.path
+    events_mod.event_log.configure(str(tmp_path / "events.jsonl"))
+    try:
+        cfg, params, eng, sched = _setup()
+        before = recompiles.count()
+        h = sched.submit(np.array([1, 2, 3], np.int32))
+        while sched.pending:
+            sched.step(params)
+        first_delta = recompiles.count() - before
+        assert first_delta >= 2        # prefill + decode chunk compiled
+        # same shapes again: nothing new compiles
+        before = recompiles.count()
+        h2 = sched.submit(np.array([4, 5, 6], np.int32))
+        while sched.pending:
+            sched.step(params)
+        assert recompiles.count() == before
+        events = [json.loads(l) for l in
+                  open(tmp_path / "events.jsonl").read().splitlines()]
+        rec = [e for e in events if e["kind"] == "recompile"]
+        assert rec and all("shapes" in e and "fn" in e for e in rec)
+    finally:
+        events_mod.event_log.configure(old)
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_writes_jsonl_with_trace_context(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    log = EventLog(str(path))
+    with trace_context(request_id=9) as ctx:
+        log.emit("shed", reason="deadline")
+    log.emit("plain", n=1)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "shed"
+    assert lines[0]["reason"] == "deadline"
+    assert lines[0]["trace_id"] == ctx.trace_id
+    assert lines[0]["request_id"] == 9
+    assert "trace_id" not in lines[1]
+    assert lines[0]["ts"] > 0
+
+
+def test_event_log_size_capped_rotation(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    log = EventLog(str(path), max_bytes=400, backups=2)
+    for i in range(60):
+        log.emit("tick", i=i, pad="x" * 40)
+    assert os.path.getsize(path) <= 400
+    assert (tmp_path / "ev.jsonl.1").exists()
+    assert (tmp_path / "ev.jsonl.2").exists()
+    assert not (tmp_path / "ev.jsonl.3").exists()   # oldest dropped
+    # newest generation holds the latest events, in order
+    last = [json.loads(l) for l in path.read_text().splitlines()]
+    assert last[-1]["i"] == 59
+    gen1 = [json.loads(l) for l in
+            (tmp_path / "ev.jsonl.1").read_text().splitlines()]
+    assert gen1[-1]["i"] == last[0]["i"] - 1
+
+
+def test_event_log_disabled_is_noop(tmp_path):
+    log = EventLog()
+    log.emit("nothing", x=1)                # must not raise or write
+    assert not log.enabled
+
+
+def test_serving_events_reach_the_shared_log(tmp_path):
+    from paddle_tpu.observability import events as events_mod
+    old = events_mod.event_log.path
+    events_mod.event_log.configure(str(tmp_path / "serving.jsonl"))
+    try:
+        cfg, params, eng, sched = _setup(max_queue_depth=1)
+        for i in range(4):
+            sched.submit(np.array([1, 2, 3], np.int32), priority=i)
+        while sched.pending:
+            sched.step(params)
+        events = [json.loads(l) for l in
+                  open(tmp_path / "serving.jsonl").read().splitlines()]
+        kinds = {e["kind"] for e in events}
+        assert "shed" in kinds              # queue overflow shed to the log
+        shed = next(e for e in events if e["kind"] == "shed")
+        assert shed["reason"] == "queue_full" and "request_id" in shed
+    finally:
+        events_mod.event_log.configure(old)
+
+
+# ---------------------------------------------------------------------------
+# StepTimer
+# ---------------------------------------------------------------------------
+
+def test_step_timer_math():
+    t = StepTimer(flops_per_step=1e9, peak_flops_per_s=1e12)
+    for _ in range(4):
+        with t.step(tokens=128):
+            pass
+    assert t.steps == 4 and t.tokens == 512
+    s = t.summary()
+    assert s["step_ms"]["count"] == 4
+    assert s["tokens_per_s"] == pytest.approx(512 / t.total_s)
+    # mfu = (flops_per_step * steps / total_s) / peak
+    assert s["mfu"] == pytest.approx((1e9 * 4 / t.total_s) / 1e12)
+    assert t.end() is None                  # end without begin tolerated
+
+
+def test_step_timer_host_device_split():
+    import time as _t
+    t = StepTimer()
+    t.begin()
+    _t.sleep(0.01)
+    t.host_done()
+    _t.sleep(0.02)
+    t.end(tokens=1)
+    s = t.summary()
+    assert s["host_ms"]["max"] >= 9
+    assert s["device_ms"]["max"] >= 18
+    assert s["step_ms"]["max"] >= s["host_ms"]["max"] + 17
+    t2 = StepTimer()                        # no flops config -> mfu None
+    with t2.step():
+        pass
+    assert t2.summary()["mfu"] is None
+
+
+def test_scheduler_step_timer_counts_tokens():
+    cfg, params, eng, sched = _setup(max_new=4)
+    sched.submit(np.array([1, 2, 3], np.int32))
+    while sched.pending:
+        sched.step(params)
+    assert sched.step_timer.steps >= 1
+    assert sched.step_timer.tokens == 4     # max_new tokens counted
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead fast path
+# ---------------------------------------------------------------------------
+
+def test_record_event_short_circuits_when_disarmed():
+    assert not host_recorder.enabled
+    ev = RecordEvent("idle")
+    with ev:
+        pass
+    assert ev._start_ns is None             # begin() never armed the span
+    assert host_recorder.drain() == []
+
+
+def test_dispatch_armed_flag_tracks_sources():
+    assert telemetry.enabled and dispatch_armed[0]
+    telemetry.disable()
+    try:
+        assert not dispatch_armed[0]        # nothing armed: single check
+        host_recorder.enabled = True
+        assert dispatch_armed[0]            # capture window arms it
+        host_recorder.enabled = False
+        assert not dispatch_armed[0]
+    finally:
+        telemetry.enable()
+    assert dispatch_armed[0]
+
+
+def test_dispatch_counters_and_sampled_durations():
+    telemetry.enable()
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    before = telemetry.op_counts.get("add", 0)
+    dur_before = telemetry._duration_us.hist().count
+    for _ in range(telemetry.sample_every + 1):
+        x + x
+    assert telemetry.op_counts["add"] - before == telemetry.sample_every + 1
+    assert telemetry._duration_us.hist().count > dur_before
+    # disabled: counters freeze
+    telemetry.disable()
+    try:
+        frozen = telemetry.op_counts.get("add", 0)
+        x + x
+        assert telemetry.op_counts.get("add", 0) == frozen
+    finally:
+        telemetry.enable()
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace acceptance: 3-request serving run with per-request lanes
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_three_request_lanes(tmp_path):
+    """ISSUE 3 acceptance: a 3-request serving run exports a chrome trace
+    where each request's queue-wait → prefill → decode-chunk spans share
+    that request's trace id (in args) and are linked by flow events."""
+    cfg, params, eng, sched = _setup(max_new=4, num_slots=2)
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    prof.start()
+    handles = [sched.submit(np.array([3 + i, 5, 7], np.int32))
+               for i in range(3)]
+    while sched.pending:
+        sched.step(params)
+    prof.stop()
+
+    assert prof.last_export_path
+    trace = json.load(open(prof.last_export_path))
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    for h in handles:
+        lane = [e for e in xs
+                if e.get("args", {}).get("trace_id") == h.trace_id]
+        names = {e["name"] for e in lane}
+        assert {"paddle_serving.queue_wait", "engine.prefill",
+                "engine.decode_chunk"} <= names, (h.rid, names)
+        assert all(e["args"]["request_id"] == h.rid for e in lane)
+        # lane ordering: queue wait starts before prefill before decode
+        t_queue = min(e["ts"] for e in lane
+                      if e["name"] == "paddle_serving.queue_wait")
+        t_prefill = min(e["ts"] for e in lane
+                        if e["name"] == "engine.prefill")
+        t_decode = min(e["ts"] for e in lane
+                       if e["name"] == "engine.decode_chunk")
+        assert t_queue <= t_prefill <= t_decode
+    # flow events link each request's spans: one s and one f per trace id
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    for h in handles:
+        chain = [e for e in flows if e["name"] == f"trace/{h.trace_id}"]
+        assert [e for e in chain if e["ph"] == "s"]
+        assert [e for e in chain if e["ph"] == "f"]
+        ids = {e["id"] for e in chain}
+        assert len(ids) == 1
+    # distinct requests get distinct flow ids
+    all_ids = {e["id"] for e in flows}
+    assert len(all_ids) >= 3
+
+
+# ---------------------------------------------------------------------------
+# lint: exposition formatting lives ONLY in observability/
+# ---------------------------------------------------------------------------
+
+def test_no_adhoc_prometheus_formatters_outside_observability():
+    """Forbid new private Prometheus/histogram formatters: any module
+    emitting bucket/TYPE exposition lines must delegate to
+    ``paddle_tpu.observability.format`` (the single formatter), like the
+    serving and resilience sinks do."""
+    patterns = re.compile(
+        r'_bucket\{+le=|\{le="|# TYPE \{|"# TYPE |f"# TYPE|'
+        r"quantile=\\\"|_prometheus_fmt")
+    pkg = REPO / "paddle_tpu"
+    allowed = {pkg / "observability" / "format.py"}
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        if path in allowed:
+            continue
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if patterns.search(line):
+                offenders.append(f"{path.relative_to(REPO)}:{i}")
+    assert not offenders, (
+        f"ad-hoc Prometheus formatting in {offenders}; assemble exposition "
+        "lines via paddle_tpu.observability.format so the registry stays "
+        "the single valid /metrics surface")
