@@ -93,7 +93,7 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "hapi", "parallel", "incubate",
                 "profiler", "models", "inference", "serving", "static",
-                "quantization", "observability", "resilience",
+                "quantization", "observability", "resilience", "kvcache",
                 "linalg", "fft", "sparse", "distribution", "signal",
                 "audio", "text", "utils", "onnx", "geometric",
                 "device", "regularizer", "callbacks", "version", "hub"):

@@ -245,7 +245,7 @@ class PagedGenerationEngine:
             ids[i, :len(p)] = np.asarray(p, np.int32)
 
         total = [l + cfg.max_new_tokens for l in lens]
-        pages_per_seq = [(n + self.page_size - 1) // self.page_size
+        pages_per_seq = [PagedKVCacheManager.pages_needed(n, self.page_size)
                          for n in total]
         num_pages = self._num_pages or (sum(pages_per_seq) + 1)
         mgr = PagedKVCacheManager(
@@ -313,7 +313,8 @@ class ContinuousBatchingEngine:
                  generation_config: Optional[GenerationConfig] = None,
                  num_slots: int = 8, page_size: int = 16,
                  max_seq_len: int = 2048, num_pages: Optional[int] = None,
-                 chunk: int = 16):
+                 chunk: int = 16, prefix_cache: bool = False,
+                 check_invariants: bool = True):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -323,13 +324,28 @@ class ContinuousBatchingEngine:
         self.page_size = page_size
         self.chunk = chunk
         self.max_seq_len = max_seq_len
-        self._table_width = (max_seq_len + page_size - 1) // page_size
+        self._table_width = PagedKVCacheManager.pages_needed(max_seq_len,
+                                                             page_size)
         # pool sized for every slot at max length unless told otherwise
         pool = num_pages or (num_slots * self._table_width + 1)
         mcfg = model_config
-        self.mgr = PagedKVCacheManager(
-            mcfg.num_hidden_layers, pool, page_size,
-            mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+        if prefix_cache:
+            # shared-ownership pool + radix prefix index: retired prompts
+            # stay resident and later requests prefill only their suffix
+            from ..kvcache import PrefixCache, RefcountedKVCacheManager
+            self.mgr = RefcountedKVCacheManager(
+                mcfg.num_hidden_layers, pool, page_size,
+                mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+            self.cache: Optional["PrefixCache"] = PrefixCache(self.mgr)
+        else:
+            self.mgr = PagedKVCacheManager(
+                mcfg.num_hidden_layers, pool, page_size,
+                mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
+            self.cache = None
+        # the conservation audit is O(pool) host work per step; on by
+        # default (it anchors the shared-ownership model) but opt-out for
+        # latency-critical deployments with very large pools
+        self._check_invariants = check_invariants and prefix_cache
         # host slot state
         self._slot_rid = [None] * num_slots       # rid occupying each slot
         self._queue: list = []                    # pending _Request
@@ -342,8 +358,12 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros((num_slots,), np.int32)
         self._bt = np.zeros((num_slots, self._table_width), np.int32)
         self._rng = jax.random.key(self.config.seed)
-        self._compiled_prefill: Dict[Tuple[int, int], Callable] = {}
+        self._compiled_prefill: Dict[Tuple, Callable] = {}
         self._decode_chunk = None
+        #: prompt tokens actually run through prefill (cache hits skip
+        #: their cached prefix; benchmarks diff this against submitted
+        #: prompt lengths for the skip ratio)
+        self._prefill_tokens = 0
         # serving-layer hooks (paddle_tpu.serving): both default to None so
         # the plain submit/step/collect/serve surface is byte-identical.
         # token_callback(rid, token) fires for every KEPT token as step()
@@ -368,6 +388,28 @@ class ContinuousBatchingEngine:
             return tok, k_pages, v_pages
 
         return jax.jit(run, donate_argnums=(3, 4))
+
+    def _build_prefill_suffix(self, bucket: int):
+        """Prefill of the UNCACHED SUFFIX only (prefix-cache hits): the
+        rows' leading ``start`` tokens are already resident in shared
+        pages, so the model runs over the suffix at offset positions and
+        attends through the page gather (models.llama.prefill_paged_suffix).
+        Cold rows (start 0) riding in the same batch are exact full
+        prefills."""
+        L = self._L
+        mcfg = self.model_config
+        cfg = self.config
+
+        def run(params, ids, seq_len, start, k_pages, v_pages, bt, key):
+            logits, k_pages, v_pages = L.prefill_paged_suffix(
+                params, ids, seq_len, start, k_pages, v_pages, bt, mcfg)
+            last = jnp.take_along_axis(
+                logits, (seq_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            tok = _sample(last, key, cfg)
+            return tok, k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(4, 5))
 
     def _build_decode_chunk(self):
         L = self._L
@@ -452,31 +494,75 @@ class ContinuousBatchingEngine:
         mixed-workload serve wall time at 16 slots — batch-1 prefills
         leave the MXU almost idle."""
         cfg = self.config
-        picked = []                      # (slot, req, pages_row, lp)
+        picked = []                # (slot, req, pages_row, lp, n_cached)
         for s in range(self.num_slots):
             if self._slot_rid[s] is not None or not self._queue:
                 continue
             req = self._queue[0]
             lp = len(req.prompt)
             total = lp + self._budget(req)       # submit() bounds this
-            if not self.mgr.can_allocate(total):
+            shared: list = []
+            n_cached = 0
+            cow_src = None
+            if self.cache is not None:
+                shared, n_cached, cow_src = self.cache.lookup(req.prompt)
+            need_fresh = self.mgr.pages_for(total) - len(shared)
+            if self.mgr.num_free_pages < need_fresh and self.cache is not None:
+                # reclaim cold cached pages before deferring admission;
+                # protect the pages THIS lookup is about to share (their
+                # refcounts rise only at allocate)
+                self.cache.evict(need_fresh - self.mgr.num_free_pages,
+                                 protect=shared + [cow_src])
+                if (self.mgr.num_free_pages < need_fresh
+                        and cow_src is not None):
+                    # still short: give up the COW page (one more
+                    # evictable) and recompute its block instead
+                    cow_src, n_cached = None, len(shared) * self.page_size
+                    self.cache.evict(
+                        need_fresh - self.mgr.num_free_pages,
+                        protect=shared)
+            if self.mgr.num_free_pages < need_fresh:
                 if not self._live and not picked:
-                    raise MemoryError(
-                        f"request {req.rid} needs "
-                        f"{self.mgr._pages_for(total)} pages but the pool "
-                        f"has {self.mgr.num_free_pages} free and no live "
-                        "sequence will release any; enlarge num_pages")
+                    # infeasibility is judged against WHOLE-pool capacity:
+                    # with nothing live and nothing evictable left, a
+                    # request within capacity admits (free == usable -
+                    # shared); beyond capacity nothing ever will
+                    if self.mgr.pages_for(total) > self.mgr.usable_pages:
+                        raise MemoryError(
+                            f"request {req.rid} needs "
+                            f"{self.mgr.pages_for(total)} pages but the "
+                            f"pool only holds {self.mgr.usable_pages}; "
+                            "enlarge num_pages")
                 break                    # pool full: wait for a completion
             self._queue.pop(0)
-            pages = self.mgr.allocate(req.rid, total)
+            if self.cache is not None:
+                pages = self.mgr.allocate(req.rid, total, shared=shared)
+                if cow_src is not None:
+                    # the suffix's first write lands mid-page: append into
+                    # a private device-side copy, never the shared page
+                    self.mgr.copy_page(cow_src, pages[len(shared)])
+                self.cache.record(req.rid, lp, n_cached, len(shared),
+                                  cow=cow_src is not None,
+                                  trace_id=req.trace_id)
+            else:
+                pages = self.mgr.allocate(req.rid, total)
             self.mgr._lens[req.rid] = lp
-            picked.append((s, req, pages, lp))
+            picked.append((s, req, pages, lp, n_cached))
         if not picked:
             return
-        groups: Dict[int, list] = {}
+        # group by (SUFFIX bucket, warm): cold rows NEVER share a group
+        # with warm rows, so they always run the original full-prefill
+        # program and cache-enabled cold traffic stays byte-identical
+        # with the cache-disabled engine (the suffix program is a
+        # numerically different attention — fine for warm rows, whose
+        # reuse is cross-program by construction, but not imposed on
+        # cold ones). Without the cache every row is cold and grouping /
+        # compile keys match the pre-cache engine exactly.
+        groups: Dict[Tuple, list] = {}
         for item in picked:
-            groups.setdefault(_bucket(item[3]), []).append(item)
-        for bucket, items in groups.items():
+            groups.setdefault((_bucket(item[3] - item[4]), item[4] > 0),
+                              []).append(item)
+        for (bucket, warm), items in groups.items():
             real = len(items)
             b_pad = 1
             while b_pad < real:
@@ -488,36 +574,48 @@ class ContinuousBatchingEngine:
             ids = np.full((b_pad, bucket), cfg.pad_token_id, np.int32)
             rows = np.zeros((b_pad, self._table_width), np.int32)
             lens = np.ones((b_pad,), np.int32)   # pad rows: 1 garbage tok
-            for i, (s, req, pages, lp) in enumerate(items):
-                ids[i, :lp] = req.prompt
+            starts = np.zeros((b_pad,), np.int32)
+            for i, (s, req, pages, lp, nc) in enumerate(items):
+                ids[i, :lp - nc] = req.prompt[nc:]
                 rows[i, :len(pages)] = pages
-                lens[i] = lp
-            key = (bucket, b_pad)
+                lens[i] = lp - nc
+                starts[i] = nc
+            key = ("sfx", bucket, b_pad) if warm else (bucket, b_pad)
             if key not in self._compiled_prefill:
                 recompiles.record_miss("cbe.prefill", key)
-                self._compiled_prefill[key] = self._build_prefill(bucket)
+                self._compiled_prefill[key] = (
+                    self._build_prefill_suffix(bucket) if warm
+                    else self._build_prefill(bucket))
             self._rng, sub = jax.random.split(self._rng)
             t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
-            tok, self.mgr.k_pages, self.mgr.v_pages = \
-                self._compiled_prefill[key](
-                    params, jnp.asarray(ids), jnp.asarray(lens),
-                    self.mgr.k_pages, self.mgr.v_pages, jnp.asarray(rows),
-                    sub)
+            if warm:
+                tok, self.mgr.k_pages, self.mgr.v_pages = \
+                    self._compiled_prefill[key](
+                        params, jnp.asarray(ids), jnp.asarray(lens),
+                        jnp.asarray(starts), self.mgr.k_pages,
+                        self.mgr.v_pages, jnp.asarray(rows), sub)
+            else:
+                tok, self.mgr.k_pages, self.mgr.v_pages = \
+                    self._compiled_prefill[key](
+                        params, jnp.asarray(ids), jnp.asarray(lens),
+                        self.mgr.k_pages, self.mgr.v_pages,
+                        jnp.asarray(rows), sub)
+            self._prefill_tokens += int(sum(it[3] - it[4] for it in items))
             if t0_ns:
                 # one batched prefill serves several requests: emit one
                 # span per admitted request so each trace-id lane shows
                 # its own prefill segment
                 t1_ns = time.perf_counter_ns()
-                for s, req, pages, lp in items:
+                for s, req, pages, lp, nc in items:
                     emit_span("engine.prefill", t0_ns, t1_ns,
                               event_type="Operator", trace_id=req.trace_id,
                               args={"request_id": req.rid, "bucket": bucket,
-                                    "prompt_len": lp})
+                                    "prompt_len": lp, "cached_tokens": nc})
             # NO host readback: prefill tokens are written into the slots
             # lazily and reach the host with the next chunk's emissions
             slot_idx = jnp.asarray([s for s, *_ in items], jnp.int32)
             self._tok_dev = self._tok_dev.at[slot_idx].set(tok[:real])
-            for i, (s, req, pages, lp) in enumerate(items):
+            for i, (s, req, pages, lp, nc) in enumerate(items):
                 self._slot_rid[s] = req.rid
                 self._live[req.rid] = req
                 self._pos[s] = lp
@@ -541,6 +639,15 @@ class ContinuousBatchingEngine:
         if not cancelled:
             out = req.tokens[:self._budget(req)]
             self._finished[rid] = out
+            if self.cache is not None:
+                # index the finished prefix BEFORE release: pages backing
+                # its full token blocks stay resident (refcount 0, cached)
+                # instead of draining to the free list. Positions past the
+                # kept output may hold over-decoded garbage, but those
+                # never complete a block (full blocks end <= kept length).
+                self.cache.insert(
+                    [int(t) for t in req.prompt] + [int(t) for t in out],
+                    self.mgr._tables[rid])
             if self.finish_callback is not None:
                 self.finish_callback(rid, out)
         self.mgr.free(rid)
@@ -553,6 +660,8 @@ class ContinuousBatchingEngine:
         chunk's emitted tokens). Returns the live count after the round."""
         self._admit(params)
         if not self._live:
+            if self._check_invariants:
+                self.mgr.check_conservation()
             return 0
         if self._decode_chunk is None:
             recompiles.record_miss("cbe.decode_chunk",
@@ -597,6 +706,12 @@ class ContinuousBatchingEngine:
                 self._pos[s] += self.chunk
         # idle slots decode into the garbage page; their host positions
         # stay pinned at 0 so they never run past the rope cache
+        if self.cache is not None:
+            if self._check_invariants:
+                # the ownership-model anchor: every page is free, live
+                # (refcounted) or cached — checked after EVERY step
+                self.mgr.check_conservation()
+            self.cache.update_gauges()
         return len(self._live)
 
     def collect(self) -> Dict[int, list]:

@@ -972,6 +972,40 @@ def decode_step_stacked(params, tok, pos, cache, config: LlamaConfig):
 # ===========================================================================
 # Paged KV-cache path (ragged serving batches; ops/paged_attention.py)
 # ===========================================================================
+def _paged_prefill_layer(carry, lp_l, *, config, b, t, cos, sin, phys,
+                         page_off, pool_p, attn_fn, scatter_first):
+    """One transformer layer of a paged prefill — the single body shared
+    by the full path (:func:`prefill_paged`) and the prefix-cache suffix
+    path (:func:`prefill_paged_suffix`). The two differ ONLY in attention
+    (in-prompt causal vs page-gather at offset positions — ``attn_fn``)
+    and in whether the K/V scatter must precede it (the suffix attends
+    THROUGH the pool, so its keys must land there first)."""
+    xc, kp, vp = carry
+    lp, l = lp_l
+    d = config.head_dim
+    xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+    q = _mm_prefill(xn, lp["wq"]).reshape(b, t, -1, d)
+    k = _mm_prefill(xn, lp["wk"]).reshape(b, t, -1, d)
+    v = _mm_prefill(xn, lp["wv"]).reshape(b, t, -1, d)
+    q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    if scatter_first:
+        kp = kp.at[phys + l * pool_p, page_off].set(k.astype(kp.dtype))
+        vp = vp.at[phys + l * pool_p, page_off].set(v.astype(vp.dtype))
+    attn = attn_fn(q, k, v, kp, vp, l)
+    xo = xc + _mm_prefill(attn.reshape(b, t, -1), lp["wo"]).astype(xc.dtype)
+    xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+    g = _mm_prefill(xn2, lp["w_gate"])
+    u = _mm_prefill(xn2, lp["w_up"])
+    xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u,
+                         _dense(lp["w_down"]))
+    if not scatter_first:
+        # scatter this layer's K/V into its slab of the flat pool
+        kp = kp.at[phys + l * pool_p, page_off].set(k.astype(kp.dtype))
+        vp = vp.at[phys + l * pool_p, page_off].set(v.astype(vp.dtype))
+    # int8-quantized weights dequantize to f32; keep the carry dtype
+    return (xo.astype(xc.dtype), kp, vp), None
+
+
 def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
                   config: LlamaConfig):
     """Prefill a ragged batch into paged KV.
@@ -1004,28 +1038,76 @@ def prefill_paged(params, ids, seq_lens, k_pages, v_pages, block_tables,
     kp_flat = k_pages.reshape((n_layers * pool_p,) + k_pages.shape[2:])
     vp_flat = v_pages.reshape((n_layers * pool_p,) + v_pages.shape[2:])
 
-    def body(carry, lp_l):
-        xc, kp, vp = carry
-        lp, l = lp_l
-        d = config.head_dim
-        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
-        q = _mm_prefill(xn, lp["wq"]).reshape(b, t, -1, d)
-        k = _mm_prefill(xn, lp["wk"]).reshape(b, t, -1, d)
-        v = _mm_prefill(xn, lp["wv"]).reshape(b, t, -1, d)
-        q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+    body = functools.partial(
+        _paged_prefill_layer, config=config, b=b, t=t, cos=cos, sin=sin,
+        phys=phys, page_off=page_off, pool_p=pool_p,
         # causal attention within the (padded) prompt
-        attn = fa._sdpa_array(q, k, v, scale=1.0 / math.sqrt(d), causal=True)
-        xo = xc + _mm_prefill(attn.reshape(b, t, -1), lp["wo"]).astype(xc.dtype)
-        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
-        g = _mm_prefill(xn2, lp["w_gate"])
-        u = _mm_prefill(xn2, lp["w_up"])
-        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u, _dense(lp["w_down"]))
-        # scatter this layer's K/V into its slab of the flat pool
-        kp = kp.at[phys + l * pool_p, page_off].set(k.astype(kp.dtype))
-        vp = vp.at[phys + l * pool_p, page_off].set(v.astype(vp.dtype))
-        # int8-quantized weights dequantize to f32; keep the carry dtype
-        return (xo.astype(xc.dtype), kp, vp), None
+        attn_fn=lambda q, k, v, kp, vp, l: fa._sdpa_array(
+            q, k, v, scale=1.0 / math.sqrt(config.head_dim), causal=True),
+        scatter_first=False)
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    (x, kp_flat, vp_flat), _ = lax.scan(
+        body, (x, kp_flat, vp_flat),
+        (layer_params, jnp.arange(n_layers)))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    logits = jnp.einsum("bth,hv->btv", x, _dense(params["lm_head"]))
+    return (logits, kp_flat.reshape(k_pages.shape),
+            vp_flat.reshape(v_pages.shape))
 
+
+def prefill_paged_suffix(params, ids, seq_lens, start_pos, k_pages, v_pages,
+                         block_tables, config: LlamaConfig):
+    """Prefill only the UNCACHED SUFFIX of a ragged batch into paged KV.
+
+    The prefix-cache path (paddle_tpu.kvcache): each row's leading
+    ``start_pos[b]`` tokens are already resident in shared pages reachable
+    through ``block_tables``, so only the suffix runs through the model.
+    Suffix queries sit at absolute positions ``start_pos + t`` — rope is
+    taken at those positions and attention runs over the gathered page
+    span (cached prefix + just-scattered suffix) with the
+    ``key_pos <= query_pos`` mask (ops.paged_attention.
+    paged_prefill_attention_array), not the in-prompt causal mask.
+
+    ids: (B, T) right-padded suffix tokens; seq_lens: (B,) true suffix
+    lengths; start_pos: (B,) cached-prefix lengths (0 = cold row);
+    k_pages/v_pages: (L, P, page, nkv, d); block_tables: (B, max_pages).
+    Returns (logits (B, T, V), k_pages', v_pages').
+    """
+    from ..ops import paged_attention as pa
+    b, t = ids.shape
+    page = k_pages.shape[2]
+    s_max = block_tables.shape[1] * page
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+
+    tpos = jnp.arange(t)
+    start_pos = start_pos.astype(jnp.int32)
+    # clamp: a padded suffix bucket may poke past the table span; those
+    # slots are invalid (masked below) but the gathers must stay in range
+    abs_pos = jnp.minimum(start_pos[:, None] + tpos[None, :], s_max - 1)
+    cos = jnp.take(cos_full, abs_pos, axis=0)             # (B, T, d)
+    sin = jnp.take(sin_full, abs_pos, axis=0)
+    page_idx = abs_pos // page                            # (B, T)
+    page_off = abs_pos % page
+    phys = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    valid = tpos[None, :] < seq_lens[:, None]
+    phys = jnp.where(valid, phys, 0)                      # pads -> page 0
+
+    # flat-pool carry with per-layer page offsets — see prefill_paged
+    n_layers, pool_p = k_pages.shape[0], k_pages.shape[1]
+    kp_flat = k_pages.reshape((n_layers * pool_p,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((n_layers * pool_p,) + v_pages.shape[2:])
+
+    body = functools.partial(
+        _paged_prefill_layer, config=config, b=b, t=t, cos=cos, sin=sin,
+        phys=phys, page_off=page_off, pool_p=pool_p,
+        # scatter the suffix K/V FIRST (scatter_first) so attention sees
+        # cached prefix + suffix through one page gather
+        attn_fn=lambda q, k, v, kp, vp, l: pa.paged_prefill_attention_array(
+            q, kp, vp, block_tables + l * pool_p, start_pos,
+            scale=1.0 / math.sqrt(config.head_dim)),
+        scatter_first=True)
     layer_params = {k: params[k] for k in LAYER_KEYS}
     (x, kp_flat, vp_flat), _ = lax.scan(
         body, (x, kp_flat, vp_flat),

@@ -97,6 +97,57 @@ def paged_write_array(k_pages, v_pages, k_new, v_new, block_tables, positions):
     return k_pages, v_pages
 
 
+def paged_prefill_attention_array(q, k_pages, v_pages, block_tables, q_start,
+                                  scale: Optional[float] = None):
+    """Chunked/suffix prefill attention over paged KV.
+
+    The prefix-cache path (paddle_tpu.kvcache): a request whose leading
+    tokens are already resident in shared pages prefills only its suffix.
+    The suffix queries sit at absolute positions ``q_start + t`` and must
+    attend to BOTH the cached prefix pages and the suffix's own (already
+    scattered) K/V — so unlike the in-prompt causal mask of the full
+    prefill, the mask here is ``key_pos <= q_start + t`` over the gathered
+    page span.
+
+    q:            (B, T, nh, d)  — suffix queries (right-padded)
+    k_pages:      (P, page, nkv, d) — page pool (suffix K/V already written)
+    v_pages:      (P, page, nkv, d)
+    block_tables: (B, max_pages) int32 (pad: 0, the reserved garbage page)
+    q_start:      (B,) int32 — absolute position of each row's first query
+    Returns (B, T, nh, d).
+    """
+    b, t, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    rep = nh // nkv
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    k = jnp.take(k_pages, block_tables, axis=0)     # (B, max_pages, page, ..)
+    v = jnp.take(v_pages, block_tables, axis=0)
+    k = k.reshape(b, max_pages * page, nkv, d)
+    v = v.reshape(b, max_pages * page, nkv, d)
+
+    q_pos = q_start[:, None] + jnp.arange(t)[None, :]          # (B, T)
+    mask = (jnp.arange(max_pages * page)[None, None, :]
+            <= q_pos[:, :, None])                              # (B, T, S)
+    if rep > 1:
+        # grouped attention without materializing repeated KV (same
+        # bandwidth argument as paged_attention_array)
+        qg = q.reshape(b, t, nkv, rep, d)
+        scores = jnp.einsum("btgrd,bsgd->bgrts", qg.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bgrts,bsgd->btgrd", probs.astype(v.dtype), v)
+        return out.reshape(b, t, nh, d)
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    scores = jnp.where(mask[:, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+
+
 # ---------------------------------------------------------------------------
 # Host-side page pool (the allocator metadata; device arrays hold the data)
 # ---------------------------------------------------------------------------
@@ -126,14 +177,29 @@ class PagedKVCacheManager:
     # -- allocation ---------------------------------------------------------
 
     def can_allocate(self, n_tokens: int) -> bool:
-        return len(self._free) >= self._pages_for(n_tokens)
+        return len(self._free) >= self.pages_for(n_tokens)
 
-    def _pages_for(self, n_tokens: int) -> int:
-        return (n_tokens + self.page_size - 1) // self.page_size
+    @staticmethod
+    def pages_needed(n_tokens: int, page_size: int) -> int:
+        """Pages covering ``n_tokens`` at ``page_size`` granularity — THE
+        page-math helper; every layer (scheduler, engines, kvcache)
+        delegates here instead of re-deriving the ceil-div."""
+        return (n_tokens + page_size - 1) // page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        return self.pages_needed(n_tokens, self.page_size)
+
+    # deprecated alias (pre-kvcache spelling); new code uses pages_for()
+    _pages_for = pages_for
+
+    @property
+    def usable_pages(self) -> int:
+        """Allocatable pool capacity (page 0 is the reserved pad page)."""
+        return self.num_pages - 1
 
     def allocate(self, seq_id, n_tokens: int) -> List[int]:
         """Reserve pages for a new sequence of n_tokens (prefill)."""
-        need = self._pages_for(n_tokens)
+        need = self.pages_for(n_tokens)
         if len(self._free) < need:
             raise MemoryError(
                 f"KV pool exhausted: need {need} pages, "
@@ -148,7 +214,7 @@ class PagedKVCacheManager:
         cur = self._lens[seq_id]
         new_len = cur + n_new
         have = len(self._tables[seq_id])
-        need = self._pages_for(new_len)
+        need = self.pages_for(new_len)
         for _ in range(need - have):
             if not self._free:
                 raise MemoryError("KV pool exhausted on extend")

@@ -200,11 +200,11 @@ class ServingScheduler:
                 f"engine's max_seq_len={self.engine.max_seq_len}; raise "
                 "max_seq_len or truncate the prompt")
         mgr = self.engine.mgr
-        if mgr._pages_for(total) > mgr.num_pages - 1:   # page 0 reserved
+        if mgr.pages_for(total) > mgr.usable_pages:
             raise ValueError(
                 f"request of {total} total tokens needs "
-                f"{mgr._pages_for(total)} KV pages but the engine pool "
-                f"only holds {mgr.num_pages - 1}; enlarge num_pages or "
+                f"{mgr.pages_for(total)} KV pages but the engine pool "
+                f"only holds {mgr.usable_pages}; enlarge num_pages or "
                 "shrink the request")
         now = self._clock()
         rid = self._next_rid
@@ -358,12 +358,31 @@ class ServingScheduler:
         now = self._clock()
         headroom = self.engine.num_free_slots - len(self.engine._queue)
         free_pages = self.engine.mgr.num_free_pages
+        cache = getattr(self.engine, "cache", None)
+        protect: List[int] = []     # pages THIS step's admissions rely on
         while headroom > 0 and self._queue:
             req = self._queue[0]
-            need = self.engine.mgr._pages_for(
+            need = self.engine.mgr.pages_for(
                 len(req.prompt) + self._engine_budget(req.max_new_tokens))
+            reusing: List[int] = []
+            if cache is not None:
+                # charge only the UNCACHED SUFFIX: pages the prefix cache
+                # will lend come for free (peek: no LRU/stat distortion);
+                # the COW source isn't charged for but must survive too
+                shareable, _cached_tokens, cow_src = cache.peek(req.prompt)
+                need -= len(shareable)
+                reusing = shareable + ([cow_src] if cow_src is not None
+                                       else [])
+                if need > free_pages:
+                    # reclaim cold cached pages before deferring — but
+                    # never pages an admission already charged against
+                    # this step (their refcounts rise only when the
+                    # engine allocates), nor this request's own match
+                    free_pages += cache.evict(need - free_pages,
+                                              protect=protect + reusing)
             if need > free_pages:
                 break               # wait for a completion to free pages
+            protect.extend(reusing)
             self._queue.pop(0)
             self._order.pop(0)
             req.engine_rid = self.engine.submit(
@@ -517,6 +536,15 @@ class ServingScheduler:
         m.set_gauge("slot_utilization",
                     (slots - self.engine.num_free_slots) / slots)
         mgr = self.engine.mgr
-        usable = mgr.num_pages - 1          # page 0 is reserved
+        usable = mgr.usable_pages
         m.set_gauge("page_utilization",
                     1.0 - mgr.num_free_pages / usable if usable else 0.0)
+        cache = getattr(self.engine, "cache", None)
+        if cache is not None:
+            # cached-vs-live split: how much of the occupied pool is
+            # reusable cache vs pinned by in-flight sequences
+            m.set_gauge("live_page_utilization",
+                        mgr.num_live_pages / usable if usable else 0.0)
+            m.set_gauge("cached_page_utilization",
+                        mgr.num_cached_pages / usable if usable else 0.0)
+            cache.update_gauges()

@@ -1,0 +1,416 @@
+"""Prefix cache for the paged KV pool (paddle_tpu.kvcache): radix index,
+refcounted shared ownership, copy-on-write, LRU eviction — and the e2e
+acceptance bar: byte-identical generation with the cache enabled vs
+disabled, >=50% of prefill tokens skipped on warm shared-prefix traffic,
+and the page conservation invariant holding after every engine step."""
+
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paddle_tpu.kvcache import (LRUEvictionPolicy, PrefixCache,
+                                RefcountedKVCacheManager, RadixTree)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _mgr(num_pages=12, page_size=4):
+    # tiny device arrays: 1 layer, 1 kv head, dim 2 — metadata is the test
+    return RefcountedKVCacheManager(1, num_pages, page_size, 1, 2)
+
+
+def _toks(*blocks):
+    out = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# radix tree
+# ---------------------------------------------------------------------------
+
+def test_radix_match_full_blocks_only():
+    t = RadixTree(page_size=4)
+    t.insert([1, 2, 3, 4, 5, 6, 7, 8], [10, 11])
+    assert [n.page for n in t.match([1, 2, 3, 4, 5, 6, 7, 8, 9])] == [10, 11]
+    # divergence after one block
+    assert [n.page for n in t.match([1, 2, 3, 4, 9, 9, 9, 9])] == [10]
+    # partial block never matches
+    assert t.match([1, 2, 3]) == []
+    assert t.match([2, 2, 3, 4]) == []
+
+
+def test_radix_insert_reports_duplicates_not_adoption():
+    t = RadixTree(page_size=2)
+    adopted, dup = t.insert([1, 2, 3, 4], [5, 6])
+    assert (adopted, dup) == ([5, 6], [])
+    # same blocks under different pages: nothing adopted, dups reported
+    adopted, dup = t.insert([1, 2, 3, 4, 9, 9], [7, 8, 9])
+    assert adopted == [9] and dup == [7, 8]
+    assert len(t) == 3
+
+
+def test_radix_remove_leaf_only():
+    t = RadixTree(page_size=2)
+    t.insert([1, 2, 3, 4], [5, 6])
+    inner = t.match([1, 2])[0]
+    with pytest.raises(ValueError):
+        t.remove(inner)
+    leaf = t.match([1, 2, 3, 4])[-1]
+    t.remove(leaf)
+    assert t.match([1, 2, 3, 4]) == [inner]
+    t.remove(inner)          # now a leaf
+    assert len(t) == 0
+
+
+# ---------------------------------------------------------------------------
+# refcounted pool
+# ---------------------------------------------------------------------------
+
+def test_shared_allocation_refcounts_and_release():
+    mgr = _mgr(num_pages=8, page_size=4)
+    a = mgr.allocate("a", 8)                       # 2 owned pages
+    b = mgr.allocate("b", 12, shared=a)            # shares both + 1 fresh
+    assert b[:2] == a and len(b) == 3
+    assert mgr.refcount(a[0]) == 2 and mgr.refcount(b[2]) == 1
+    mgr.free("a")
+    assert mgr.refcount(a[0]) == 1                 # b still holds them
+    mgr.check_conservation()
+    mgr.free("b")
+    assert mgr.num_free_pages == mgr.usable_pages  # nothing cached
+    mgr.check_conservation()
+
+
+def test_cached_pages_survive_release_and_evict_to_free():
+    mgr = _mgr(num_pages=6, page_size=4)
+    pages = mgr.allocate("a", 8)
+    for p in pages:
+        mgr.adopt_cached(p)
+    mgr.free("a")
+    assert mgr.num_free_pages == mgr.usable_pages - 2
+    assert mgr.num_cached_pages == 2
+    mgr.check_conservation()
+    mgr.evict_cached(pages[0])
+    assert mgr.num_free_pages == mgr.usable_pages - 1
+    mgr.check_conservation()
+
+
+def test_conservation_detects_violations():
+    mgr = _mgr()
+    mgr.allocate("a", 4)
+    mgr._free.append(mgr._tables["a"][0])          # free a live page
+    with pytest.raises(RuntimeError, match="overlap"):
+        mgr.check_conservation()
+    mgr = _mgr()
+    mgr.allocate("a", 4)
+    mgr._tables.pop("a")                           # leak: refs != tables
+    with pytest.raises(RuntimeError, match="diverge"):
+        mgr.check_conservation()
+
+
+def test_copy_page_copies_device_content():
+    import jax.numpy as jnp
+    mgr = _mgr(num_pages=6, page_size=4)
+    src, dst = 1, 2
+    mgr.k_pages = mgr.k_pages.at[:, src].set(7.0)
+    mgr.v_pages = mgr.v_pages.at[:, src].set(3.0)
+    mgr.copy_page(src, dst)
+    assert float(jnp.abs(mgr.k_pages[:, dst] - 7.0).max()) == 0.0
+    assert float(jnp.abs(mgr.v_pages[:, dst] - 3.0).max()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# prefix cache orchestration
+# ---------------------------------------------------------------------------
+
+def test_lookup_caps_full_prompt_match_with_cow():
+    mgr = _mgr(num_pages=12, page_size=4)
+    cache = PrefixCache(mgr)
+    prompt = list(range(1, 9))                     # exactly 2 blocks
+    table = mgr.allocate(0, 8)
+    cache.insert(prompt, table)
+    mgr.free(0)
+    shared, n_cached, cow = cache.lookup(prompt)
+    # full match: last page goes copy-on-write, one token recomputed
+    assert shared == table[:1] and n_cached == 7 and cow == table[1]
+    # longer prompt with the same prefix: plain 2-page share, no COW
+    shared, n_cached, cow = cache.lookup(prompt + [77])
+    assert shared == table and n_cached == 8 and cow is None
+
+
+def test_lru_eviction_prefers_coldest_leaf():
+    mgr = _mgr(num_pages=12, page_size=4)
+    cache = PrefixCache(mgr)
+    pa = _toks(range(4), range(4))                 # prefix A: 2 blocks
+    pb = _toks(range(10, 14), range(20, 24))       # prefix B: 2 blocks
+    ta = mgr.allocate("a", 8)
+    cache.insert(pa, ta)
+    mgr.free("a")
+    tb = mgr.allocate("b", 8)
+    cache.insert(pb, tb)
+    mgr.free("b")
+    cache.lookup(pa + [9])                         # touch A: B is now LRU
+    assert cache.evict(1) == 1
+    # B's leaf died; A fully resident
+    assert len(cache.tree.match(pb, touch=False)) == 1
+    assert len(cache.tree.match(pa, touch=False)) == 2
+    mgr.check_conservation()
+
+
+def test_evict_respects_protect_and_pinned_pages():
+    mgr = _mgr(num_pages=12, page_size=4)
+    cache = PrefixCache(mgr)
+    prompt = _toks(range(4), range(4))
+    table = mgr.allocate("a", 8)
+    cache.insert(prompt, table)
+    mgr.free("a")
+    # protected pages never die, so only the unprotected leaf can go
+    assert cache.evict(5, protect=table) == 0
+    # pin via a live sharer: nothing evictable at all
+    mgr.allocate("b", 8, shared=table)
+    assert cache.evict(5) == 0
+    mgr.free("b")
+    assert cache.evict(5) == 2
+    assert mgr.num_free_pages == mgr.usable_pages
+    mgr.check_conservation()
+
+
+# ---------------------------------------------------------------------------
+# satellite: randomized interleaving property test
+# ---------------------------------------------------------------------------
+
+def test_pool_invariants_random_interleavings():
+    """submit/extend/cancel/retire/evict in random order: conservation
+    holds after every op, refcounts never negative (check_conservation
+    cross-checks refs against block-table occupancy, so a page in two
+    tables with a dead refcount cannot hide)."""
+    for seed in (0, 1, 2):
+        rng = np.random.RandomState(seed)
+        mgr = _mgr(num_pages=16, page_size=2)
+        cache = PrefixCache(mgr)
+        live = {}
+        next_sid = 0
+        for _ in range(300):
+            op = rng.choice(["submit", "extend", "retire", "cancel",
+                             "evict"], p=[0.4, 0.15, 0.2, 0.1, 0.15])
+            if op == "submit":
+                lp = int(rng.randint(1, 9))
+                prompt = [int(t) for t in rng.randint(0, 3, lp)]
+                budget = int(rng.randint(1, 5))
+                total = lp + budget
+                if mgr.pages_for(total) > mgr.usable_pages:
+                    continue
+                shared, n_cached, cow = cache.lookup(prompt)
+                need = mgr.pages_for(total) - len(shared)
+                if mgr.num_free_pages < need:
+                    cache.evict(need - mgr.num_free_pages,
+                                protect=shared + [cow])
+                if mgr.num_free_pages < need and cow is not None:
+                    cow, n_cached = None, len(shared) * mgr.page_size
+                    cache.evict(need - mgr.num_free_pages, protect=shared)
+                if mgr.num_free_pages < need:
+                    continue                     # engine would defer
+                table = mgr.allocate(next_sid, total, shared=shared)
+                if cow is not None:
+                    mgr.copy_page(cow, table[len(shared)])
+                live[next_sid] = {"prompt": prompt, "gen": [],
+                                  "budget": budget}
+                next_sid += 1
+            elif op == "extend" and live:
+                sid = int(rng.choice(list(live)))
+                try:
+                    mgr.extend(sid, 1)
+                except MemoryError:
+                    cache.evict(1)
+                    try:
+                        mgr.extend(sid, 1)
+                    except MemoryError:
+                        continue             # genuinely full: defer
+                live[sid]["gen"].append(int(rng.randint(0, 3)))
+            elif op == "retire" and live:
+                sid = int(rng.choice(list(live)))
+                st = live.pop(sid)
+                cache.insert(st["prompt"] + st["gen"], mgr._tables[sid])
+                mgr.free(sid)
+            elif op == "cancel" and live:
+                sid = int(rng.choice(list(live)))
+                live.pop(sid)
+                mgr.free(sid)                    # cancelled: no insert
+            elif op == "evict":
+                cache.evict(int(rng.randint(1, 4)))
+            mgr.check_conservation()
+        for sid in list(live):
+            mgr.free(sid)
+        mgr.check_conservation()
+        # everything unreferenced: full eviction must drain to all-free
+        cache.evict(mgr.usable_pages)
+        assert mgr.num_free_pages == mgr.usable_pages
+
+
+# ---------------------------------------------------------------------------
+# engine integration (e2e acceptance)
+# ---------------------------------------------------------------------------
+
+def _setup_engine(prefix_cache, max_new=6, num_slots=2, num_pages=None,
+                  seed=3):
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=seed)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new),
+        num_slots=num_slots, page_size=4, max_seq_len=32, chunk=3,
+        num_pages=num_pages, prefix_cache=prefix_cache)
+    return cfg, params, eng
+
+
+def _shared_prefix_prompts(cfg, n=4, sys_len=12, seed=0):
+    rng = np.random.RandomState(seed)
+    sys_p = rng.randint(1, cfg.vocab_size, (sys_len,)).astype(np.int32)
+    return [np.concatenate([sys_p,
+                            rng.randint(1, cfg.vocab_size,
+                                        (int(rng.randint(2, 8)),)
+                                        ).astype(np.int32)])
+            for _ in range(n)]
+
+
+def test_generation_byte_identical_cache_on_vs_off():
+    """THE acceptance bar: same prompts, same seed — the cache-enabled
+    engine (cold AND warm waves, COW included) produces exactly the
+    token lists of the cache-disabled engine."""
+    cfg, params, eng_off = _setup_engine(prefix_cache=False)
+    _, _, eng_on = _setup_engine(prefix_cache=True)
+    prompts = _shared_prefix_prompts(cfg)
+    # one prompt of exactly 4 pages forces the full-match COW path on
+    # its second wave
+    prompts.append(prompts[0][:16])
+    assert len(prompts[-1]) == 16
+    for wave in range(2):
+        expect = eng_off.serve(params, prompts)
+        got = eng_on.serve(params, prompts)
+        assert got == expect, f"wave {wave} diverged"
+    st = eng_on.cache.snapshot()
+    assert st["hits"] > 0 and st["cow_copies"] > 0
+    assert st["cached_tokens"] > 0
+    eng_on.mgr.check_conservation()
+
+
+def test_warm_wave_skips_half_the_prefill_tokens():
+    """Shared-system-prompt traffic: the warm wave computes < 50% of the
+    prefill tokens the cold wave did (>= 50% skipped)."""
+    cfg, params, eng = _setup_engine(prefix_cache=True)
+    prompts = _shared_prefix_prompts(cfg, sys_len=16)
+    eng.serve(params, prompts)
+    cold = eng._prefill_tokens
+    eng.serve(params, prompts)
+    warm = eng._prefill_tokens - cold
+    assert warm <= cold / 2, (cold, warm)
+    assert eng.cache.stats["hits"] >= len(prompts)
+
+
+def test_cache_disabled_engine_unchanged():
+    """prefix_cache=False keeps the plain manager: no cache attribute
+    consulted, no refcount bookkeeping."""
+    from paddle_tpu.ops.paged_attention import PagedKVCacheManager
+    _, _, eng = _setup_engine(prefix_cache=False)
+    assert eng.cache is None
+    assert type(eng.mgr) is PagedKVCacheManager
+
+
+def test_over_reject_uses_whole_pool_capacity():
+    """Satellite fix: a request bigger than the WHOLE pool raises; one
+    that merely exceeds the transient free count (pool full of cached
+    pages) evicts and admits instead of raising."""
+    cfg, params, eng = _setup_engine(prefix_cache=True, max_new=4,
+                                     num_slots=1, num_pages=7)
+    rng = np.random.RandomState(1)
+    # fill the cache: one request retires and leaves its pages cached
+    p0 = rng.randint(1, cfg.vocab_size, (12,)).astype(np.int32)
+    eng.serve(params, [p0])
+    assert eng.mgr.num_cached_pages > 0
+    free_before = eng.mgr.num_free_pages
+    # needs more than the free count but fits the pool: must evict, admit
+    p1 = rng.randint(1, cfg.vocab_size, (20,)).astype(np.int32)
+    assert eng.mgr.pages_for(len(p1) + 4) > free_before
+    out = eng.serve(params, [p1])
+    assert len(out[0]) == 4
+    assert eng.cache.stats["evictions"] > 0
+    # permanently infeasible: beyond usable_pages raises MemoryError
+    eng.submit(rng.randint(1, cfg.vocab_size, (28,)).astype(np.int32))
+    with pytest.raises(MemoryError, match="pool only holds"):
+        eng.step(params)
+
+
+def test_scheduler_charges_uncached_suffix_and_reports_gauges():
+    """ServingScheduler over a cache-enabled engine: warm requests admit
+    against suffix-only page budgets, and the cached/live gauge split is
+    sampled."""
+    from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+    cfg, params, eng = _setup_engine(prefix_cache=True)
+    prompts = _shared_prefix_prompts(cfg)
+    sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=32))
+    handles = [sched.submit(p) for p in prompts]    # cold wave
+    sched.run(params, max_steps=1000)
+    handles += [sched.submit(p) for p in prompts]   # warm wave
+    sched.run(params, max_steps=1000)
+    assert all(h.done for h in handles)
+    assert eng.cache.stats["hits"] >= len(prompts)
+    g = sched.metrics.gauges
+    assert "cached_page_utilization" in g and "live_page_utilization" in g
+    assert g["cached_page_utilization"] > 0.0      # retired prefixes resident
+    # registry carries the kvcache counters + page-state gauge split
+    from paddle_tpu.observability import get_registry
+    text = get_registry().prometheus_text()
+    assert re.search(r"paddle_kvcache_hits_total [1-9]", text)
+    assert 'paddle_kvcache_pages{state="cached"}' in text
+
+
+def test_cache_hit_and_evict_events_logged(tmp_path):
+    from paddle_tpu.observability.events import configure_event_log
+    import json
+    path = str(tmp_path / "events.jsonl")
+    configure_event_log(path)
+    try:
+        cfg, params, eng = _setup_engine(prefix_cache=True, num_slots=1,
+                                         num_pages=9, max_new=4)
+        rng = np.random.RandomState(2)
+        p = rng.randint(1, cfg.vocab_size, (10,)).astype(np.int32)
+        eng.serve(params, [p])
+        eng.serve(params, [p])                     # hit
+        big = rng.randint(1, cfg.vocab_size, (20,)).astype(np.int32)
+        eng.serve(params, [big])                   # pressure -> evict
+    finally:
+        configure_event_log(None)
+    kinds = [json.loads(l)["kind"] for l in open(path)]
+    assert "cache_hit" in kinds and "cache_evict" in kinds
+
+
+# ---------------------------------------------------------------------------
+# lint: pool internals stay behind the ops/kvcache boundary
+# ---------------------------------------------------------------------------
+
+def test_no_private_pool_access_outside_ops_and_kvcache():
+    """Forbid `._free` / `._pages_for` outside paddle_tpu/ops/ and
+    paddle_tpu/kvcache/: every other layer sizes requests via the public
+    ``pages_for()``/``usable_pages`` surface, and only the pool itself
+    touches the free list (the refcount/cached states make direct free-
+    list surgery unsound)."""
+    pattern = re.compile(r"\._pages_for\b|\._free\b")
+    offenders = []
+    for sub in ("paddle_tpu", "tests", "benchmarks"):
+        for path in sorted((REPO / sub).rglob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            if (rel.startswith("paddle_tpu/ops/")
+                    or rel.startswith("paddle_tpu/kvcache/")
+                    or path == Path(__file__).resolve()):
+                continue
+            for i, line in enumerate(path.read_text().splitlines(), 1):
+                if pattern.search(line):
+                    offenders.append(f"{rel}:{i}")
+    assert not offenders, (
+        f"private page-pool access in {offenders}; use pages_for()/"
+        "usable_pages, or route page ownership through paddle_tpu.kvcache")
