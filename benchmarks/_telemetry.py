@@ -1,0 +1,32 @@
+"""Shared benchmark telemetry: the ``metrics_snapshot`` field.
+
+Every benchmark appends the SAME registry view to its one-line JSON
+summary (``bench_serving.py`` and ``bench_checkpoint.py`` established
+the shape; the perf-trajectory tooling diffs it across rounds):
+recompile counts per function, the total eager-dispatch count, plus any
+extra registry namespaces the benchmark asks for.
+
+Import from a benchmark script (the benchmarks dir is sys.path[0] when
+run as ``python benchmarks/bench_x.py``)::
+
+    from _telemetry import metrics_snapshot
+    out["metrics_snapshot"] = metrics_snapshot()
+"""
+
+
+def metrics_snapshot(*namespaces: str) -> dict:
+    """The standard snapshot dict; ``namespaces`` adds whole registry
+    sections (e.g. ``"paddle_serving"``) under their own keys."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    out = {
+        "recompiles_total": snap.get("paddle_runtime_recompiles_total", {}),
+        "op_dispatch_total": sum(
+            snap.get("paddle_runtime_ops", {})
+            .get("op_dispatch_total", {}).values()),
+    }
+    for ns in namespaces:
+        if ns in snap:
+            out[ns] = snap[ns]
+    return out

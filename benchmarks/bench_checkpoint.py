@@ -94,15 +94,9 @@ def main():
 
     overhead = (overlapped_step_ms - base_step_ms) / max(base_step_ms, 1e-9)
     # unified-telemetry snapshot: dispatch + recompile counters from the
-    # process-global registry (what a /metrics scrape would report)
-    from paddle_tpu.observability import get_registry
-    snap = get_registry().snapshot()
-    metrics_snapshot = {
-        "recompiles_total": snap.get("paddle_runtime_recompiles_total", {}),
-        "op_dispatch_total": sum(
-            snap.get("paddle_runtime_ops", {})
-            .get("op_dispatch_total", {}).values()),
-    }
+    # process-global registry (shared shape: benchmarks/_telemetry.py)
+    from _telemetry import metrics_snapshot as _snapshot
+    metrics_snapshot = _snapshot()
     print(json.dumps({
         "bench": "checkpoint",
         "platform": "tpu" if on_tpu else "cpu",

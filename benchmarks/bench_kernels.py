@@ -125,6 +125,15 @@ def main():
     print(f"{'kernel':<{width}} ms/iter")
     for name, ms in rows:
         print(f"{name:<{width}} {ms:7.3f}")
+    # one machine-readable trailer line with the shared registry view,
+    # so the perf trajectory carries telemetry (benchmarks/_telemetry.py)
+    import json
+    from _telemetry import metrics_snapshot
+    print(json.dumps({
+        "bench": "kernels",
+        "ms_per_iter": {name: round(ms, 4) for name, ms in rows},
+        "metrics_snapshot": metrics_snapshot(),
+    }))
 
 
 if __name__ == "__main__":

@@ -114,6 +114,15 @@ def main():
           f"{'bubble':>7}")
     for kind, loss, ms, kib, bub in rows:
         print(f"{kind:<12} {loss:8.4f} {ms:8.2f} {kib:9.0f} {bub:7.3f}")
+    # one machine-readable trailer line with the shared registry view,
+    # so the perf trajectory carries telemetry (benchmarks/_telemetry.py)
+    import json
+    from _telemetry import metrics_snapshot
+    print(json.dumps({
+        "bench": "pipeline",
+        "ms_per_step": {kind: round(ms, 3) for kind, _, ms, _, _ in rows},
+        "metrics_snapshot": metrics_snapshot(),
+    }))
 
 
 if __name__ == "__main__":

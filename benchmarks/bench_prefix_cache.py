@@ -117,6 +117,9 @@ def main():
             cold["ttft_ms"]["p50"] / max(warm["ttft_ms"]["p50"], 1e-9), 3),
         "kvcache": eng.cache.snapshot(),
     }
+    # same registry view every bench carries (benchmarks/_telemetry.py)
+    from _telemetry import metrics_snapshot
+    out["metrics_snapshot"] = metrics_snapshot()
     assert skipped >= 0.5, (
         f"warm wave skipped only {100 * skipped:.1f}% of prefill tokens")
     print(json.dumps(out))

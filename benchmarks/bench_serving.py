@@ -93,16 +93,13 @@ def main():
     }
     # unified-telemetry snapshot: per-op dispatch counts, recompiles,
     # serving sink — the registry view a /metrics scrape would see
-    from paddle_tpu.observability import get_registry
-    snap = get_registry().snapshot()
-    out["metrics_snapshot"] = {
-        "recompiles_total": snap.get("paddle_runtime_recompiles_total", {}),
-        "op_dispatch_total": sum(
-            snap.get("paddle_runtime_ops", {})
-            .get("op_dispatch_total", {}).values()),
-        "serving_counters": snap.get("paddle_serving", {}).get("counters"),
-        "step_timer": sched.step_timer.summary()["step_ms"],
-    }
+    # (shared shape: benchmarks/_telemetry.py)
+    from _telemetry import metrics_snapshot
+    ms = metrics_snapshot("paddle_serving")
+    ms["serving_counters"] = (ms.pop("paddle_serving", None)
+                              or {}).get("counters")
+    ms["step_timer"] = sched.step_timer.summary()["step_ms"]
+    out["metrics_snapshot"] = ms
     # prefix-cache effect on this (mostly-unique-prompt) workload: the
     # dedicated shared-prefix study lives in bench_prefix_cache.py
     out["kvcache"] = eng.cache.snapshot()

@@ -170,3 +170,13 @@ class PrefixCache:
         out["cached_pages"] = self.mgr.num_cached_pages
         out["tree_nodes"] = len(self.tree)
         return out
+
+    def statusz(self) -> Dict[str, object]:
+        """Diagnostics-server view (``DiagServer.attach_kvcache``): the
+        hit/evict stats plus the live page-pool ownership split."""
+        out: Dict[str, object] = dict(self.snapshot())
+        out["pages"] = {"usable": self.mgr.usable_pages,
+                        "free": self.mgr.num_free_pages,
+                        "live": self.mgr.num_live_pages,
+                        "cached": self.mgr.num_cached_pages}
+        return out

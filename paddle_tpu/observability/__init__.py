@@ -26,6 +26,18 @@ needs (every future perf PR must be measurable):
 * :mod:`.events` — structured JSON-lines event log (size-capped
   rotation) shared by serving and resilience for shed / retry /
   rollback / preempt / recompile events.
+* :mod:`.slo` — declarative objectives (latency quantiles, error
+  ratios) judged with multi-window burn rates against the registry,
+  emitting ``slo_breach``/``slo_recovered`` events and feeding the
+  scheduler's degrade path.
+* :mod:`.goodput` — wall-clock decomposition of training runs
+  (productive / retry / rollback-replay / checkpoint-stall / restart)
+  plus a rolling-MAD straggler detector.
+* :mod:`.flight` — flight recorder: last-N events/spans/metric deltas
+  in bounded rings, postmortem ``dump_debug_bundle`` tarballs,
+  auto-dump hooks on watchdog timeout / NaN rollback / degrade.
+* :mod:`.server` — stdlib-only :class:`DiagServer` exposing
+  ``/metrics``, ``/healthz``, ``/statusz`` and ``/debugz`` live.
 
 Quick start::
 
@@ -38,11 +50,17 @@ Quick start::
 
 from . import format  # noqa: F401
 from .events import EventLog, configure_event_log, emit_event, event_log  # noqa: F401
+from .flight import FlightRecorder, flight_recorder  # noqa: F401
+from .goodput import GoodputTracker, StragglerDetector  # noqa: F401
 from .registry import (  # noqa: F401
     Counter, Gauge, HistogramMetric, MetricsRegistry, get_registry,
 )
 from .runtime import (  # noqa: F401
     DispatchTelemetry, RecompileDetector, recompiles, telemetry,
+)
+from .server import DiagServer  # noqa: F401
+from .slo import (  # noqa: F401
+    SLObjective, SLOMonitor, latency_objective, ratio_objective,
 )
 from .step_timer import StepTimer  # noqa: F401
 from .trace import (  # noqa: F401
@@ -56,4 +74,7 @@ __all__ = [
     "telemetry", "StepTimer", "TraceContext", "current_trace",
     "current_trace_id", "new_trace_id", "trace_context", "EventLog",
     "configure_event_log", "emit_event", "event_log", "format",
+    "SLObjective", "SLOMonitor", "latency_objective", "ratio_objective",
+    "GoodputTracker", "StragglerDetector", "FlightRecorder",
+    "flight_recorder", "DiagServer",
 ]

@@ -9,6 +9,14 @@ Disabled (no-op, one ``is None`` check per emit) until
 :func:`configure_event_log` points it at a path. Rotation keeps
 ``backups`` closed generations (``events.jsonl.1`` newest … ``.N``
 oldest) and never lets the live file exceed ``max_bytes``.
+
+``emit`` is called from scheduler/trainer hot paths, so it is
+exception-safe by contract: an I/O failure (full disk, a path turned
+into a directory, a racing rotation) increments
+``paddle_events_dropped_total`` and drops the event instead of
+propagating into the step loop. While the flight recorder is armed,
+every record also lands in its ring — even when the file sink is
+disabled.
 """
 
 from __future__ import annotations
@@ -19,7 +27,23 @@ import threading
 import time
 from typing import Optional
 
+from .flight import flight_armed, flight_recorder
 from .trace import current_trace
+
+_dropped_counter = None       # lazy: created on first drop, then cached
+
+
+def _count_dropped() -> None:
+    global _dropped_counter
+    try:
+        if _dropped_counter is None:
+            from .registry import get_registry
+            _dropped_counter = get_registry().counter(
+                "paddle_events_dropped_total",
+                "events lost to event-log I/O failures")
+        _dropped_counter.inc()
+    except Exception:         # even the accounting must never propagate
+        pass
 
 
 class EventLog:
@@ -57,9 +81,10 @@ class EventLog:
         return self
 
     def emit(self, kind: str, **fields) -> None:
-        """Append one event. The current trace context's ids are attached
-        automatically (explicit kwargs win)."""
-        if self._path is None:
+        """Append one event (see module docstring: exception-safe, taps
+        the armed flight recorder). The current trace context's ids are
+        attached automatically (explicit kwargs win)."""
+        if self._path is None and not flight_armed[0]:
             return
         ctx = current_trace()
         record = {"ts": round(time.time(), 6), "kind": kind}
@@ -70,16 +95,26 @@ class EventLog:
             if ctx.step is not None:
                 record.setdefault("step", ctx.step)
         record.update(fields)
-        line = json.dumps(record, default=str, separators=(",", ":")) + "\n"
-        data = line.encode()
-        with self._lock:
-            if self._path is None:
-                return
-            if self._size and self._size + len(data) > self._max_bytes:
-                self._rotate()
-            with open(self._path, "ab") as f:
-                f.write(data)
-            self._size += len(data)
+        if flight_armed[0]:
+            flight_recorder.note_event(record)
+        if self._path is None:
+            return
+        try:
+            line = json.dumps(record, default=str,
+                              separators=(",", ":")) + "\n"
+            data = line.encode()
+            with self._lock:
+                if self._path is None:
+                    return
+                if self._size and self._size + len(data) > self._max_bytes:
+                    self._rotate()
+                with open(self._path, "ab") as f:
+                    f.write(data)
+                self._size += len(data)
+        except Exception:
+            # full disk / rotation race / unserialisable field: the hot
+            # path (scheduler, trainer) must never see event-log errors
+            _count_dropped()
 
     def _rotate(self) -> None:
         """path -> path.1 -> … -> path.backups (oldest dropped)."""

@@ -1,0 +1,210 @@
+"""Flight recorder: last-N telemetry in memory, tarball on demand.
+
+A production incident needs the moments BEFORE the failure — the events,
+spans and metric deltas that a rotating log or a sampled scrape already
+dropped. The :class:`FlightRecorder` keeps bounded rings of the most
+recent activity and can serialise a *postmortem bundle* at any time:
+
+* ``events`` — every structured event (``emit_event``) while armed, even
+  when the JSONL file sink is disabled;
+* ``spans`` — profiler ``RecordEvent``/``emit_span`` spans while armed
+  (no Profiler capture window required: ``profiler.record`` taps spans
+  into the ring whenever ``flight_armed[0]`` is set);
+* ``metrics`` — periodic deltas pushed by the SLO monitor (burn rates
+  per tick).
+
+Disarmed cost is the zero-overhead contract of the telemetry layer: call
+sites check the module-level ``flight_armed`` cell (one list index, no
+allocation) exactly like ``runtime.dispatch_armed`` — guarded by
+``benchmarks/bench_obs_overhead.py``.
+
+:meth:`FlightRecorder.dump_debug_bundle` writes a tar.gz containing
+``metrics.prom`` (the full registry exposition), ``metrics.json`` (its
+snapshot), ``events.jsonl`` (ring), ``trace.json`` (ring spans as a
+chrome trace that loads in Perfetto), ``slo.json`` (objective states, if
+a monitor was attached) and ``manifest.json`` (reason, counts, config).
+:meth:`auto_dump` is the hook the runtime calls on watchdog timeouts,
+NaN rollbacks and scheduler degradation — it rate-limits to one bundle
+per reason so a crash loop cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+#: the one cell hot paths check before touching the recorder (mutable
+#: list so callers read a stable module attribute, not a rebindable name)
+flight_armed = [False]
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=capacity)
+        self._spans: Deque[tuple] = deque(maxlen=capacity)
+        self._metrics: Deque[Dict[str, Any]] = deque(maxlen=capacity)
+        self._dump_dir: Optional[str] = None
+        self._slo_monitor = None
+        self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
+        self.dumps = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def armed(self) -> bool:
+        return flight_armed[0]
+
+    def arm(self, capacity: Optional[int] = None,
+            dump_dir: Optional[str] = None) -> "FlightRecorder":
+        """Start recording. ``dump_dir`` enables :meth:`auto_dump` (the
+        watchdog/NaN/degrade hooks are no-ops without it)."""
+        with self._lock:
+            if capacity is not None and capacity != self._capacity:
+                self._capacity = capacity
+                self._events = deque(self._events, maxlen=capacity)
+                self._spans = deque(self._spans, maxlen=capacity)
+                self._metrics = deque(self._metrics, maxlen=capacity)
+            if dump_dir is not None:
+                self._dump_dir = dump_dir
+            flight_armed[0] = True
+        return self
+
+    def disarm(self) -> None:
+        flight_armed[0] = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._spans.clear()
+            self._metrics.clear()
+            self._auto_dumped.clear()
+
+    def attach_slo_monitor(self, monitor) -> None:
+        """Objective states land in ``slo.json`` of every bundle."""
+        self._slo_monitor = monitor
+
+    # -- recording (armed-only; callers gate on flight_armed[0]) ------------
+
+    def note_event(self, record: Dict[str, Any]) -> None:
+        """Called by ``events.EventLog.emit`` with the already-built
+        record dict (shared, not copied — emit never mutates it after)."""
+        self._events.append(record)
+
+    def note_span(self, span: tuple) -> None:
+        """Called by ``profiler.record`` with a ``HostSpan`` tuple."""
+        self._spans.append(span)
+
+    def note_metrics(self, label: str, payload: Dict[str, Any]) -> None:
+        self._metrics.append({"label": label, **payload})
+
+    # -- dumping ------------------------------------------------------------
+
+    def snapshot_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"armed": flight_armed[0], "capacity": self._capacity,
+                    "events": len(self._events), "spans": len(self._spans),
+                    "metric_samples": len(self._metrics),
+                    "dumps": self.dumps, "dump_dir": self._dump_dir}
+
+    def _chrome_trace(self, spans: List[tuple]) -> Dict[str, Any]:
+        """Ring spans as chrome://tracing JSON (same shape as
+        ``profiler.export_chrome_tracing``, minus flow events — a ring is
+        a window, so chains may be torn anyway)."""
+        events = []
+        for sp in spans:
+            ev = {"name": sp.name, "cat": sp.event_type, "ph": "X",
+                  "ts": sp.start_ns / 1000.0,
+                  "dur": (sp.end_ns - sp.start_ns) / 1000.0,
+                  "pid": sp.pid, "tid": sp.tid}
+            args = dict(sp.args or {})
+            if sp.trace_id:
+                args.setdefault("trace_id", sp.trace_id)
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_debug_bundle(self, path: Optional[str] = None,
+                          reason: str = "manual") -> str:
+        """Write the postmortem tarball; returns its path. ``path`` may
+        be a target file or a directory (a timestamped name is chosen
+        inside); defaults to the armed ``dump_dir`` (cwd as a last
+        resort)."""
+        from .registry import get_registry
+
+        with self._lock:
+            events = list(self._events)
+            spans = list(self._spans)
+            metric_samples = list(self._metrics)
+            seq = self.dumps        # claimed under the lock: concurrent
+            self.dumps += 1         # dumps get distinct bundle names
+        target = path if path is not None else (self._dump_dir or ".")
+        if os.path.isdir(target) or not target.endswith((".tar.gz", ".tgz")):
+            os.makedirs(target, exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            target = os.path.join(
+                target, f"paddle_debug_{reason}_{stamp}_{os.getpid()}"
+                        f"_{seq}.tar.gz")
+        else:
+            d = os.path.dirname(target)
+            if d:
+                os.makedirs(d, exist_ok=True)
+        reg = get_registry()
+        members: Dict[str, bytes] = {}
+        members["metrics.prom"] = reg.prometheus_text().encode()
+        members["metrics.json"] = json.dumps(
+            reg.snapshot(), default=str, indent=1).encode()
+        members["events.jsonl"] = "".join(
+            json.dumps(e, default=str, separators=(",", ":")) + "\n"
+            for e in events).encode()
+        members["trace.json"] = json.dumps(
+            self._chrome_trace(spans)).encode()
+        if self._slo_monitor is not None:
+            members["slo.json"] = json.dumps(
+                self._slo_monitor.states(), indent=1).encode()
+        members["manifest.json"] = json.dumps({
+            "reason": reason, "pid": os.getpid(),
+            "capacity": self._capacity, "events": len(events),
+            "spans": len(spans), "metric_samples": len(metric_samples),
+            "metric_deltas": metric_samples,
+        }, default=str, indent=1).encode()
+        with tarfile.open(target, "w:gz") as tar:
+            for name, data in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        from .events import emit_event
+        emit_event("debug_dump", reason=reason, path=target,
+                   events=len(events), spans=len(spans))
+        return target
+
+    def auto_dump(self, reason: str) -> Optional[str]:
+        """Postmortem hook for the runtime (watchdog timeout, NaN
+        rollback, scheduler degrade): dump once per distinct reason,
+        only when armed with a dump_dir; never raises into the caller's
+        failure path."""
+        if not flight_armed[0] or self._dump_dir is None:
+            return None
+        with self._lock:
+            if reason in self._auto_dumped:
+                return None
+            self._auto_dumped[reason] = ""   # reserve before the slow dump
+        try:
+            p = self.dump_debug_bundle(reason=reason)
+        except Exception:
+            return None
+        with self._lock:
+            self._auto_dumped[reason] = p
+        return p
+
+
+#: the process-global recorder the runtime hooks dump into
+flight_recorder = FlightRecorder()

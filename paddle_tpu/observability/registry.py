@@ -204,16 +204,46 @@ class MetricsRegistry:
 
     def _get_or_make(self, kind: str, name: str, help: str,
                      labels: Sequence[str], **kw):
+        if isinstance(labels, str):
+            # labels="op" silently iterates into ('o', 'p'); catch the
+            # footgun before it registers an unusable family
+            raise TypeError(
+                f"metric {name!r}: labels must be a SEQUENCE of label "
+                f"names, got the bare string {labels!r} — use "
+                f"labels=({labels!r},)")
+        labels = tuple(labels)
         with self._lock:
             existing = self._metrics.get(name)
             if existing is not None:
-                if (self._kinds[name] != kind
-                        or existing.label_names != tuple(labels)):
+                if self._kinds[name] != kind:
                     raise ValueError(
-                        f"metric {name!r} already registered as "
-                        f"{self._kinds[name]} with labels "
-                        f"{existing.label_names}; cannot re-register as "
-                        f"{kind} with labels {tuple(labels)}")
+                        f"metric {name!r} already registered as a "
+                        f"{self._kinds[name]}; cannot re-register as a "
+                        f"{kind}")
+                if existing.label_names != labels:
+                    # returning the existing family here would make later
+                    # inc(**labels) calls key inconsistently between the
+                    # two call sites — fail loudly at registration instead
+                    raise ValueError(
+                        f"metric {name!r} already registered with label "
+                        f"names {existing.label_names}; cannot "
+                        f"re-register with label names {labels} — every "
+                        f"call site of one family must declare the same "
+                        f"labels (order included)")
+                if kind == "histogram":
+                    bounds = tuple(kw.get("bounds", existing.bounds))
+                    if bounds != existing.bounds:
+                        raise ValueError(
+                            f"histogram {name!r} already registered with "
+                            f"bounds {existing.bounds}; cannot "
+                            f"re-register with bounds {bounds}")
+                    q = kw.get("quantiles", existing.quantiles)
+                    q = tuple(q) if q else None
+                    if q != existing.quantiles:
+                        raise ValueError(
+                            f"histogram {name!r} already registered with "
+                            f"quantiles {existing.quantiles}; cannot "
+                            f"re-register with quantiles {q}")
                 return existing
             metric = _TYPES[kind](name, help, labels, **kw)
             self._metrics[name] = metric

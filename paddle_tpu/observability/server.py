@@ -1,0 +1,218 @@
+"""Live diagnostics server — the process's operable surface.
+
+A stdlib-only (``http.server`` on a daemon thread) debug endpoint; THE
+one place in the tree allowed to open a listening socket for
+diagnostics (``tests/test_observability_lint.py`` enforces it):
+
+========== ==============================================================
+route      serves
+========== ==============================================================
+/metrics   the registry's Prometheus exposition, byte-identical to
+           ``registry.prometheus_text()`` (scrape target)
+/healthz   SLO-aware health: ``ok`` | ``degraded`` | ``breached`` as
+           JSON; HTTP 200 while serving is viable, 503 on breach
+           (load-balancer ready-check semantics)
+/statusz   one JSON document from every registered provider (scheduler
+           queues, kvcache pages, goodput breakdown, SLO states,
+           flight-recorder status)
+/debugz    flight-recorder status; ``?dump=1`` writes a postmortem
+           bundle (``dump_debug_bundle``) and returns its path
+========== ==============================================================
+
+Providers are callables returning JSON-able data, registered with
+:meth:`DiagServer.add_statusz` or via the ``attach_*`` conveniences.
+Handlers never let a torn provider kill the scrape: a provider raising
+turns into an ``{"error": …}`` entry, the rest of the page still
+renders.
+
+Usage::
+
+    srv = DiagServer(monitor=slo_monitor)       # port=0: ephemeral
+    srv.attach_scheduler(sched)
+    port = srv.start()
+    ...
+    curl http://127.0.0.1:{port}/healthz
+    srv.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .flight import flight_recorder
+from .registry import get_registry
+
+#: health states, ordered by severity (max wins when composing sources)
+_HEALTH_ORDER = {"ok": 0, "degraded": 1, "breached": 2}
+
+
+class DiagServer:
+    """See module docstring. ``port=0`` binds an ephemeral port (tests);
+    ``registry=None`` uses the process-global one."""
+
+    def __init__(self, registry=None, monitor=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 flight=None):
+        self.registry = registry if registry is not None else get_registry()
+        self.monitor = monitor
+        self.flight = flight if flight is not None else flight_recorder
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._statusz: Dict[str, Callable[[], object]] = {}
+        self._health_fns: Dict[str, Callable[[], str]] = {}
+        if monitor is not None:
+            self.add_health_source("slo", monitor.health)
+            self.add_statusz("slo", monitor.states)
+        self.add_statusz("flight_recorder", self.flight.snapshot_status)
+
+    # -- wiring -------------------------------------------------------------
+
+    def add_statusz(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a /statusz section; ``fn`` returns JSON-able data."""
+        self._statusz[name] = fn
+
+    def add_health_source(self, name: str,
+                          fn: Callable[[], str]) -> None:
+        """Register a health contributor returning ``ok`` | ``degraded``
+        | ``breached``; /healthz reports the worst across sources."""
+        self._health_fns[name] = fn
+
+    def attach_scheduler(self, sched) -> None:
+        """Serving scheduler: queue/slot/page state on /statusz, its
+        degraded latch as a health source."""
+        self.add_statusz("serving", sched.statusz)
+        self.add_health_source(
+            "serving", lambda: "breached" if sched.degraded else "ok")
+
+    def attach_goodput(self, tracker) -> None:
+        self.add_statusz("goodput", tracker.breakdown)
+
+    def attach_kvcache(self, cache) -> None:
+        self.add_statusz("kvcache", cache.statusz)
+
+    # -- derived health -----------------------------------------------------
+
+    def health(self) -> str:
+        worst = "ok"
+        for fn in self._health_fns.values():
+            try:
+                state = fn()
+            except Exception:
+                state = "degraded"          # a torn source is suspicious
+            if _HEALTH_ORDER.get(state, 1) > _HEALTH_ORDER[worst]:
+                worst = state
+        return worst
+
+    def statusz(self) -> Dict[str, object]:
+        out: Dict[str, object] = {"health": self.health()}
+        for name, fn in self._statusz.items():
+            try:
+                out[name] = fn()
+            except Exception as e:          # page still renders
+                out[name] = {"error": repr(e)}
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        if self._httpd is not None:
+            return self.port
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):        # noqa: ARG002 - quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):                    # noqa: N802 - stdlib API
+                try:
+                    url = urlparse(self.path)
+                    route = url.path.rstrip("/") or "/"
+                    if route == "/metrics":
+                        # byte-identical to registry.prometheus_text()
+                        self._send(200,
+                                   server.registry.prometheus_text()
+                                   .encode("utf-8"),
+                                   ctype="text/plain; version=0.0.4; "
+                                         "charset=utf-8")
+                    elif route == "/healthz":
+                        state = server.health()
+                        self._send(503 if state == "breached" else 200,
+                                   json.dumps({"status": state}).encode())
+                    elif route == "/statusz":
+                        self._send(200, json.dumps(
+                            server.statusz(), default=str,
+                            indent=1).encode())
+                    elif route == "/debugz":
+                        q = parse_qs(url.query)
+                        if q.get("dump", ["0"])[0] == "1":
+                            path = server.flight.dump_debug_bundle(
+                                reason="debugz")
+                            body = {"dumped": path}
+                        else:
+                            body = server.flight.snapshot_status()
+                        self._send(200, json.dumps(
+                            body, default=str).encode())
+                    elif route == "/":
+                        self._send(200, json.dumps({
+                            "endpoints": ["/metrics", "/healthz",
+                                          "/statusz", "/debugz"],
+                        }).encode())
+                    else:
+                        self._send(404, b'{"error":"not found"}')
+                except BrokenPipeError:          # client went away
+                    pass
+                except Exception as e:           # never kill the server
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode())
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="paddle-diagserver",
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self) -> "DiagServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

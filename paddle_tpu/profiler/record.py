@@ -22,6 +22,7 @@ import time
 from typing import List, NamedTuple, Optional
 
 from ..observability import runtime as _obs_runtime
+from ..observability.flight import flight_armed, flight_recorder
 from ..observability.trace import current_trace
 
 
@@ -80,16 +81,19 @@ def emit_span(name: str, start_ns: int, end_ns: int,
               args: Optional[dict] = None) -> None:
     """Emit a span with explicit timestamps (for retroactive spans like a
     request's queue wait, whose start predates the emit site). No-op when
-    no capture window is active. ``trace_id=None`` picks up the ambient
-    trace context."""
-    if not host_recorder.enabled:
+    neither a capture window nor the flight recorder is armed.
+    ``trace_id=None`` picks up the ambient trace context."""
+    if not host_recorder.enabled and not flight_armed[0]:
         return
     if trace_id is None:
         ctx = current_trace()
         trace_id = ctx.trace_id if ctx is not None else ""
-    host_recorder.emit(HostSpan(
-        name, event_type, start_ns, end_ns,
-        threading.get_ident(), _MAIN_PID, trace_id, args))
+    span = HostSpan(name, event_type, start_ns, end_ns,
+                    threading.get_ident(), _MAIN_PID, trace_id, args)
+    if host_recorder.enabled:
+        host_recorder.emit(span)
+    if flight_armed[0]:
+        flight_recorder.note_span(span)
 
 
 class RecordEvent:
@@ -116,12 +120,15 @@ class RecordEvent:
         self._jax_ann = None
 
     def begin(self) -> None:
-        if not host_recorder.enabled:     # zero-overhead fast path
+        capture = host_recorder.enabled
+        if not capture and not flight_armed[0]:  # zero-overhead fast path
             return
         if self._trace_id is None:
             ctx = current_trace()
             self._trace_id = ctx.trace_id if ctx is not None else ""
         self._start_ns = time.perf_counter_ns()
+        if not capture:      # flight-only: skip the jax annotation (the
+            return           # xplane trace belongs to capture windows)
         try:
             import jax.profiler as jprof
             self._jax_ann = jprof.TraceAnnotation(self.name)
@@ -137,12 +144,16 @@ class RecordEvent:
                 self._jax_ann.__exit__(None, None, None)
             finally:
                 self._jax_ann = None
-        if host_recorder.enabled:
-            host_recorder.emit(HostSpan(
+        if host_recorder.enabled or flight_armed[0]:
+            span = HostSpan(
                 self.name, self.event_type, self._start_ns,
                 time.perf_counter_ns(),
                 threading.get_ident(), _MAIN_PID,
-                self._trace_id or "", self.args))
+                self._trace_id or "", self.args)
+            if host_recorder.enabled:
+                host_recorder.emit(span)
+            if flight_armed[0]:
+                flight_recorder.note_span(span)
         self._start_ns = None
 
     def __enter__(self) -> "RecordEvent":

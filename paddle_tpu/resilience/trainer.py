@@ -35,6 +35,8 @@ from typing import Any, Callable, Dict, Optional, Set
 import numpy as np
 
 from ..observability.events import emit_event
+from ..observability.flight import flight_recorder
+from ..observability.goodput import GoodputTracker, StragglerDetector
 from ..observability.step_timer import StepTimer
 from ..observability.trace import trace_context
 from .durable import (async_save_checkpoint, checkpoint_path, latest_step,
@@ -87,6 +89,10 @@ class ResilienceConfig:
     tokens_per_step: int = 0
     flops_per_step: Optional[float] = None
     peak_flops_per_s: Optional[float] = None
+    # straggler detection over per-step wall time (rolling MAD z-score;
+    # flags count into paddle_stragglers_total and the event log)
+    straggler_window: int = 32
+    straggler_z: float = 4.0
 
 
 class ResilientTrainer:
@@ -97,6 +103,11 @@ class ResilientTrainer:
         self.metrics = metrics or ResilienceMetrics()
         self.step_timer = StepTimer(flops_per_step=config.flops_per_step,
                                     peak_flops_per_s=config.peak_flops_per_s)
+        self.goodput = GoodputTracker()
+        self.stragglers = StragglerDetector(window=config.straggler_window,
+                                            z_threshold=config.straggler_z)
+        self._goodput_hw = -1          # highest step already run in-process
+        self._wasted_s = 0.0           # retry time inside the last step
         self.last_loss: Optional[float] = None
         self.resumed_from: Optional[int] = None
         self._pending = None           # in-flight AsyncSaveFuture
@@ -125,28 +136,39 @@ class ResilientTrainer:
         """Durable save at the current global step (async unless ``block``
         or the config says sync). Returns the committed path for a blocking
         save (None when it failed — failure is logged + counted; an interval
-        save failing degrades durability but must not kill training)."""
-        self._harvest(block=True)  # a new save serializes after the last one
-        step = self.state.global_step
-        sd = self.state.state_dict()
-        if self.cfg.async_save and not block:
-            self._pending = async_save_checkpoint(
-                sd, self.cfg.checkpoint_dir, step, keep=self.cfg.keep,
-                fault_injector=self.cfg.fault_injector)
-            self._pending_step = step
-            return None
-        t0 = time.perf_counter()
+        save failing degrades durability but must not kill training).
+
+        The time the TRAINING LOOP is blocked here — waiting out the
+        previous async save, copying the state dict, the whole sync
+        write — is goodput's ``checkpoint_stall`` bucket (overlapped
+        async IO is free by construction)."""
+        t_stall = time.perf_counter()
         try:
-            path = save_checkpoint(sd, self.cfg.checkpoint_dir, step,
-                                   keep=self.cfg.keep,
-                                   fault_injector=self.cfg.fault_injector)
-        except Exception as e:
-            self.metrics.inc("save_failures")
-            emit_event("save_failure", step=step, error=repr(e))
-            logger.warning("checkpoint save at step %d failed: %s", step, e)
-            return None
-        self.metrics.observe_save_ms((time.perf_counter() - t0) * 1e3)
-        return path
+            self._harvest(block=True)  # serialize after the last save
+            step = self.state.global_step
+            sd = self.state.state_dict()
+            if self.cfg.async_save and not block:
+                self._pending = async_save_checkpoint(
+                    sd, self.cfg.checkpoint_dir, step, keep=self.cfg.keep,
+                    fault_injector=self.cfg.fault_injector)
+                self._pending_step = step
+                return None
+            t0 = time.perf_counter()
+            try:
+                path = save_checkpoint(
+                    sd, self.cfg.checkpoint_dir, step, keep=self.cfg.keep,
+                    fault_injector=self.cfg.fault_injector)
+            except Exception as e:
+                self.metrics.inc("save_failures")
+                emit_event("save_failure", step=step, error=repr(e))
+                logger.warning("checkpoint save at step %d failed: %s",
+                               step, e)
+                return None
+            self.metrics.observe_save_ms((time.perf_counter() - t0) * 1e3)
+            return path
+        finally:
+            self.goodput.note("checkpoint_stall",
+                              time.perf_counter() - t_stall)
 
     def _harvest(self, block: bool) -> None:
         """Collect the outcome of the in-flight async save, if any. A
@@ -220,12 +242,17 @@ class ResilientTrainer:
     def _step_with_retry(self, step_fn: Callable[[int], Any], step: int):
         delay = self.cfg.retry_backoff
         attempt = 0
+        wasted = 0.0          # failed attempts + backoff -> goodput retry
+        self._wasted_s = 0.0
         while True:
+            t_attempt = time.perf_counter()
             try:
                 fi = self.cfg.fault_injector
                 if fi is not None and fi.fire("step_error", step):
                     raise ChaosError(f"injected step failure at step {step}")
-                return step_fn(step)
+                result = step_fn(step)
+                self._wasted_s = wasted
+                return result
             except (Preempted, TrainingAborted):
                 raise
             except Exception as e:
@@ -241,12 +268,19 @@ class ResilientTrainer:
                                step, e, attempt, self.cfg.max_step_retries,
                                delay)
                 time.sleep(delay)
+                waste = time.perf_counter() - t_attempt
+                wasted += waste
+                self.goodput.note("retry", waste)
                 delay = min(delay * 2, self.cfg.retry_backoff_cap)
 
     def _rollback(self, offending_step: int, reason: str) -> None:
         """Reload the last good checkpoint and let the loop replay forward.
         One-shot faults will not re-fire during the replay, so a transient
         NaN converges back onto the uninterrupted trajectory."""
+        t0 = time.perf_counter()
+        # snapshot the moments leading into the rollback while they are
+        # still in the flight ring (armed + dump_dir only, never raises)
+        flight_recorder.auto_dump("nan_rollback")
         self._harvest(block=True)
         self.metrics.inc("nan_rollbacks")
         restored = restore_train_state(self.state, self.cfg.checkpoint_dir,
@@ -258,6 +292,7 @@ class ResilientTrainer:
                    restored_step=restored)
         logger.warning("rolled back to step %d after %s at step %d",
                        restored, reason, offending_step)
+        self.goodput.note("rollback_replay", time.perf_counter() - t0)
 
     def _note_nan(self, step: int) -> None:
         n = self._nan_counts.get(step, 0) + 1
@@ -279,13 +314,23 @@ class ResilientTrainer:
         way. Raises :class:`Preempted` after a clean preemption flush and
         :class:`TrainingAborted` when the failure budget is exhausted."""
         cfg = self.cfg
+        t_run = time.perf_counter()
+        # fresh accounting per run: a reused trainer must not bill a
+        # previous run's buckets against this run's wall clock
+        self.goodput = GoodputTracker()
+        self.stragglers = StragglerDetector(window=cfg.straggler_window,
+                                            z_threshold=cfg.straggler_z)
+        self._goodput_hw = -1
+        self._wasted_s = 0.0
         if cfg.fault_injector is None and cfg.chaos_seed is not None:
             # built here, where the real run length is known — seeding over
             # a huge fixed step space would schedule faults that never fire
             cfg.fault_injector = FaultInjector.seeded(cfg.chaos_seed,
                                                       num_steps=num_steps)
         if resume:
+            t0 = time.perf_counter()
             self.resume()
+            self.goodput.note("restart", time.perf_counter() - t0)
         if latest_step(cfg.checkpoint_dir) is None:
             # seed checkpoint: the rollback/preemption target must exist
             # before the first interval save
@@ -308,11 +353,31 @@ class ResilientTrainer:
                     lv = loss._value if hasattr(loss, "_value") else loss
                     self.step_timer.host_done()   # dispatch done; the
                     lf = float(np.asarray(lv))    # float() is the fence
-                    self.step_timer.end(tokens=cfg.tokens_per_step)
+                    step_s = self.step_timer.end(
+                        tokens=cfg.tokens_per_step) or 0.0
+                # goodput: the successful attempt's time (retries/backoff
+                # were booked inside _step_with_retry) is productive only
+                # when the step is NEW progress producing a finite loss;
+                # a re-execution below the high-water mark is replay, a
+                # NaN attempt is wasted work charged to the rollback
+                useful_s = max(0.0, step_s - self._wasted_s)
                 if not np.isfinite(lf):
+                    self.goodput.note("rollback_replay", useful_s)
                     self._note_nan(step)
                     self._rollback(step, "nan_loss")
                     continue
+                self.goodput.note(
+                    "rollback_replay" if step <= self._goodput_hw
+                    else "productive", useful_s)
+                self._goodput_hw = max(self._goodput_hw, step)
+                # judge only the successful attempt: retry/backoff time is
+                # already counted in step_retries_total, and letting it in
+                # would both misflag the step and pollute the MAD window
+                z = self.stragglers.observe(useful_s, source="train_step")
+                if z > self.stragglers.z_threshold:
+                    emit_event("straggler", step=step,
+                               step_ms=round(useful_s * 1e3, 3),
+                               z=round(z, 2))
                 self.last_loss = lf
                 self.state.step()
                 gs = self.state.global_step
@@ -335,4 +400,7 @@ class ResilientTrainer:
                 "last_loss": self.last_loss,
                 "skipped_steps": sorted(self._skip_steps),
                 "metrics": self.metrics.summary(),
-                "step_timer": self.step_timer.summary()}
+                "step_timer": self.step_timer.summary(),
+                "goodput": self.goodput.finalize(
+                    time.perf_counter() - t_run),
+                "stragglers": self.stragglers.flagged}

@@ -28,7 +28,14 @@ needs on top of it:
 * **metrics** — TTFT/ITL/e2e/queue-wait histograms, queue-depth and
   slot/page-utilization samples, shed/cancel/retry counters, plus
   profiler ``RecordEvent`` spans (``paddle_serving.step`` etc.) so
-  scheduler phases correlate with device activity in traces.
+  scheduler phases correlate with device activity in traces;
+* **SLOs** — :meth:`ServingScheduler.make_slo_monitor` attaches a
+  multi-window burn-rate monitor over the scheduler's own metrics and
+  clock; ``step()`` ticks it once per round and a breach sheds part of
+  the admission queue through the existing shedding policy (reason
+  ``slo``). ``statusz()`` is the diagnostics server's live view, and
+  the flight recorder auto-dumps a debug bundle on watchdog timeouts
+  and degradation.
 
 Determinism: scheduling order depends only on (priority, arrival order)
 and on deadline comparisons against the injected ``clock``; with a fixed
@@ -55,6 +62,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..observability.events import emit_event
+from ..observability.flight import flight_recorder
 from ..observability.step_timer import StepTimer
 from ..observability.trace import new_trace_id, trace_context
 from ..profiler.record import emit_span, host_recorder
@@ -155,6 +163,8 @@ class ServingScheduler:
         self._watchdog: Optional[tuple] = None   # (thread, result box)
         self.step_timer = StepTimer()            # host/device + tokens/s
         self.degraded = False
+        self.slo_monitor = None                  # see attach_slo_monitor
+        self._slo_shed_fraction = 0.5
         # engine hooks: route chunk tokens / retirements into the streams
         engine.token_callback = self._on_engine_token
         engine.finish_callback = self._on_engine_finish
@@ -252,20 +262,101 @@ class ServingScheduler:
         emit_event("cancel", request_id=req.rid, trace_id=req.trace_id)
         return True
 
+    # -- SLO wiring ---------------------------------------------------------
+
+    def make_slo_monitor(self, ttft_p95_ms: Optional[float] = None,
+                         itl_p99_ms: Optional[float] = None,
+                         max_shed_ratio: Optional[float] = 0.01,
+                         **monitor_kw):
+        """Build an :class:`~paddle_tpu.observability.slo.SLOMonitor`
+        over THIS scheduler's metrics sink and attach it: TTFT p95 /
+        ITL p99 latency objectives (pass thresholds to enable) and a
+        "submissions not shed or failed" ratio objective. Extra kwargs
+        (windows, burn_threshold, clock) flow to the monitor; the
+        scheduler's own clock is the default, so fake-clock tests stay
+        deterministic end to end."""
+        from ..observability.slo import (SLOMonitor, latency_objective,
+                                         ratio_objective)
+        m = self.metrics
+        objectives = []
+        if ttft_p95_ms is not None:
+            objectives.append(latency_objective(
+                "ttft", lambda: m.histograms["ttft_ms"], ttft_p95_ms,
+                target=0.95))
+        if itl_p99_ms is not None:
+            objectives.append(latency_objective(
+                "itl", lambda: m.histograms["itl_ms"], itl_p99_ms,
+                target=0.99))
+        if max_shed_ratio is not None:
+            # exclude reason="slo" sheds: those are the monitor's OWN
+            # remediation — counting them as bad events would let a
+            # latency breach cascade into a self-inflicted shed breach
+            objectives.append(ratio_objective(
+                "shed", lambda: m.shed_total - m.shed.get("slo", 0.0)
+                + m.counters.get("step_failures_total", 0),
+                lambda: m.counters.get("requests_submitted_total", 0),
+                target=1.0 - max_shed_ratio))
+        if not objectives:
+            raise ValueError("no objectives enabled; pass at least one "
+                             "of ttft_p95_ms / itl_p99_ms / "
+                             "max_shed_ratio")
+        monitor_kw.setdefault("clock", self._clock)
+        monitor = SLOMonitor(objectives, **monitor_kw)
+        self.attach_slo_monitor(monitor)
+        return monitor
+
+    def attach_slo_monitor(self, monitor,
+                           shed_fraction: float = 0.5) -> None:
+        """Wire a monitor into the serving loop: ``step()`` ticks it
+        once per round. The breach transition sheds ``shed_fraction``
+        of the admission queue (worst victims first — the existing
+        load-shedding policy), and for as long as the breach latch
+        holds, every step keeps the queue capped at
+        ``max_queue_depth * (1 - shed_fraction)`` so refilling traffic
+        keeps being trimmed until the objective recovers."""
+        self.slo_monitor = monitor
+        self._slo_shed_fraction = float(shed_fraction)
+        monitor.on_breach = self._on_slo_breach
+        monitor.on_recover = self._on_slo_recover
+
+    def _on_slo_breach(self, name: str, state: dict) -> None:
+        self.metrics.set_gauge("slo_breached", 1.0)
+        self.metrics.mark("slo_breach")
+        n_shed = int(len(self._queue) * self._slo_shed_fraction + 0.5)
+        for _ in range(n_shed):
+            self._shed_worst("slo")
+        if n_shed:
+            emit_event("slo_degrade_shed", slo=name, shed=n_shed,
+                       queue_depth=len(self._queue))
+
+    def _on_slo_recover(self, name: str, state: dict) -> None:
+        if not self.slo_monitor.breached():
+            self.metrics.set_gauge("slo_breached", 0.0)
+        self.metrics.mark("slo_recovered")
+
     # -- queue policy -------------------------------------------------------
 
-    def _shed_overflow(self) -> None:
-        while len(self._queue) > self.config.max_queue_depth:
-            # victim: lowest priority class (max number), then latest
-            # deadline (None = +inf sheds first), then latest arrival
-            def badness(iq):
-                i, r = iq
-                dl = float("inf") if r.deadline_t is None else r.deadline_t
-                return (r.priority, dl, self._order[i][1])
-            i, victim = max(enumerate(self._queue), key=badness)
-            self._queue.pop(i)
-            self._order.pop(i)
-            self._shed(victim, "queue_full")
+    def _shed_worst(self, reason: str) -> None:
+        """Shed one queued request: lowest priority class (max number),
+        then latest deadline (None = +inf sheds first), then latest
+        arrival."""
+        def badness(iq):
+            i, r = iq
+            dl = float("inf") if r.deadline_t is None else r.deadline_t
+            return (r.priority, dl, self._order[i][1])
+        if not self._queue:
+            return
+        i, victim = max(enumerate(self._queue), key=badness)
+        self._queue.pop(i)
+        self._order.pop(i)
+        self._shed(victim, reason)
+
+    def _shed_overflow(self, cap: Optional[int] = None,
+                       reason: str = "queue_full") -> None:
+        if cap is None:
+            cap = self.config.max_queue_depth
+        while len(self._queue) > cap:
+            self._shed_worst(reason)
 
     def _expire_deadlines(self) -> None:
         now = self._clock()
@@ -335,6 +426,15 @@ class ServingScheduler:
                     if ok:
                         self.engine.collect()   # streams own the tokens
                 self._sample_gauges()
+                if self.slo_monitor is not None:
+                    self.slo_monitor.tick()
+                    if self.slo_monitor.breached():
+                        # level-triggered remediation: the breach
+                        # transition shed once, but refilling traffic
+                        # must keep being trimmed while the latch holds
+                        cap = int(self.config.max_queue_depth
+                                  * (1 - self._slo_shed_fraction)) or 1
+                        self._shed_overflow(cap=cap, reason="slo")
         return self.pending
 
     def run(self, params, max_steps: Optional[int] = None) -> None:
@@ -463,6 +563,7 @@ class ServingScheduler:
         t.join(timeout)
         if t.is_alive():
             self._watchdog = (t, box)
+            flight_recorder.auto_dump("watchdog_timeout")
             raise ServingError(
                 "engine_failure",
                 f"engine.step exceeded step_timeout_s={timeout}")
@@ -479,6 +580,9 @@ class ServingScheduler:
         emit_event("degraded", error=repr(err) if err else None,
                    inflight=len(self._by_engine_rid),
                    queued=len(self._queue))
+        # postmortem while the torn state is still inspectable (no-op
+        # unless the flight recorder is armed with a dump dir)
+        flight_recorder.auto_dump("engine_step_failure")
         cause = f": {err}" if err is not None else ""
         for req in list(self._by_engine_rid.values()):
             try:
@@ -548,3 +652,36 @@ class ServingScheduler:
             m.set_gauge("cached_page_utilization",
                         mgr.num_cached_pages / usable if usable else 0.0)
             cache.update_gauges()
+
+    def statusz(self) -> Dict[str, Any]:
+        """Live scheduler state for the diagnostics server's /statusz:
+        queue composition, engine slot/page occupancy, lifecycle
+        counters, step timing."""
+        per_priority: Dict[int, int] = {}
+        for req in self._queue:
+            per_priority[req.priority] = per_priority.get(req.priority,
+                                                          0) + 1
+        mgr = self.engine.mgr
+        out: Dict[str, Any] = {
+            "queued": len(self._queue),
+            "queued_by_priority": {str(k): v for k, v in
+                                   sorted(per_priority.items())},
+            "inflight": len(self._by_engine_rid),
+            "degraded": self.degraded,
+            "slots": {"total": self.engine.num_slots,
+                      "free": self.engine.num_free_slots},
+            "pages": {"usable": mgr.usable_pages,
+                      "free": mgr.num_free_pages},
+            "counters": dict(self.metrics.counters),
+            "shed": dict(self.metrics.shed),
+            "step_ms": self.step_timer.step_ms.summary(),
+            "tokens_per_s": self.step_timer.tokens_per_s,
+        }
+        cache = getattr(self.engine, "cache", None)
+        if cache is not None:
+            out["pages"]["live"] = mgr.num_live_pages
+            out["pages"]["cached"] = mgr.num_cached_pages
+            out["prefix_cache"] = cache.snapshot()
+        if self.slo_monitor is not None:
+            out["slo"] = self.slo_monitor.states()
+        return out

@@ -177,6 +177,105 @@ def test_sink_delegation_keeps_public_prometheus_text_shape():
     assert 'paddle_resilience_save_latency_ms_bucket{le="+Inf"} 0' in rtext
 
 
+def test_registry_mismatched_relabeling_raises_clearly():
+    """ISSUE 5 satellite regression: re-registering a family with
+    different label NAMES (set or order) must raise at registration —
+    silently returning the existing family would make later
+    ``inc(**labels)`` calls key inconsistently between call sites."""
+    reg = MetricsRegistry()
+    reg.counter("req_total", labels=("op", "code"))
+    with pytest.raises(ValueError, match="label names"):
+        reg.counter("req_total", labels=("op",))          # subset
+    with pytest.raises(ValueError, match="label names"):
+        reg.counter("req_total", labels=("code", "op"))   # order
+    with pytest.raises(ValueError, match="label names"):
+        reg.counter("req_total")                          # unlabeled
+    with pytest.raises(TypeError, match="bare string"):
+        reg.counter("other_total", labels="op")           # str footgun
+    # histograms: silently reusing different bounds skews every later
+    # bucket read — also a registration-time error now
+    reg.histogram("lat_ms", bounds=(1, 10, 100))
+    with pytest.raises(ValueError, match="bounds"):
+        reg.histogram("lat_ms", bounds=(5, 50))
+    with pytest.raises(ValueError, match="quantiles"):
+        reg.histogram("lat_ms", bounds=(1, 10, 100), quantiles=(0.5,))
+    reg.histogram("lat_ms", bounds=(1, 10, 100))          # exact: reused
+
+
+def test_emit_is_exception_safe_and_counts_drops(tmp_path):
+    """ISSUE 5 satellite: event-log I/O failures must never propagate
+    into the emitting hot path; they count into
+    paddle_events_dropped_total instead."""
+    from paddle_tpu.observability.events import EventLog
+    log = EventLog(str(tmp_path / "ev.jsonl"))
+    log.emit("ok", n=1)
+    # turn the live file into a directory: the next append raises
+    # IsADirectoryError inside emit, which must be swallowed
+    os.remove(tmp_path / "ev.jsonl")
+    os.mkdir(tmp_path / "ev.jsonl")
+    dropped = get_registry().get("paddle_events_dropped_total")
+    before = dropped.value() if dropped is not None else 0.0
+    log.emit("doomed", n=2)                   # must not raise
+    log.emit("doomed", n=3)
+    after = get_registry().get("paddle_events_dropped_total").value()
+    assert after - before == 2
+
+
+def test_concurrent_metric_writes_race_the_scraper():
+    """ISSUE 5 satellite: N writer threads bumping labeled counters and
+    histograms while a scraper thread renders prometheus_text()/
+    snapshot(): no exceptions, exact totals, valid exposition."""
+    import threading
+
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "hits", labels=("worker",))
+    h = reg.histogram("lat_ms", "lat", labels=("worker",))
+    g = reg.gauge("depth")
+    N_THREADS, N_OPS = 8, 500
+    errors = []
+    start = threading.Barrier(N_THREADS + 1)
+
+    def writer(wid):
+        try:
+            start.wait()
+            for i in range(N_OPS):
+                c.inc(worker=f"w{wid}")
+                h.observe(float(i % 50), worker=f"w{wid}")
+                g.set(i)
+        except Exception as e:                # pragma: no cover
+            errors.append(e)
+
+    stop = threading.Event()
+
+    def scraper():
+        try:
+            start.wait()
+            while not stop.is_set():
+                text = reg.prometheus_text()
+                validate_exposition_text(text)
+                json.dumps(reg.snapshot())
+        except Exception as e:                # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(N_THREADS)]
+    s = threading.Thread(target=scraper)
+    for t in threads + [s]:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    s.join()
+    assert not errors, errors
+    assert c.total == N_THREADS * N_OPS       # no lost increments
+    for i in range(N_THREADS):
+        assert c.value(worker=f"w{i}") == N_OPS
+        assert h.hist(worker=f"w{i}").count == N_OPS
+    text = reg.prometheus_text()
+    validate_exposition_text(text)
+    assert f'hits_total{{worker="w0"}} {N_OPS}' in text
+
+
 # ---------------------------------------------------------------------------
 # trace-context propagation
 # ---------------------------------------------------------------------------

@@ -1,0 +1,73 @@
+"""Tooling lint for the diagnostics layer (ISSUE 5 satellite).
+
+Two architectural rules, enforced over the whole package source:
+
+1. **One debug surface.** ``http.server`` (and new raw ``socket``
+   listeners) live ONLY in ``observability/server.py`` — ad-hoc debug
+   endpoints fragment the operable surface and dodge the /healthz
+   semantics. The pre-existing collective-bootstrap networking
+   (``distributed/launch``, ``distributed/store``) is grandfathered: it
+   implements the training rendezvous protocol, not diagnostics.
+
+2. **Deterministic SLO math.** ``slo.py`` and ``goodput.py`` must never
+   read the wall clock (``time.time``): SLO windows advance only on the
+   injected step-driven clock, goodput only on durations fed by the
+   trainer — that is what makes breach/recover transitions and goodput
+   breakdowns byte-reproducible in chaos replays.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "paddle_tpu"
+
+
+def _offenders(pattern: re.Pattern, paths, allowed=()):
+    allowed = {PKG / a for a in allowed}
+    out = []
+    for path in sorted(paths):
+        if path in allowed:
+            continue
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if pattern.search(line):
+                out.append(f"{path.relative_to(REPO)}:{i}: {line.strip()}")
+    return out
+
+
+def test_http_server_only_in_diagserver():
+    pattern = re.compile(r"^\s*(import http\.server|from http\.server\b|"
+                         r"import http\b|from http import)")
+    offenders = _offenders(pattern, PKG.rglob("*.py"),
+                           allowed=("observability/server.py",))
+    assert not offenders, (
+        f"http.server outside observability/server.py: {offenders}; the "
+        "DiagServer is the ONE debug endpoint — register a /statusz "
+        "provider instead of opening another listener")
+
+
+def test_raw_sockets_only_in_sanctioned_modules():
+    pattern = re.compile(r"^\s*(import socket\b|from socket import)")
+    # distributed networking predates the rule and implements the
+    # launch/rendezvous protocol (not a diagnostics surface)
+    allowed = ("observability/server.py",
+               "distributed/launch/context.py",
+               "distributed/launch/master.py",
+               "distributed/store.py")
+    offenders = _offenders(pattern, PKG.rglob("*.py"), allowed=allowed)
+    assert not offenders, (
+        f"raw socket usage in {offenders}; new listeners belong in "
+        "observability/server.py (diagnostics) or the sanctioned "
+        "distributed rendezvous modules")
+
+
+def test_slo_and_goodput_never_read_wall_clock():
+    pattern = re.compile(r"time\.time\(")
+    paths = [PKG / "observability" / "slo.py",
+             PKG / "observability" / "goodput.py"]
+    assert all(p.exists() for p in paths)
+    offenders = _offenders(pattern, paths)
+    assert not offenders, (
+        f"wall-clock read in {offenders}; SLO/goodput math runs on "
+        "injected step-driven clocks only, so tests and chaos replays "
+        "stay deterministic")

@@ -1,0 +1,622 @@
+"""SLO engine + goodput accounting + flight recorder + DiagServer
+(ISSUE 5): multi-window burn rates with deterministic step-driven
+clocks, the serving E2E breach->shed->recover acceptance, goodput
+bucket attribution under chaos, debug-bundle round-trips, and the live
+diagnostics endpoints.
+"""
+
+import json
+import tarfile
+import tempfile
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.histogram import Histogram
+from paddle_tpu.distributed.checkpoint import TrainState
+from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                           GenerationConfig)
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability import (DiagServer, GoodputTracker, SLOMonitor,
+                                      StragglerDetector, flight_recorder,
+                                      get_registry, latency_objective,
+                                      ratio_objective)
+from paddle_tpu.observability import events as events_mod
+from paddle_tpu.observability.flight import FlightRecorder, flight_armed
+from paddle_tpu.observability.format import validate_exposition_text
+from paddle_tpu.observability.slo import hist_count_le
+from paddle_tpu.resilience import (Fault, FaultInjector, ResilienceConfig,
+                                   ResilientTrainer)
+from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:       # 503 healthz still has a body
+        if e.code == 503:
+            return e.code, e.read()
+        raise
+
+
+def _setup_serving(max_new=4, num_slots=2, chunk=2, seed=3, clock=None,
+                   **sched_kw):
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=seed)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new, seed=seed),
+        num_slots=num_slots, page_size=4, max_seq_len=32, chunk=chunk)
+    kw = {}
+    if clock is not None:
+        kw = {"clock": clock, "sleep": lambda s: None}
+    sched = ServingScheduler(eng, SchedulerConfig(**sched_kw), **kw)
+    return params, eng, sched
+
+
+@pytest.fixture()
+def disarmed_flight():
+    """Tests arm the GLOBAL recorder; always leave it disarmed+clean."""
+    yield flight_recorder
+    flight_recorder.disarm()
+    flight_recorder.clear()
+    flight_recorder._dump_dir = None
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math (pure, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_hist_count_le_exact_on_bucket_bounds():
+    h = Histogram(bounds=(10, 100, 1000))
+    for v in (5, 50, 500, 5000):
+        h.record(v)
+    assert hist_count_le(h, 10) == 1
+    assert hist_count_le(h, 100) == 2
+    assert hist_count_le(h, 1000) == 3
+    assert hist_count_le(h, 999) == 2     # straddling bucket counts as bad
+
+
+def test_objective_target_must_leave_budget():
+    with pytest.raises(ValueError):
+        ratio_objective("x", lambda: 0, lambda: 1, target=1.0)
+    with pytest.raises(ValueError):
+        ratio_objective("x", lambda: 0, lambda: 1, target=0.0)
+
+
+def test_breach_needs_fast_and_slow_windows():
+    """A short bad blip trips the fast window but not the slow one: no
+    breach (that is the point of multi-window rules)."""
+    clk = FakeClock()
+    bad, total = [0.0], [0.0]
+    mon = SLOMonitor(
+        [ratio_objective("err", lambda: bad[0], lambda: total[0],
+                         target=0.99)],
+        clock=clk, fast_window_s=10, slow_window_s=1000, burn_threshold=5)
+    # 200 good events over 1000s: slow window saturates with good traffic
+    for _ in range(200):
+        total[0] += 1
+        mon.tick()
+        clk.advance(5)
+    # a 10s burst of 100% errors: fast burn explodes, slow stays dilute
+    for _ in range(10):
+        bad[0] += 1
+        total[0] += 1
+        mon.tick()
+        clk.advance(1)
+    st = mon._states["err"]
+    assert st.fast_burn > 5
+    assert st.slow_burn < 5
+    assert mon.health() == "degraded"      # early warning, no page
+    assert not mon.breached()
+    # sustained errors: the slow window confirms, breach latches
+    for _ in range(400):
+        bad[0] += 1
+        total[0] += 1
+        mon.tick()
+        clk.advance(5)
+    assert mon.breached("err") and mon.health() == "breached"
+    # recovery: good traffic pushes the fast window back under
+    for _ in range(20):
+        total[0] += 10
+        mon.tick()
+        clk.advance(5)
+    assert not mon.breached() and mon.health() == "ok"
+
+
+def test_slo_events_and_gauges(tmp_path):
+    old = events_mod.event_log.path
+    events_mod.event_log.configure(str(tmp_path / "ev.jsonl"))
+    try:
+        clk = FakeClock()
+        bad, total = [0.0], [0.0]
+        mon = SLOMonitor(
+            [ratio_objective("err", lambda: bad[0], lambda: total[0],
+                             target=0.9)],
+            clock=clk, fast_window_s=10, slow_window_s=100,
+            burn_threshold=2)
+        for i in range(30):
+            bad[0] += 1
+            total[0] += 1
+            mon.tick()
+            clk.advance(1)
+        assert mon.breached("err")
+        for _ in range(30):
+            total[0] += 5
+            mon.tick()
+            clk.advance(1)
+        assert not mon.breached("err")
+        kinds = [json.loads(l)["kind"] for l in
+                 (tmp_path / "ev.jsonl").read_text().splitlines()]
+        assert "slo_breach" in kinds and "slo_recovered" in kinds
+        text = get_registry().prometheus_text()
+        validate_exposition_text(text)
+        assert 'paddle_slo_burn_rate{slo="err",window="fast"}' in text
+        assert 'paddle_slo_budget_remaining{slo="err"}' in text
+        assert get_registry().get(
+            "paddle_slo_breaches_total").value(slo="err") >= 1
+    finally:
+        events_mod.event_log.configure(old)
+
+
+def test_monitor_sample_granularity_is_bounded():
+    """A kHz tick loop must not grow the sample window unboundedly
+    (coalescing keeps burn math O(bounded) per tick)."""
+    clk = FakeClock()
+    total = [0.0]
+    mon = SLOMonitor([ratio_objective("e", lambda: 0.0, lambda: total[0],
+                                      target=0.99)],
+                     clock=clk, fast_window_s=300, slow_window_s=3600)
+    for _ in range(10_000):
+        total[0] += 1
+        mon.tick()
+        clk.advance(0.002)                 # 500 Hz step loop
+    assert len(mon._states["e"].samples) < 200
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: slow engine -> breach -> shed -> /healthz -> recover
+# ---------------------------------------------------------------------------
+
+def test_e2e_slo_breach_degrade_and_recovery(tmp_path):
+    """ISSUE 5 acceptance: injected slow engine steps breach the TTFT
+    fast window, a slo_breach event lands, /healthz flips to breached,
+    the scheduler's degrade callback sheds queued work, and after
+    latencies recover /healthz returns to ok — all on a fake clock, no
+    wall-clock sleeps."""
+    old = events_mod.event_log.path
+    events_mod.event_log.configure(str(tmp_path / "ev.jsonl"))
+    clk = FakeClock()
+    params, eng, sched = _setup_serving(clock=clk, max_queue_depth=16)
+    monitor = sched.make_slo_monitor(
+        ttft_p95_ms=200, max_shed_ratio=None,
+        fast_window_s=60, slow_window_s=600, burn_threshold=5)
+    assert sched.slo_monitor is monitor
+    srv = DiagServer(monitor=monitor)
+    srv.attach_scheduler(sched)
+    port = srv.start()
+    try:
+        slow = [True]
+        orig_step = eng.step
+
+        def injected(p):
+            clk.advance(1.0 if slow[0] else 0.001)   # 1000ms vs 1ms TTFT
+            return orig_step(p)
+
+        eng.step = injected
+
+        # slow phase: 2 slots busy, the rest queued behind slow steps
+        # (enough traffic that the breach lands while the queue is still
+        # populated — min_events suppresses the first few TTFTs)
+        handles = [sched.submit(np.array([3 + i, 5, 7], np.int32))
+                   for i in range(10)]
+        while sched.pending:
+            sched.step(params)
+            clk.advance(0.5)
+        assert monitor.breached("ttft")
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 503 and json.loads(body)["status"] == "breached"
+        # degrade callback fired: queued victims were shed with reason slo
+        assert sched.metrics.shed.get("slo", 0) >= 1
+        shed_handles = [h for h in handles if h.state == "shed"]
+        assert shed_handles
+        assert all(h.stream.error.code == "shed_slo" for h in shed_handles)
+        events = [json.loads(l) for l in
+                  (tmp_path / "ev.jsonl").read_text().splitlines()]
+        kinds = [e["kind"] for e in events]
+        assert "slo_breach" in kinds and "slo_degrade_shed" in kinds
+        breach = next(e for e in events if e["kind"] == "slo_breach")
+        assert breach["slo"] == "ttft" and breach["fast_burn"] > 5
+
+        # recovery: fast steps + the fast window sliding past the burst
+        slow[0] = False
+        for i in range(8):
+            sched.submit(np.array([9 + i % 4, 5, 7], np.int32))
+            while sched.pending:
+                sched.step(params)
+                clk.advance(10.0)
+        assert not monitor.breached()
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        assert "slo_recovered" in [
+            json.loads(l)["kind"] for l in
+            (tmp_path / "ev.jsonl").read_text().splitlines()]
+    finally:
+        srv.stop()
+        events_mod.event_log.configure(old)
+
+
+# ---------------------------------------------------------------------------
+# DiagServer endpoints
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_byte_identical():
+    """/metrics must be byte-identical to registry.prometheus_text().
+    A dedicated static registry keeps the comparison exact (the global
+    one mutates under dispatch telemetry)."""
+    from paddle_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("a_total", "a", labels=("k",)).inc(k="v")
+    reg.gauge("b").set(1.5)
+    reg.histogram("c_ms").observe(3.0)
+    srv = DiagServer(registry=reg)
+    port = srv.start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200
+        assert body == reg.prometheus_text().encode("utf-8")
+        validate_exposition_text(body.decode())
+    finally:
+        srv.stop()
+
+
+def test_statusz_aggregates_providers(disarmed_flight):
+    params, eng, sched = _setup_serving()
+    sched.submit(np.array([1, 2, 3], np.int32))
+    while sched.pending:
+        sched.step(params)
+    tracker = GoodputTracker()
+    tracker.note("productive", 1.0)
+    tracker.finalize(1.25)
+    srv = DiagServer()
+    srv.attach_scheduler(sched)
+    srv.attach_goodput(tracker)
+    port = srv.start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/statusz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["health"] == "ok"
+        s = doc["serving"]
+        assert s["queued"] == 0 and s["inflight"] == 0
+        assert s["slots"]["total"] == 2
+        assert s["pages"]["usable"] > 0
+        assert s["counters"]["requests_completed_total"] == 1
+        assert doc["goodput"]["goodput_ratio"] == 0.8
+        assert doc["flight_recorder"]["armed"] is False
+        status, _ = _get(f"http://127.0.0.1:{port}/statusz/")
+        assert status == 200                  # trailing slash tolerated
+    finally:
+        srv.stop()
+
+
+def test_statusz_includes_kvcache_provider():
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=4, seed=3), num_slots=2,
+        page_size=4, max_seq_len=32, chunk=2, prefix_cache=True)
+    sched = ServingScheduler(eng)
+    sched.submit(np.array([1, 2, 3, 4, 5], np.int32))
+    while sched.pending:
+        sched.step(params)
+    srv = DiagServer()
+    srv.attach_kvcache(eng.cache)
+    port = srv.start()
+    try:
+        _, body = _get(f"http://127.0.0.1:{port}/statusz")
+        kv = json.loads(body)["kvcache"]
+        assert {"hits", "misses", "pages"} <= set(kv)
+        assert kv["pages"]["usable"] > 0
+        assert kv["pages"]["cached"] >= 1     # retired prompt left cache
+    finally:
+        srv.stop()
+
+
+def test_unknown_route_404_and_health_composes_degraded():
+    srv = DiagServer()
+    srv.add_health_source("custom", lambda: "degraded")
+    port = srv.start()
+    try:
+        status, _ = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 200                  # degraded still serves
+        _, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert json.loads(body)["status"] == "degraded"
+        try:
+            _get(f"http://127.0.0.1:{port}/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + debug bundles
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_caps_and_disarmed_noop(disarmed_flight):
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.note_event({"kind": "e", "i": i})
+    assert len(fr._events) == 4
+    assert [e["i"] for e in fr._events] == [6, 7, 8, 9]   # last N win
+    # the global recorder's gate: nothing lands while disarmed
+    assert not flight_armed[0]
+    events_mod.emit_event("ignored", x=1)
+    assert len(flight_recorder._events) == 0
+
+
+def test_debug_bundle_roundtrip(tmp_path, disarmed_flight):
+    """Bundle round-trip: chrome trace loads, metrics snapshot parses,
+    the last-N events are present, slo.json carries objective states."""
+    clk = FakeClock()
+    mon = SLOMonitor([ratio_objective("err", lambda: 0.0, lambda: 1.0,
+                                      target=0.99)],
+                     clock=clk, fast_window_s=10, slow_window_s=100)
+    mon.tick()
+    flight_recorder.arm(capacity=16, dump_dir=str(tmp_path))
+    flight_recorder.attach_slo_monitor(mon)
+    for i in range(40):                       # ring keeps the last 16
+        events_mod.emit_event("tick", i=i)
+    from paddle_tpu.profiler.record import RecordEvent
+    with RecordEvent("unit.phase", args={"k": 1}):
+        pass
+    path = flight_recorder.dump_debug_bundle(reason="unit")
+    assert path.startswith(str(tmp_path))
+    with tarfile.open(path) as tar:
+        names = set(tar.getnames())
+        assert {"metrics.prom", "metrics.json", "events.jsonl",
+                "trace.json", "slo.json", "manifest.json"} <= names
+        snap = json.load(tar.extractfile("metrics.json"))
+        assert isinstance(snap, dict) and snap
+        validate_exposition_text(
+            tar.extractfile("metrics.prom").read().decode())
+        trace = json.load(tar.extractfile("trace.json"))
+        assert any(e["name"] == "unit.phase" and e["ph"] == "X"
+                   for e in trace["traceEvents"])
+        events = [json.loads(l) for l in
+                  tar.extractfile("events.jsonl").read().splitlines()]
+        ticks = [e for e in events if e["kind"] == "tick"]
+        assert [e["i"] for e in ticks] == list(range(24, 40))
+        slo = json.load(tar.extractfile("slo.json"))
+        assert slo[0]["slo"] == "err"
+        manifest = json.load(tar.extractfile("manifest.json"))
+        assert manifest["reason"] == "unit"
+
+
+def test_auto_dump_once_per_reason(tmp_path, disarmed_flight):
+    flight_recorder.arm(capacity=8, dump_dir=str(tmp_path))
+    p1 = flight_recorder.auto_dump("watchdog_timeout")
+    p2 = flight_recorder.auto_dump("watchdog_timeout")
+    assert p1 and Path(p1).exists()
+    assert p2 is None                         # rate-limited per reason
+    flight_recorder.disarm()
+    assert flight_recorder.auto_dump("other") is None  # disarmed: no-op
+
+
+def test_debugz_dump_endpoint(tmp_path, disarmed_flight):
+    flight_recorder.arm(capacity=8, dump_dir=str(tmp_path))
+    events_mod.emit_event("before_dump", n=1)
+    srv = DiagServer()
+    port = srv.start()
+    try:
+        _, body = _get(f"http://127.0.0.1:{port}/debugz")
+        st = json.loads(body)
+        assert st["armed"] is True and st["events"] >= 1
+        _, body = _get(f"http://127.0.0.1:{port}/debugz?dump=1")
+        dumped = json.loads(body)["dumped"]
+        assert Path(dumped).exists()
+        with tarfile.open(dumped) as tar:
+            events = [json.loads(l) for l in
+                      tar.extractfile("events.jsonl").read().splitlines()]
+        assert any(e["kind"] == "before_dump" for e in events)
+    finally:
+        srv.stop()
+
+
+def test_scheduler_degrade_auto_dumps(tmp_path, disarmed_flight):
+    """An unhandled engine-step exception exhausting the retry budget
+    degrades the scheduler AND leaves a postmortem bundle."""
+    flight_recorder.arm(capacity=32, dump_dir=str(tmp_path))
+    params, eng, sched = _setup_serving(max_step_retries=1)
+    sched._sleep = lambda s: None
+
+    def broken(p):
+        raise RuntimeError("kaboom")
+
+    eng.step = broken
+    h = sched.submit(np.array([1, 2, 3], np.int32))
+    sched.step(params)
+    assert sched.degraded and h.state == "failed"
+    bundles = list(Path(tmp_path).glob("*engine_step_failure*.tar.gz"))
+    assert len(bundles) == 1
+    with tarfile.open(bundles[0]) as tar:
+        events = [json.loads(l) for l in
+                  tar.extractfile("events.jsonl").read().splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert "step_retry" in kinds              # the ring saw the lead-up
+    assert "degraded" in kinds
+
+
+def test_nan_rollback_auto_dumps(tmp_path, disarmed_flight):
+    flight_recorder.arm(capacity=32, dump_dir=str(tmp_path / "dumps"))
+    net, opt, state = _make_train_state()
+    fi = FaultInjector([Fault("nan", 2)])
+    tr = ResilientTrainer(state, ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "ck"), save_interval=0,
+        install_signal_handlers=False, fault_injector=fi))
+    tr.run(_train_step(net, opt, fi), num_steps=4)
+    bundles = list((tmp_path / "dumps").glob("*nan_rollback*.tar.gz"))
+    assert len(bundles) == 1
+
+
+# ---------------------------------------------------------------------------
+# goodput + stragglers
+# ---------------------------------------------------------------------------
+
+def _make_train_state(seed=21):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = optimizer.AdamW(learning_rate=1e-2,
+                          parameters=net.parameters())
+    return net, opt, TrainState(net, opt)
+
+
+def _train_step(net, opt, injector=None):
+    def step(i):
+        if injector is not None and injector.fire("nan", i):
+            return float("nan")
+        x = paddle.to_tensor(np.random.RandomState(1000 + i)
+                             .randn(8, 4).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+    return step
+
+
+def test_goodput_tracker_breakdown_math():
+    t = GoodputTracker()
+    t.note("productive", 8.0)
+    t.note("retry", 1.0)
+    t.note("checkpoint_stall", 0.5)
+    out = t.finalize(10.0)
+    assert out["untracked_s"] == pytest.approx(0.5)
+    assert out["goodput_ratio"] == pytest.approx(0.8)
+    assert sum(v for k, v in out.items()
+               if k.endswith("_s") and k != "total_s") == \
+        pytest.approx(out["total_s"])
+    with pytest.raises(KeyError):
+        t.note("nonsense", 1.0)
+    assert get_registry().get("paddle_goodput_ratio").value() == \
+        pytest.approx(0.8)
+
+
+def test_goodput_chaos_attribution(tmp_path):
+    """ISSUE 5 acceptance: a chaos run's goodput components sum to the
+    run wall-clock within 1%, and the injected retry/rollback lands in
+    the right buckets."""
+    net, opt, state = _make_train_state()
+    fi = FaultInjector([Fault("step_error", 2), Fault("nan", 5)])
+    tr = ResilientTrainer(state, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), save_interval=3,
+        install_signal_handlers=False, fault_injector=fi,
+        retry_backoff=0.05, tokens_per_step=16))
+    out = tr.run(_train_step(net, opt, fi), num_steps=10)
+    g = out["goodput"]
+    parts = sum(v for k, v in g.items()
+                if k.endswith("_s") and k != "total_s")
+    assert abs(parts - g["total_s"]) <= 0.01 * g["total_s"]
+    assert g["retry_s"] >= 0.05               # >= one backoff sleep
+    assert g["rollback_replay_s"] > 0         # restore + replayed steps
+    assert g["checkpoint_stall_s"] > 0        # seed + interval saves
+    assert g["productive_s"] > 0
+    assert 0 < g["goodput_ratio"] < 1
+    assert ("step_error", 2) in fi.fired and ("nan", 5) in fi.fired
+
+
+def test_goodput_resets_between_runs(tmp_path):
+    """A reused trainer must not bill run 1's buckets against run 2's
+    wall clock."""
+    net, opt, state = _make_train_state()
+    tr = ResilientTrainer(state, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), save_interval=0,
+        install_signal_handlers=False))
+    step = _train_step(net, opt)
+    tr.run(step, num_steps=3)
+    g = tr.run(step, num_steps=6)["goodput"]
+    parts = sum(v for k, v in g.items()
+                if k.endswith("_s") and k != "total_s")
+    assert abs(parts - g["total_s"]) <= 0.01 * g["total_s"], g
+
+
+def test_breach_latch_keeps_trimming_refilled_queue():
+    """SLO remediation is level-triggered: while the breach latch
+    holds, every step caps the queue at
+    max_queue_depth * (1 - shed_fraction), so traffic refilling after
+    the transition shed keeps being trimmed."""
+    params, eng, sched = _setup_serving(max_queue_depth=12)
+    monitor = sched.make_slo_monitor(ttft_p95_ms=200)
+    monitor._states["ttft"].breached = True       # latch held
+    for i in range(12):
+        sched.submit(np.array([3 + i % 4, 5, 7], np.int32), priority=i)
+    sched.step(params)
+    # 2 admitted into slots; the queue must sit at the reduced cap of 6
+    assert len(sched._queue) == 6
+    assert sched.metrics.shed.get("slo", 0) == 4   # 12 - 2 admitted - 6
+    sched.submit(np.array([9, 5, 7], np.int32), priority=99)   # refill
+    sched.step(params)
+    assert len(sched._queue) <= 6                  # trimmed again
+    assert sched.metrics.shed.get("slo", 0) >= 5
+
+
+def test_slo_shed_objective_ignores_its_own_remediation():
+    """SLO-triggered sheds are the monitor's own remediation; counting
+    them as bad events would let a latency breach cascade into a
+    self-inflicted shed breach."""
+    params, eng, sched = _setup_serving()
+    sched.make_slo_monitor(max_shed_ratio=0.01)
+    shed_obj = sched.slo_monitor.objectives[-1]
+    m = sched.metrics
+    m.inc("requests_submitted_total", 100)
+    m.inc_shed("slo")
+    m.inc_shed("slo")
+    assert shed_obj.sample() == (0.0, 100.0)   # self-sheds not bad
+    m.inc_shed("queue_full")
+    assert shed_obj.sample() == (1.0, 100.0)   # real sheds still count
+
+
+def test_clean_run_goodput_is_high(tmp_path):
+    net, opt, state = _make_train_state()
+    tr = ResilientTrainer(state, ResilienceConfig(
+        checkpoint_dir=str(tmp_path), save_interval=0,
+        install_signal_handlers=False))
+    out = tr.run(_train_step(net, opt), num_steps=6)
+    g = out["goodput"]
+    assert g["retry_s"] == 0 and g["rollback_replay_s"] == 0
+    assert g["goodput_ratio"] > 0.5
+    assert out["stragglers"] == 0 or out["stragglers"] >= 0  # exported
+
+
+def test_straggler_detector_mad_zscore():
+    det = StragglerDetector(window=16, z_threshold=4.0, min_samples=8)
+    before = get_registry().get("paddle_stragglers_total") \
+        .value(source="unit")
+    for _ in range(12):
+        assert det.observe(0.100, source="unit") <= 4.0
+    z = det.observe(0.500, source="unit")      # 5x spike
+    assert z > 4.0 and det.flagged == 1
+    # uniform window (MAD=0) still scores via the median fallback
+    assert det.observe(0.101, source="unit") < 4.0
+    after = get_registry().get("paddle_stragglers_total") \
+        .value(source="unit")
+    assert after - before == 1
