@@ -1,0 +1,166 @@
+"""Fleet-router benchmark: shared-prompt storm over 4 replicas with one
+injected mid-storm replica death.
+
+Measures what the router tier actually buys:
+
+* **prefix affinity hit rate** — fraction of requests routed to the
+  replica whose cache already holds their prefix (the router-side radix
+  index doing its job);
+* **failover recovery p50** — ms from a request's failover to its
+  completion on the sibling (the mid-stream re-admission cost);
+* **TTFT delta vs single replica** — the same storm through a 1-replica
+  "fleet", so queueing relief is visible as a TTFT ratio.
+
+Emits ONE line of JSON (plus the shared ``_telemetry.py`` registry
+snapshot). Run: python benchmarks/bench_router.py
+(real chip; CPU smoke with JAX_PLATFORMS=cpu runs a tiny model).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
+
+
+def _build_fleet(n_replicas, cfg, max_new, num_slots, chunk, page_size,
+                 max_seq_len, prefix_cache):
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.serving import (FleetRouter, HealthConfig,
+                                    ReplicaHandle, RouterConfig,
+                                    SchedulerConfig)
+    replicas = []
+    for i in range(n_replicas):
+        eng = ContinuousBatchingEngine(
+            cfg, GenerationConfig(max_new_tokens=max_new),
+            num_slots=num_slots, page_size=page_size,
+            max_seq_len=max_seq_len, chunk=chunk,
+            prefix_cache=prefix_cache, check_invariants=False)
+        replicas.append(ReplicaHandle(
+            i, eng,
+            config=SchedulerConfig(max_queue_depth=256,
+                                   max_step_retries=1,
+                                   retry_backoff_s=0.005),
+            health_config=HealthConfig(eject_after=1,
+                                       probe_cooldown_s=60.0)))
+    return FleetRouter(replicas,
+                       config=RouterConfig(failover_backoff_s=0.005))
+
+
+def _storm(router, params, prompts, kill_replica=None, kill_after_steps=2,
+           max_steps=200_000):
+    handles = [router.submit(p) for p in prompts]
+    steps = 0
+    while router.pending:
+        router.step(params)
+        steps += 1
+        if kill_replica is not None and steps == kill_after_steps:
+            router.replicas[kill_replica].kill()
+        if steps >= max_steps:
+            raise RuntimeError("storm did not converge")
+    return handles
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.ops._common import is_tpu_platform
+
+    on_tpu = is_tpu_platform(jax.devices()[0].platform)
+    if on_tpu:
+        cfg = L.llama_tiny(num_hidden_layers=8, hidden_size=1024)
+        n_req, max_new, num_slots, chunk = 64, 32, 8, 8
+        page_size, prefix_len, max_seq_len = 16, 64, 256
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        n_req, max_new, num_slots, chunk = 24, 6, 2, 2
+        page_size, prefix_len, max_seq_len = 4, 8, 32
+    params = L.init_stacked_params(cfg, seed=0)
+
+    # shared-prompt storm: 75% of requests share one system prefix
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    prompts = []
+    for i in range(n_req):
+        tail = rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(2, 5)),)).astype(np.int32)
+        prompts.append(np.concatenate([shared, tail]) if i % 4 else tail)
+
+    def fleet(n):
+        return _build_fleet(n, cfg, max_new, num_slots, chunk, page_size,
+                            max_seq_len, prefix_cache=True)
+
+    from paddle_tpu.observability import get_registry
+
+    # single-replica baseline: untimed warmup storms on the SAME router
+    # (two passes: the first warms the prefix caches and router index,
+    # the second follows the warm-index routing and compiles its
+    # admission shapes — the measured storm then runs compile-free)
+    router1 = fleet(1)
+    _storm(router1, params, prompts)
+    _storm(router1, params, prompts)
+    t0 = time.perf_counter()
+    h1 = _storm(router1, params, prompts)
+    wall_1 = time.perf_counter() - t0
+    ttft_1 = [h.ttft_ms for h in h1 if h.ttft_ms is not None]
+
+    # 4-replica fleet, same warmup discipline; storm B measures routing
+    # (affinity + TTFT), storm C on the SAME warm fleet kills replica 1
+    # mid-flight and measures failover recovery
+    router4 = fleet(4)
+    _storm(router4, params, prompts)
+    _storm(router4, params, prompts)
+    t0 = time.perf_counter()
+    h4 = _storm(router4, params, prompts)
+    wall_4 = time.perf_counter() - t0
+    ttft_4 = [h.ttft_ms for h in h4 if h.ttft_ms is not None]
+    hk = _storm(router4, params, prompts, kill_replica=1)
+    assert all(h.stream.finished for h in h4 + hk)
+    failed_over = [h for h in hk if h.failovers > 0]
+    recovery_ms = [(h.finish_t - h.failover_t) * 1e3 for h in failed_over
+                   if h.failover_t is not None and h.finish_t is not None]
+
+    out = {
+        "bench": "router",
+        "platform": "tpu" if on_tpu else "cpu",
+        "replicas": 4,
+        "requests": n_req,
+        "shared_prefix_tokens": prefix_len,
+        "affinity_hit_rate": round(
+            sum(h.routed_by_affinity for h in h4) / n_req, 4),
+        "completed": sum(h.state == "done" for h in h4),
+        "failovers": sum(h.failovers for h in hk),
+        "failover_recovery_ms_p50": round(_percentile(recovery_ms, 50), 3),
+        "ttft_ms_p50_fleet": round(_percentile(ttft_4, 50), 3),
+        "ttft_ms_p50_single": round(_percentile(ttft_1, 50), 3),
+        "ttft_p50_delta_vs_single": round(
+            _percentile(ttft_4, 50) - _percentile(ttft_1, 50), 3),
+        "wall_s_fleet": round(wall_4, 3),
+        "wall_s_single": round(wall_1, 3),
+    }
+    # unified-telemetry snapshot (shared shape: benchmarks/_telemetry.py)
+    from _telemetry import metrics_snapshot
+
+    ms = metrics_snapshot()
+    snap = get_registry().snapshot()
+    ms["router_requests_total"] = snap.get("paddle_router_requests_total",
+                                           {})
+    ms["router_failovers_total"] = snap.get("paddle_router_failovers_total",
+                                            0.0)
+    out["metrics_snapshot"] = ms
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

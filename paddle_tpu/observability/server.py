@@ -90,6 +90,13 @@ class DiagServer:
         self.add_health_source(
             "serving", lambda: "breached" if sched.degraded else "ok")
 
+    def attach_router(self, router) -> None:
+        """Fleet router: the whole-fleet /statusz view (per-replica
+        scheduler + breaker state) and fleet health — 503 only once NO
+        replica can take traffic."""
+        self.add_statusz("router", router.statusz)
+        self.add_health_source("router", router.fleet_health)
+
     def attach_goodput(self, tracker) -> None:
         self.add_statusz("goodput", tracker.breakdown)
 

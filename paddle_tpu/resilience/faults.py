@@ -15,13 +15,28 @@ event                asked by
                      raises; exercises bounded retry)
 ``preempt``          ``ResilientTrainer`` before running a step (SIGTERM
                      to self — the real preemption signal path)
+``replica_die``      ``FleetRouter.step`` per replica (the replica raises
+                     on every subsequent step — a dead engine)
+``replica_stall``    ``FleetRouter.step`` per replica (the replica raises
+                     for a bounded wall-clock window — a hung step the
+                     watchdog would flag — then recovers)
+``replica_slow``     ``FleetRouter.step`` per replica (each step sleeps
+                     extra for a bounded window — a straggling replica)
 ===================  ======================================================
 
 Each scheduled fault fires exactly once (``fire`` consumes it), so a
 rollback-and-replay of the same step proceeds clean — which is what makes
 chaos runs deterministic and byte-identical to uninterrupted ones. Tests
 may schedule custom events (e.g. ``nan``) and query them from their own
-step functions. ``fired`` records every (event, step) that triggered.
+step functions. ``fired`` records every (event, step) that triggered —
+replica-scoped faults append ``(event, step, replica)``.
+
+Replica scoping: a :class:`Fault` may carry a ``replica`` id. The router
+asks ``fire(event, step, replica=r)`` for each replica every step; a
+fault with ``replica=None`` acts as a wildcard (consumed by the first
+replica that asks at its step), while a replica-scoped fault fires only
+for its replica. The one-shot consumption contract is unchanged, so a
+router chaos run replays byte-for-byte from the same schedule.
 
 This module is also the only place allowed to write checkpoint bytes
 outside the atomic-write helper — it exists to corrupt them on purpose.
@@ -41,15 +56,20 @@ class ChaosError(RuntimeError):
 @dataclass(frozen=True)
 class Fault:
     """One scheduled fault: ``event`` fires when the runtime reaches
-    ``step`` (for save events, the step being saved)."""
+    ``step`` (for save events, the step being saved). ``replica``
+    narrows a fleet fault to one replica id (None = unscoped: trainer
+    faults, or a wildcard consumed by the first replica that asks)."""
     event: str
     step: int
+    replica: Optional[int] = None
 
 
 @dataclass
 class FaultInjector:
     schedule: List[Fault] = field(default_factory=list)
-    fired: List[Tuple[str, int]] = field(default_factory=list)
+    #: (event, step) for unscoped faults, (event, step, replica) for
+    #: replica-scoped ones — unpack accordingly when a schedule mixes both
+    fired: List[Tuple] = field(default_factory=list)
 
     @classmethod
     def seeded(cls, seed: int, num_steps: int,
@@ -70,13 +90,56 @@ class FaultInjector:
         return [f for f in self.schedule
                 if event is None or f.event == event]
 
-    def fire(self, event: str, step: int) -> bool:
-        """True (and consume) iff a fault for (event, step) is scheduled."""
+    @classmethod
+    def seeded_replicas(cls, seed: int, num_steps: int, num_replicas: int,
+                        events: Sequence[str] = ("replica_die",
+                                                 "replica_stall",
+                                                 "replica_slow"),
+                        n_faults: int = 2) -> "FaultInjector":
+        """A reproducible replica-scoped schedule for router chaos runs:
+        same seed → same (event, step, replica) triples. Steps are
+        1-based (1..num_steps) to match ``FleetRouter.step`` numbering —
+        the router increments its counter before asking, so a step-0
+        fault could never fire. Triples are unique: the router consumes
+        at most one (event, step, replica) per step, so a duplicate
+        could never fire and would silently thin the chaos run."""
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        num_steps = max(num_steps, 1)
+        num_replicas = max(num_replicas, 1)
+        n_faults = min(n_faults, num_steps * len(events) * num_replicas)
+        faults: List[Fault] = []
+        seen = set()
+        while len(faults) < n_faults:
+            f = Fault(events[int(rng.choice(len(events)))],
+                      int(rng.choice(num_steps)) + 1,
+                      replica=int(rng.choice(num_replicas)))
+            if f in seen:
+                continue
+            seen.add(f)
+            faults.append(f)
+        faults.sort(key=lambda f: (f.step, f.event, f.replica))
+        return cls(schedule=faults)
+
+    def fire(self, event: str, step: int,
+             replica: Optional[int] = None) -> bool:
+        """True (and consume) iff a fault for (event, step) is scheduled.
+        With ``replica`` given, replica-scoped faults must match it
+        exactly; unscoped faults act as a wildcard. A replica-scoped
+        fault never fires for an unscoped query."""
         for f in self.schedule:
-            if f.event == event and f.step == int(step):
-                self.schedule.remove(f)
+            if f.event != event or f.step != int(step):
+                continue
+            if f.replica is not None and (replica is None
+                                          or int(replica) != f.replica):
+                continue
+            self.schedule.remove(f)
+            if replica is None and f.replica is None:
                 self.fired.append((event, int(step)))
-                return True
+            else:
+                r = f.replica if f.replica is not None else int(replica)
+                self.fired.append((event, int(step), r))
+            return True
         return False
 
     # -- corruption tools (deliberately non-atomic writes) ------------------

@@ -5,8 +5,11 @@ The inference stack's ``ContinuousBatchingEngine`` is a closed batch loop;
 this package adds the request-serving layer the ROADMAP north star calls
 for: a priority/deadline admission scheduler with load shedding and
 cancellation (:mod:`.scheduler`), per-request streaming token delivery
-(:mod:`.stream`), and TTFT/ITL/utilization metrics exported as Prometheus
-text and profiler trace events (:mod:`.metrics`).
+(:mod:`.stream`), TTFT/ITL/utilization metrics exported as Prometheus
+text and profiler trace events (:mod:`.metrics`), and the fleet tier —
+a prefix-aware router over N engine replicas with circuit-breaker
+failure detection, graceful drain and mid-stream failover
+(:mod:`.router`, :mod:`.replica`, :mod:`.health`).
 
 Quick start::
 
@@ -25,7 +28,12 @@ Quick start::
     print(sched.metrics.to_prometheus_text())
 """
 
+from .health import (  # noqa: F401
+    HealthConfig, HealthTracker, ReplicaState,
+)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .replica import ReplicaFault, ReplicaHandle  # noqa: F401
+from .router import FleetRouter, RouterConfig, RouterRequest  # noqa: F401
 from .scheduler import (  # noqa: F401
     RequestState, SchedulerConfig, ServingRequest, ServingScheduler,
 )
@@ -34,4 +42,6 @@ from .stream import ServingError, TokenStream  # noqa: F401
 __all__ = [
     "Histogram", "ServingMetrics", "RequestState", "SchedulerConfig",
     "ServingRequest", "ServingScheduler", "ServingError", "TokenStream",
+    "HealthConfig", "HealthTracker", "ReplicaState", "ReplicaFault",
+    "ReplicaHandle", "FleetRouter", "RouterConfig", "RouterRequest",
 ]

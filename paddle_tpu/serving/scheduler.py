@@ -14,7 +14,10 @@ needs on top of it:
   class); queued requests whose deadline lapses before admission are
   shed as ``deadline``;
 * **cancellation** — queued or mid-decode; a live cancel retires the
-  engine slot immediately and returns its pages to the pool;
+  engine slot immediately and returns its pages to the pool; a request
+  parked on the retry/backoff path (``submit(defer_s=...)`` — the fleet
+  router's failover resubmissions) cancels idempotently: a later
+  promotion tick can never resurrect it;
 * **robustness** — optional per-step wall-clock timeout and bounded
   retry-with-exponential-backoff around ``engine.step``; after the retry
   budget is spent the scheduler *degrades gracefully*: every in-flight
@@ -128,6 +131,10 @@ class ServingRequest:
     _submit_ns: int = field(default=0, repr=False)  # perf-clock twin of
     # submit_t (submit_t may come from an injected/fake scheduler clock;
     # trace spans need the real perf_counter_ns timeline)
+    _ready_t: float = field(default=0.0, repr=False)   # deferred requests
+    _key: tuple = field(default=(), repr=False)        # (priority, seq)
+    _no_shed: bool = field(default=False, repr=False)  # remediation: never
+    # a queue-cap/SLO shed victim (deadlines still apply)
 
     @property
     def done(self) -> bool:
@@ -158,6 +165,10 @@ class ServingScheduler:
         self._seq = 0                       # FIFO tiebreak within priority
         self._queue: List[ServingRequest] = []   # sorted by (priority, seq)
         self._order: List[tuple] = []            # parallel (priority, seq)
+        # deferred admissions (retry/backoff): requests parked here until
+        # the clock passes their _ready_t, then promoted into the queue
+        # at their original (priority, seq) position
+        self._backoff: List[ServingRequest] = []
         self._requests: Dict[int, ServingRequest] = {}
         self._by_engine_rid: Dict[int, ServingRequest] = {}
         self._watchdog: Optional[tuple] = None   # (thread, result box)
@@ -179,13 +190,25 @@ class ServingScheduler:
     def submit(self, prompt, priority: int = 0,
                deadline_ms: Optional[float] = None,
                max_new_tokens: Optional[int] = None,
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> ServingRequest:
+               on_token: Optional[Callable[[int], None]] = None,
+               defer_s: Optional[float] = None,
+               no_shed: bool = False) -> ServingRequest:
         """Queue a request. ``priority`` is a class (0 = most urgent, FIFO
         within a class); ``deadline_ms`` is the admission SLO relative to
         now — a request still queued past it is shed; ``max_new_tokens``
         overrides the engine default budget; ``on_token`` streams tokens
-        synchronously as chunks unpack. Returns the request handle (its
+        synchronously as chunks unpack. ``defer_s`` parks the request in
+        the backoff area until the scheduler clock passes ``now +
+        defer_s`` (the retry/backoff path: the fleet router resubmits
+        failed-over requests this way); deferred requests keep their
+        arrival (priority, FIFO) position, count toward ``pending``, can
+        be cancelled, and expire against their deadline like any queued
+        request — but are exempt from queue-cap and SLO shedding while
+        parked AND after promotion (they are remediation, not fresh
+        load; a full queue sheds fresh victims around them, never them).
+        ``no_shed`` grants the same exemption to an immediate
+        (non-deferred) submission — the router's drain handoffs.
+        Returns the request handle (its
         ``.stream`` is the consumption surface). The handle may come back
         already shed if the queue cap evicts it immediately.
 
@@ -234,14 +257,43 @@ class ServingScheduler:
                                       trace_id=req.trace_id)
         req._span.begin()
         self._requests[rid] = req
-        key = (req.priority, self._seq)
+        req._key = (req.priority, self._seq)
         self._seq += 1
-        i = bisect.bisect(self._order, key)
-        self._order.insert(i, key)
-        self._queue.insert(i, req)
         self.metrics.inc("requests_submitted_total")
+        # deferred (failover) and explicitly-marked (drain handoff)
+        # submissions are remediation traffic: exempt from queue-cap/SLO
+        # shedding for good
+        req._no_shed = bool(no_shed) or (defer_s is not None
+                                         and defer_s > 0)
+        if defer_s is not None and defer_s > 0:
+            req._ready_t = now + defer_s
+            self._backoff.append(req)
+            return req
+        self._enqueue(req)
         self._shed_overflow()
         return req
+
+    def _enqueue(self, req: ServingRequest) -> None:
+        i = bisect.bisect(self._order, req._key)
+        self._order.insert(i, req._key)
+        self._queue.insert(i, req)
+
+    def _promote_backoff(self) -> None:
+        """Move due deferred requests into the admission queue. A request
+        cancelled (or otherwise finished) while parked here must NEVER be
+        re-admitted by this tick — cancel() removes it from the backoff
+        list, and the ``done`` filter catches any straggler reference."""
+        if not self._backoff:
+            return
+        now = self._clock()
+        due = [r for r in self._backoff
+               if now >= r._ready_t and not r.done]
+        self._backoff = [r for r in self._backoff
+                         if now < r._ready_t and not r.done]
+        for req in sorted(due, key=lambda r: r._key):
+            self._enqueue(req)
+        if due:
+            self._shed_overflow()
 
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request; frees its engine slot and
@@ -250,9 +302,15 @@ class ServingScheduler:
         if req is None or req.done:
             return False
         if req.state == RequestState.QUEUED:
-            i = self._queue.index(req)
-            self._queue.pop(i)
-            self._order.pop(i)
+            if req in self._backoff:
+                # parked on the retry/backoff path: removing it here is
+                # what keeps cancel-after-retry idempotent — a later
+                # promotion tick must not resurrect it
+                self._backoff.remove(req)
+            else:
+                i = self._queue.index(req)
+                self._queue.pop(i)
+                self._order.pop(i)
         elif req.state == RequestState.RUNNING:
             self.engine.cancel(req.engine_rid)
             self._by_engine_rid.pop(req.engine_rid, None)
@@ -323,10 +381,13 @@ class ServingScheduler:
         self.metrics.set_gauge("slo_breached", 1.0)
         self.metrics.mark("slo_breach")
         n_shed = int(len(self._queue) * self._slo_shed_fraction + 0.5)
+        shed = 0
         for _ in range(n_shed):
-            self._shed_worst("slo")
-        if n_shed:
-            emit_event("slo_degrade_shed", slo=name, shed=n_shed,
+            if not self._shed_worst("slo"):
+                break       # only no-shed remediation requests remain
+            shed += 1
+        if shed:
+            emit_event("slo_degrade_shed", slo=name, shed=shed,
                        queue_depth=len(self._queue))
 
     def _on_slo_recover(self, name: str, state: dict) -> None:
@@ -336,27 +397,33 @@ class ServingScheduler:
 
     # -- queue policy -------------------------------------------------------
 
-    def _shed_worst(self, reason: str) -> None:
+    def _shed_worst(self, reason: str) -> bool:
         """Shed one queued request: lowest priority class (max number),
         then latest deadline (None = +inf sheds first), then latest
-        arrival."""
+        arrival. Remediation requests (``_no_shed`` — the router's
+        failover resubmissions) are never victims; False when nothing
+        sheddable remains."""
         def badness(iq):
             i, r = iq
             dl = float("inf") if r.deadline_t is None else r.deadline_t
             return (r.priority, dl, self._order[i][1])
-        if not self._queue:
-            return
-        i, victim = max(enumerate(self._queue), key=badness)
+        sheddable = [(i, r) for i, r in enumerate(self._queue)
+                     if not r._no_shed]
+        if not sheddable:
+            return False
+        i, victim = max(sheddable, key=badness)
         self._queue.pop(i)
         self._order.pop(i)
         self._shed(victim, reason)
+        return True
 
     def _shed_overflow(self, cap: Optional[int] = None,
                        reason: str = "queue_full") -> None:
         if cap is None:
             cap = self.config.max_queue_depth
         while len(self._queue) > cap:
-            self._shed_worst(reason)
+            if not self._shed_worst(reason):
+                break       # only remediation left: cap soft-exceeded
 
     def _expire_deadlines(self) -> None:
         now = self._clock()
@@ -368,6 +435,14 @@ class ServingScheduler:
                 keep_q.append(req)
                 keep_o.append(key)
         self._queue, self._order = keep_q, keep_o
+        if self._backoff:
+            lapsed = [r for r in self._backoff
+                      if r.deadline_t is not None and now > r.deadline_t]
+            if lapsed:
+                self._backoff = [r for r in self._backoff
+                                 if r not in lapsed]
+                for req in lapsed:
+                    self._shed(req, "deadline")
 
     def _shed(self, req: ServingRequest, reason: str) -> None:
         self._finish(req, RequestState.SHED, f"shed:{reason}",
@@ -395,21 +470,60 @@ class ServingScheduler:
 
     @property
     def pending(self) -> int:
-        """Requests still queued or mid-decode."""
+        """Requests still queued, parked in backoff, or mid-decode."""
+        return (len(self._queue) + len(self._backoff)
+                + len(self._by_engine_rid))
+
+    @property
+    def active(self) -> int:
+        """Requests the engine can make progress on THIS step (queued or
+        mid-decode; deferred backoff requests excluded)."""
         return len(self._queue) + len(self._by_engine_rid)
+
+    @property
+    def queue_depth(self) -> int:
+        """Admission pressure: queued + deferred-backoff requests (the
+        fleet router's per-decision load signal — O(1), unlike the full
+        ``statusz()`` document)."""
+        return len(self._queue) + len(self._backoff)
+
+    @property
+    def inflight(self) -> int:
+        """Requests currently decoding in engine slots."""
+        return len(self._by_engine_rid)
 
     def step(self, params) -> int:
         """One scheduler round: expire deadlines, admit into free slots,
-        run a robust engine step, account. Returns ``pending``."""
+        run a robust engine step, account. Returns ``pending``.
+
+        Ordinary engine exceptions stay inside the retry/degrade
+        machinery; a non-``Exception`` ``BaseException`` (KeyboardInterrupt,
+        SystemExit, a fatal runtime death) would otherwise fly past it
+        and leave every consumer stream blocked forever — those drain the
+        scheduler (terminal errors on every stream) and re-raise."""
         if self.degraded:
             return 0
+        try:
+            self._step_inner(params)
+        except BaseException as e:
+            if not isinstance(e, Exception):
+                self._degrade(e)
+            raise
+        return self.pending
+
+    def _step_inner(self, params) -> None:
         # each scheduler round gets its own trace id, so the step's op
         # dispatches correlate in the chrome trace (per-request lanes use
         # the request trace ids minted at submit)
         with trace_context(step=int(self.metrics.counters.get(
                 "steps_total", 0))):
             with self.metrics.span("step"):
+                # expire BEFORE promoting: a deferred request whose
+                # deadline lapsed while parked must shed as "deadline",
+                # not first enter the queue (its no_shed exemption would
+                # wrongfully push a viable fresh request over the cap)
                 self._expire_deadlines()
+                self._promote_backoff()
                 self._admit()
                 if self._by_engine_rid:
                     t0 = self._clock()
@@ -435,7 +549,6 @@ class ServingScheduler:
                         cap = int(self.config.max_queue_depth
                                   * (1 - self._slo_shed_fraction)) or 1
                         self._shed_overflow(cap=cap, reason="slo")
-        return self.pending
 
     def run(self, params, max_steps: Optional[int] = None) -> None:
         """Drive ``step`` until every request resolves (or degradation)."""
@@ -443,10 +556,20 @@ class ServingScheduler:
         while self.pending and not self.degraded:
             self.step(params)
             steps += 1
-            if max_steps is not None and steps >= max_steps:
+            if self.pending and max_steps is not None \
+                    and steps >= max_steps:
                 raise RuntimeError(
                     f"serving loop exceeded max_steps={max_steps} with "
                     f"{self.pending} requests pending")
+            if self.pending and self.active == 0:
+                # only deferred backoff requests remain: nothing is
+                # progressable until the clock passes the earliest ready
+                # time — sleep straight to it instead of hot-spinning
+                # (and exhausting max_steps on no-op rounds)
+                wait = (min(r._ready_t for r in self._backoff)
+                        - self._clock())
+                if wait > 0:
+                    self._sleep(wait)
 
     def _admit(self) -> None:
         """Feed the engine only requests it can place THIS step — a free
@@ -594,13 +717,14 @@ class ServingScheduler:
                                       f"engine step failed repeatedly"
                                       f"{cause}", rid=req.rid))
         self._by_engine_rid.clear()
-        for req in self._queue:
+        for req in self._queue + self._backoff:
             self._finish(req, RequestState.FAILED, "failed",
                          ServingError("engine_failure",
                                       f"engine degraded before admission"
                                       f"{cause}", rid=req.rid))
         self._queue.clear()
         self._order.clear()
+        self._backoff.clear()
 
     # -- engine hook targets ------------------------------------------------
 
@@ -666,6 +790,7 @@ class ServingScheduler:
             "queued": len(self._queue),
             "queued_by_priority": {str(k): v for k, v in
                                    sorted(per_priority.items())},
+            "backoff": len(self._backoff),
             "inflight": len(self._by_engine_rid),
             "degraded": self.degraded,
             "slots": {"total": self.engine.num_slots,

@@ -18,11 +18,20 @@ Consumption models, all safe to mix:
 End of stream carries a reason (``"complete"``, ``"cancelled"``,
 ``"shed:queue_full"``, ``"shed:deadline"``, ``"failed"``) and, for
 failures, a structured :class:`ServingError`.
+
+A consumer blocked in ``get()``/iteration must never hang forever on a
+producer that died without closing the stream (an engine crash that
+skips the finish callback, a router torn down by a fatal error).
+:meth:`TokenStream.attach_producer` binds a liveness predicate: blocking
+waits poll it, and the moment it reports the producer dead the stream
+self-closes with a terminal ``ServingError("producer_dead")`` instead of
+blocking indefinitely — no consumer-side timeout needed.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterator, List, Optional
 
 
@@ -57,6 +66,20 @@ class TokenStream:
         self.finished = False
         self.finish_reason: Optional[str] = None
         self.error: Optional[ServingError] = None
+        self._alive_fn: Optional[Callable[[], bool]] = None
+        self._poll_s = 0.05
+
+    def attach_producer(self, alive_fn: Callable[[], bool],
+                        poll_s: float = 0.05) -> None:
+        """Bind a producer-liveness predicate (see module docstring):
+        while it returns True, blocking consumers wait normally; once it
+        returns False and the stream is still open, the next blocked (or
+        blocking) consumer closes it with a terminal
+        ``ServingError("producer_dead")`` and unblocks everyone."""
+        with self._cond:
+            self._alive_fn = alive_fn
+            self._poll_s = float(poll_s)
+            self._cond.notify_all()
 
     # -- producer side (scheduler) ------------------------------------------
 
@@ -72,12 +95,35 @@ class TokenStream:
     def close(self, reason: str, error: Optional[ServingError] = None
               ) -> None:
         with self._cond:
-            if self.finished:
-                return
-            self.finished = True
-            self.finish_reason = reason
-            self.error = error
-            self._cond.notify_all()
+            self._close_locked(reason, error)
+
+    def _close_locked(self, reason: str,
+                      error: Optional[ServingError]) -> None:
+        if self.finished:
+            return
+        self.finished = True
+        self.finish_reason = reason
+        self.error = error
+        self._cond.notify_all()
+
+    def _producer_died_locked(self) -> bool:
+        """Under the lock: terminally close an open stream whose bound
+        producer reports dead. Returns True when the stream is (now)
+        closed because of it."""
+        if self._alive_fn is None or self.finished:
+            return False
+        try:
+            alive = self._alive_fn()
+        except Exception:
+            alive = False            # a torn liveness probe IS death
+        if alive:
+            return False
+        self._close_locked(
+            "failed",
+            ServingError("producer_dead",
+                         f"producer for request {self.rid} died without "
+                         "finishing the stream", rid=self.rid))
+        return True
 
     # -- consumer side ------------------------------------------------------
 
@@ -96,13 +142,26 @@ class TokenStream:
 
     def get(self, timeout: Optional[float] = None) -> Optional[int]:
         """Blocking: next undrained token, or None at end-of-stream (or
-        on timeout)."""
+        on timeout, or when a bound producer died — the stream then
+        carries a terminal ``producer_dead`` error)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._cond:
             while self._cursor >= len(self._tokens):
                 if self.finished:
                     return None
-                if not self._cond.wait(timeout):
+                if self._producer_died_locked():
                     return None
+                if deadline is None:
+                    wait_t = self._poll_s if self._alive_fn is not None \
+                        else None
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait_t = (remaining if self._alive_fn is None
+                              else min(remaining, self._poll_s))
+                self._cond.wait(wait_t)
             tok = self._tokens[self._cursor]
             self._cursor += 1
             return tok

@@ -14,6 +14,13 @@ Two architectural rules, enforced over the whole package source:
    injected step-driven clock, goodput only on durations fed by the
    trainer — that is what makes breach/recover transitions and goodput
    breakdowns byte-reproducible in chaos replays.
+
+3. **Replica encapsulation** (ISSUE 6 satellite). Nothing outside
+   ``paddle_tpu/serving/`` reaches into ``ReplicaHandle`` privates
+   (``._scheduler``, ``._fault``): the router's public surface
+   (``submit``/``cancel``/``step``/``statusz``/``health``/chaos
+   methods) is the replica contract, and bypassing it would let other
+   layers race the breaker/drain state machine.
 """
 
 import re
@@ -59,6 +66,25 @@ def test_raw_sockets_only_in_sanctioned_modules():
         f"raw socket usage in {offenders}; new listeners belong in "
         "observability/server.py (diagnostics) or the sanctioned "
         "distributed rendezvous modules")
+
+
+def test_replica_handle_privates_only_in_serving():
+    pattern = re.compile(r"\._(?:scheduler|fault)\b")
+    offenders = []
+    for sub in ("paddle_tpu", "tests", "benchmarks"):
+        for path in sorted((REPO / sub).rglob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            if (rel.startswith("paddle_tpu/serving/")
+                    or path == Path(__file__).resolve()):
+                continue
+            for i, line in enumerate(path.read_text().splitlines(), 1):
+                if pattern.search(line):
+                    offenders.append(f"{rel}:{i}")
+    assert not offenders, (
+        f"ReplicaHandle private access in {offenders}; route through the "
+        "public replica surface (submit/cancel/step/statusz/health) or "
+        "the FleetRouter — the breaker/drain state machine owns those "
+        "internals")
 
 
 def test_slo_and_goodput_never_read_wall_clock():

@@ -419,6 +419,172 @@ def test_determinism_under_fixed_seed():
     assert run_once() == run_once()
 
 
+def test_deferred_submit_admits_only_after_backoff():
+    """submit(defer_s=...) parks the request on the retry/backoff path:
+    not admitted while the clock is short of ready time, admitted (at
+    its original priority/FIFO position) once it passes."""
+    cfg, params, eng, sched, clock = _setup(num_slots=2)
+    p = _prompts(cfg, 1, rng_seed=20)[0]
+    h = sched.submit(p, defer_s=1.0)
+    assert sched.pending == 1
+    sched.step(params)
+    assert h.state == RequestState.QUEUED and h.engine_rid is None
+    assert sched.statusz()["backoff"] == 1
+    clock.advance(1.5)
+    sched.run(params, max_steps=200)
+    assert h.state == RequestState.DONE
+    assert h.stream.result() == _greedy_ref(params, cfg, p, 5)
+
+
+def test_run_waits_out_backoff_instead_of_spinning():
+    """run() with only deferred requests pending sleeps the backoff
+    instead of burning max_steps on no-op rounds (fake-clock sleeps
+    advance the clock, so the deferral comes due and completes)."""
+    cfg, params, eng, sched, clock = _setup()
+    h = sched.submit(_prompts(cfg, 1, rng_seed=26)[0], defer_s=2.0)
+    sched.run(params, max_steps=60)     # would exhaust if hot-spinning
+    assert h.state == RequestState.DONE
+
+
+def test_cancel_in_backoff_queue_is_idempotent():
+    """Regression (ISSUE 6 satellite): a request cancelled while parked
+    in the backoff queue must NOT be re-admitted by a later retry tick —
+    the cancel permanently removes it, and a second cancel is a no-op."""
+    cfg, params, eng, sched, clock = _setup(num_slots=2)
+    p = _prompts(cfg, 1, rng_seed=21)[0]
+    h = sched.submit(p, defer_s=0.5)
+    assert sched.cancel(h.rid)
+    assert h.state == RequestState.CANCELLED
+    assert h.stream.finish_reason == "cancelled"
+    assert not sched.cancel(h.rid)          # idempotent
+    clock.advance(2.0)                      # retry tick comes due...
+    for _ in range(3):
+        sched.step(params)
+    # ...and must not resurrect the cancelled request
+    assert sched.pending == 0
+    assert h.engine_rid is None and not eng._queue and not eng._live
+    assert sched.metrics.counters["requests_cancelled_total"] == 1
+    assert sched.metrics.counters.get("requests_completed_total", 0) == 0
+
+
+def test_promoted_backoff_request_exempt_from_queue_cap_shed():
+    """A failover-remediation request (submit(defer_s=...)) promoted
+    into a full queue must never be the queue_full victim — fresh load
+    sheds around it (review fix: the promoted request used to be the
+    newest arrival and thus the FIRST victim)."""
+    cfg, params, eng, sched, clock = _setup(num_slots=1, max_queue_depth=2)
+    ps = _prompts(cfg, 5, rng_seed=24)
+    h_run = sched.submit(ps[0])
+    sched.step(params)                          # occupies the only slot
+    h_a = sched.submit(ps[1])
+    h_b = sched.submit(ps[2])                   # queue now AT the cap
+    h_remed = sched.submit(ps[3], defer_s=0.1)  # remediation traffic
+    clock.advance(0.5)
+    sched.step(params)          # promotion pushes the queue over cap:
+    # the remediation request is the newest arrival (highest seq) but a
+    # FRESH request must be the queue_full victim, never it
+    assert h_remed.state != RequestState.SHED
+    assert h_b.state == RequestState.SHED
+    assert h_b.stream.finish_reason == "shed:queue_full"
+    sched.run(params, max_steps=300)
+    assert h_remed.state == RequestState.DONE
+    assert h_remed.stream.result() == _greedy_ref(params, cfg, ps[3], 5)
+    assert all(h.state == RequestState.DONE for h in (h_run, h_a))
+
+
+def test_deferred_request_deadline_expires_in_backoff():
+    cfg, params, eng, sched, clock = _setup()
+    h = sched.submit(_prompts(cfg, 1, rng_seed=22)[0], deadline_ms=100,
+                     defer_s=10.0)
+    clock.advance(0.5)                      # deadline lapses while parked
+    sched.step(params)
+    assert h.state == RequestState.SHED
+    assert h.stream.finish_reason == "shed:deadline"
+
+
+def test_lapsed_deferred_request_never_displaces_fresh_load():
+    """A deferred request whose deadline AND defer both lapsed must shed
+    as deadline without transiting the queue — its no_shed exemption
+    must not push a viable fresh request over the cap on the way out."""
+    cfg, params, eng, sched, clock = _setup(num_slots=1, max_queue_depth=2)
+    ps = _prompts(cfg, 4, rng_seed=25)
+    h_run = sched.submit(ps[0])
+    sched.step(params)                      # occupies the slot
+    h_a = sched.submit(ps[1])
+    h_b = sched.submit(ps[2])               # queue at the cap
+    h_dead = sched.submit(ps[3], deadline_ms=50, defer_s=0.1)
+    clock.advance(0.5)                      # defer due AND deadline gone
+    sched.step(params)
+    assert h_dead.state == RequestState.SHED
+    assert h_dead.stream.finish_reason == "shed:deadline"
+    assert h_a.state != RequestState.SHED   # nobody wrongfully displaced
+    assert h_b.state != RequestState.SHED
+    sched.run(params, max_steps=300)
+    assert all(h.state == RequestState.DONE for h in (h_run, h_a, h_b))
+
+
+# ---------------------------------------------------------------------------
+# stream robustness: producers that die without closing
+# ---------------------------------------------------------------------------
+
+def test_stream_producer_death_unblocks_consumer():
+    """A blocking consumer with NO timeout gets a terminal
+    producer_dead error when the bound producer dies, instead of
+    blocking indefinitely."""
+    from paddle_tpu.serving import TokenStream
+    alive = [True]
+    stream = TokenStream(0)
+    stream.attach_producer(lambda: alive[0], poll_s=0.01)
+    got = []
+
+    def consume():
+        got.append(stream.get())            # blocking, timeout-free
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()                     # genuinely blocked
+    alive[0] = False                        # producer dies silently
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got == [None]
+    assert stream.finished and stream.finish_reason == "failed"
+    with pytest.raises(ServingError) as ei:
+        stream.result()
+    assert ei.value.code == "producer_dead"
+
+
+def test_fatal_engine_death_closes_streams_terminally():
+    """An engine dying with a non-Exception BaseException (fatal runtime
+    death) skips the finish callback entirely; the scheduler must drain
+    every stream with a terminal error before re-raising, so a blocked
+    consumer thread unblocks without any timeout."""
+
+    class FatalDeath(BaseException):
+        pass
+
+    cfg, params, eng, sched, _ = _setup(num_slots=2)
+
+    def dying_step(p):
+        raise FatalDeath("runtime died")
+
+    eng.step = dying_step
+    hs = [sched.submit(p) for p in _prompts(cfg, 2, rng_seed=23)]
+    got = []
+    t = threading.Thread(target=lambda: got.extend(hs[0].stream))
+    t.start()
+    with pytest.raises(FatalDeath):
+        sched.step(params)
+    t.join(timeout=10)
+    assert not t.is_alive()                 # consumer unblocked
+    assert sched.degraded
+    for h in hs:
+        assert h.state == RequestState.FAILED
+        with pytest.raises(ServingError) as ei:
+            h.stream.result()
+        assert ei.value.code == "engine_failure"
+
+
 # ---------------------------------------------------------------------------
 # end-to-end acceptance + metrics
 # ---------------------------------------------------------------------------
