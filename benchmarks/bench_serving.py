@@ -104,7 +104,83 @@ def main():
     # dedicated shared-prefix study lives in bench_prefix_cache.py
     out["kvcache"] = eng.cache.snapshot()
     assert all(h.done for h in handles)
+
+    # length-diverse "storm": the recompile cliff study. Unified ragged
+    # step vs the legacy bucketed pipeline on the same cold engine +
+    # prompt-length spread + mid-decode admissions; recompile counts and
+    # compile seconds come straight from the RecompileDetector.
+    if on_tpu:
+        storm_kw = dict(n_req=48, max_new=32, num_slots=8, chunk=8,
+                        prompt_lens=(16, 1024), max_seq_len=2048)
+    else:
+        storm_kw = dict(n_req=16, max_new=8, num_slots=4, chunk=2,
+                        prompt_lens=(4, 48), max_seq_len=64)
+    out["storm"] = {
+        "prompt_lens": list(storm_kw["prompt_lens"]),
+        "requests": storm_kw["n_req"],
+        "unified": _storm(cfg, params, True, **storm_kw),
+        "legacy": _storm(cfg, params, False, **storm_kw),
+    }
     print(json.dumps(out))
+
+
+def _storm(cfg, params, unified, *, n_req, max_new, num_slots, chunk,
+           prompt_lens, max_seq_len):
+    """One cold engine through a length-diverse storm with mid-decode
+    admissions; reports recompiles, compile wall time, TTFT/ITL p50/p95
+    and tok/s so the unified-vs-legacy delta is a one-line diff."""
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.observability.runtime import recompiles
+    from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new),
+        num_slots=num_slots, page_size=16, max_seq_len=max_seq_len,
+        chunk=chunk, unified=unified)
+    sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=n_req))
+    rng = np.random.RandomState(1)
+    lens = rng.randint(prompt_lens[0], prompt_lens[1] + 1, n_req)
+    prompts = [rng.randint(1, cfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in lens]
+    fns = ("cbe.unified_step", "cbe.prefill", "cbe.decode_chunk")
+    rc0 = {f: recompiles.count(f) for f in fns}
+    cs0 = {f: recompiles.compile_seconds_total(f) for f in fns}
+
+    t0 = time.perf_counter()
+    # a third lands up front; the rest trickle in MID-DECODE, so every
+    # admission joins live traffic (the legacy path pays a fresh
+    # (bucket, batch) prefill compile whenever the mix shifts)
+    upfront = max(1, n_req // 3)
+    handles = [sched.submit(p) for p in prompts[:upfront]]
+    i = upfront
+    steps = 0
+    while sched.pending or i < n_req:
+        if i < n_req and steps % 2 == 0:
+            handles.append(sched.submit(prompts[i]))
+            i += 1
+        sched.step(params)
+        steps += 1
+        if steps > 200_000:
+            raise RuntimeError("storm stalled")
+    wall = time.perf_counter() - t0
+    assert all(h.done for h in handles)
+
+    m = sched.metrics
+    ttft = m.histograms["ttft_ms"]
+    itl = m.histograms["itl_ms"]
+    return {
+        "recompiles": int(sum(recompiles.count(f) - rc0[f] for f in fns)),
+        "compile_s": round(sum(
+            recompiles.compile_seconds_total(f) - cs0[f] for f in fns), 3),
+        "tokens_per_s": round(
+            m.counters["tokens_generated_total"] / wall, 2),
+        "wall_s": round(wall, 3),
+        "ttft_ms": {"p50": round(ttft.percentile(0.5), 3),
+                    "p95": round(ttft.percentile(0.95), 3)},
+        "itl_ms": {"p50": round(itl.percentile(0.5), 3),
+                   "p95": round(itl.percentile(0.95), 3)},
+    }
 
 
 def _next_pow2(n, minimum=32):

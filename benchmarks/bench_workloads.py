@@ -474,10 +474,12 @@ def bench_decode_cb():
     eng.serve(params, prompts[:slots])
     compiled_prefill = eng._compiled_prefill
     compiled_chunk = eng._decode_chunk
+    compiled_unified = eng._unified_step      # the (one) unified program
 
     eng = make_engine()
     eng._compiled_prefill = compiled_prefill
     eng._decode_chunk = compiled_chunk
+    eng._unified_step = compiled_unified
     t0 = time.perf_counter()
     outs = eng.serve(params, prompts)
     dt = time.perf_counter() - t0

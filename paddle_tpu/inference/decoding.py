@@ -294,6 +294,21 @@ class ContinuousBatchingEngine:
     shapes (slots, page pool, block-table width) never change, so nothing
     recompiles at runtime.
 
+    Unified ragged step (default, ``unified=True``): the WHOLE round —
+    prefill chunks of newly admitted prompts, warm-prefix/COW suffixes
+    and every decoding row — is ONE dispatch of one compiled program
+    (``models.llama.ragged_step`` over
+    ``ops.paged_attention.ragged_paged_attention``). Rows are metadata
+    arrays padded to the fixed slot count, so the program's shape is
+    invariant to the request mix: exactly one compile-cache entry ever
+    (O(1) recompiles across a length-diverse storm), and a prompt
+    submitted mid-decode joins the current step's batch immediately.
+    ``unified=False`` keeps the legacy pipeline — bucketed prefill waves
+    (``_build_prefill``), the warm-suffix variant
+    (``_build_prefill_suffix``) and the per-shape decode chunk
+    (``_build_decode_chunk``) — for A/B benches; both paths emit
+    byte-identical greedy tokens.
+
     Host-fence discipline (the axon tunnel makes every device->host value
     dependency a full round trip): the ONLY transfer per round is the
     decode chunk's emitted tokens. Slot tokens live on device (admission
@@ -314,7 +329,8 @@ class ContinuousBatchingEngine:
                  num_slots: int = 8, page_size: int = 16,
                  max_seq_len: int = 2048, num_pages: Optional[int] = None,
                  chunk: int = 16, prefix_cache: bool = False,
-                 check_invariants: bool = True):
+                 check_invariants: bool = True, unified: bool = True,
+                 step_tokens: Optional[int] = None):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -358,8 +374,18 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros((num_slots,), np.int32)
         self._bt = np.zeros((num_slots, self._table_width), np.int32)
         self._rng = jax.random.key(self.config.seed)
+        # legacy (unified=False) per-shape compile caches; the unified
+        # path needs exactly ONE compiled step function
         self._compiled_prefill: Dict[Tuple, Callable] = {}
         self._decode_chunk = None
+        # unified ragged step: one program serving mixed prefill+decode
+        # rows; its shape depends only on (slots, chunk, step_tokens,
+        # table width) fixed at construction — O(1) recompiles by design
+        self._unified = unified
+        self._step_tokens = max(step_tokens or
+                                max(num_slots, chunk, page_size), num_slots)
+        self._unified_step = None
+        self._pend = [None] * num_slots   # per-slot unfed prompt suffix
         #: prompt tokens actually run through prefill (cache hits skip
         #: their cached prefix; benchmarks diff this against submitted
         #: prompt lengths for the skip ratio)
@@ -482,18 +508,14 @@ class ContinuousBatchingEngine:
             return True
         return False
 
-    def _admit(self, params):
-        """Fill free slots from the queue: allocate pages, prefill into the
-        slots, record the first generated tokens.
-
-        Round-5: admissions are BATCHED — every free slot fillable this
-        round goes through ONE prefill call per prompt bucket (B padded to
-        the next power of two so the compile cache stays small; pad rows
-        write into the reserved garbage page 0 and their sampled tokens
-        are discarded). A one-at-a-time B=1 prefill wave was ~1/3 of the
-        mixed-workload serve wall time at 16 slots — batch-1 prefills
-        leave the MXU almost idle."""
-        cfg = self.config
+    def _admit_pick(self):
+        """Shared admission bookkeeping (host metadata only): pop queued
+        requests into free slots, resolve the prefix cache (shared pages,
+        COW copy), allocate pages. Returns the picked
+        ``(slot, req, pages_row, prompt_len, n_cached)`` list; the legacy
+        path then runs bucketed prefill dispatches over it while the
+        unified path just queues the suffix tokens into the next ragged
+        step."""
         picked = []                # (slot, req, pages_row, lp, n_cached)
         for s in range(self.num_slots):
             if self._slot_rid[s] is not None or not self._queue:
@@ -548,6 +570,21 @@ class ContinuousBatchingEngine:
                 pages = self.mgr.allocate(req.rid, total)
             self.mgr._lens[req.rid] = lp
             picked.append((s, req, pages, lp, n_cached))
+        return picked
+
+    def _admit(self, params):
+        """Legacy (unified=False) admission: allocate pages, prefill into
+        the slots, record the first generated tokens.
+
+        Round-5: admissions are BATCHED — every free slot fillable this
+        round goes through ONE prefill call per prompt bucket (B padded to
+        the next power of two so the compile cache stays small; pad rows
+        write into the reserved garbage page 0 and their sampled tokens
+        are discarded). A one-at-a-time B=1 prefill wave was ~1/3 of the
+        mixed-workload serve wall time at 16 slots — batch-1 prefills
+        leave the MXU almost idle."""
+        cfg = self.config
+        picked = self._admit_pick()
         if not picked:
             return
         # group by (SUFFIX bucket, warm): cold rows NEVER share a group
@@ -581,12 +618,14 @@ class ContinuousBatchingEngine:
                 lens[i] = lp - nc
                 starts[i] = nc
             key = ("sfx", bucket, b_pad) if warm else (bucket, b_pad)
-            if key not in self._compiled_prefill:
+            fresh = key not in self._compiled_prefill
+            if fresh:
                 recompiles.record_miss("cbe.prefill", key)
                 self._compiled_prefill[key] = (
                     self._build_prefill_suffix(bucket) if warm
                     else self._build_prefill(bucket))
             self._rng, sub = jax.random.split(self._rng)
+            c0 = time.perf_counter() if fresh else 0.0
             t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
             if warm:
                 tok, self.mgr.k_pages, self.mgr.v_pages = \
@@ -600,6 +639,12 @@ class ContinuousBatchingEngine:
                         params, jnp.asarray(ids), jnp.asarray(lens),
                         self.mgr.k_pages, self.mgr.v_pages,
                         jnp.asarray(rows), sub)
+            if fresh:
+                # first call of a new shape = trace+compile; surface the
+                # warmup cost in paddle_runtime_compile_seconds{fn}
+                jax.block_until_ready(tok)
+                recompiles.observe_compile("cbe.prefill",
+                                           time.perf_counter() - c0)
             self._prefill_tokens += int(sum(it[3] - it[4] for it in items))
             if t0_ns:
                 # one batched prefill serves several requests: emit one
@@ -654,25 +699,67 @@ class ContinuousBatchingEngine:
         self._slot_rid[s] = None
         self._bt[s] = 0
         self._pos[s] = 0
+        self._pend[s] = None
+
+    def _deliver_tokens(self, s, tokens) -> bool:
+        """Unpack one slot's emitted tokens: append to the request, fire
+        ``token_callback`` per token (surviving a reentrant in-place
+        cancel from inside the callback), retire on completion. Shared
+        verbatim by the legacy and unified steps — the reentrancy
+        contract must never fork. Returns True while the slot's request
+        keeps decoding (caller may advance its position mirror)."""
+        rid = self._slot_rid[s]
+        req = self._live[rid]
+        for t in tokens:
+            req.tokens.append(int(t))
+            if self.token_callback is not None:
+                self.token_callback(rid, int(t))
+                if self._slot_rid[s] != rid:
+                    return False   # callback cancelled this request
+            if self._complete(req):
+                break
+        if self._slot_rid[s] != rid:
+            return False           # already retired by a reentrant cancel
+        if self._complete(req):
+            self._retire(s)
+            return False
+        return True
 
     def step(self, params) -> int:
-        """One admit + decode-chunk round (ONE device->host transfer: the
-        chunk's emitted tokens). Returns the live count after the round."""
+        """One admit + decode round (ONE device->host transfer: the
+        step's emitted tokens). Returns the live count after the round.
+
+        Unified mode (default): admission is host bookkeeping only and
+        the round is ONE ragged dispatch — newly admitted prompts join
+        the current step's packed batch immediately, alongside every
+        decoding row. Legacy mode replays the pre-unified pipeline
+        (bucketed prefill waves + per-shape decode chunk)."""
+        if self._unified:
+            return self._step_unified(params)
+        return self._step_legacy(params)
+
+    def _step_legacy(self, params) -> int:
         self._admit(params)
         if not self._live:
             if self._check_invariants:
                 self.mgr.check_conservation()
             return 0
-        if self._decode_chunk is None:
+        fresh_chunk = self._decode_chunk is None
+        if fresh_chunk:
             recompiles.record_miss("cbe.decode_chunk",
                                    (self.num_slots, self.chunk))
             self._decode_chunk = self._build_decode_chunk()
+            c0 = time.perf_counter()
         self._rng, sub = jax.random.split(self._rng)
         t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
         toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
             self._decode_chunk(params, self._tok_dev,
                                jnp.asarray(self._pos), self.mgr.k_pages,
                                self.mgr.v_pages, jnp.asarray(self._bt), sub)
+        if fresh_chunk:
+            jax.block_until_ready(toks)
+            recompiles.observe_compile("cbe.decode_chunk",
+                                       time.perf_counter() - c0)
         toks = np.asarray(toks)                    # the one fence
         if t0_ns:
             t1_ns = time.perf_counter_ns()
@@ -686,23 +773,9 @@ class ContinuousBatchingEngine:
                           args={"request_id": rid, "slot": s,
                                 "chunk": self.chunk})
         for s in range(self.num_slots):
-            rid = self._slot_rid[s]
-            if rid is None:
+            if self._slot_rid[s] is None:
                 continue
-            req = self._live[rid]
-            for t in toks[s]:
-                req.tokens.append(int(t))
-                if self.token_callback is not None:
-                    self.token_callback(rid, int(t))
-                    if self._slot_rid[s] != rid:
-                        break   # callback cancelled this request in-place
-                if self._complete(req):
-                    break
-            if self._slot_rid[s] != rid:
-                continue        # already retired by a reentrant cancel
-            if self._complete(req):
-                self._retire(s)
-            else:
+            if self._deliver_tokens(s, toks[s]):
                 self._pos[s] += self.chunk
         # idle slots decode into the garbage page; their host positions
         # stay pinned at 0 so they never run past the rope cache
@@ -710,6 +783,201 @@ class ContinuousBatchingEngine:
             if self._check_invariants:
                 # the ownership-model anchor: every page is free, live
                 # (refcounted) or cached — checked after EVERY step
+                self.mgr.check_conservation()
+            self.cache.update_gauges()
+        return len(self._live)
+
+    # -- unified ragged step (the default serving path) ----------------------
+
+    def _build_unified_step(self):
+        """ONE compiled program for every step the engine will ever run:
+        ``chunk`` micro-rounds of the ragged model step
+        (models.llama.ragged_step) under one ``lax.scan``. Per micro-round
+        every decoding row advances one token (its sampled carry feeds
+        back in-program, so a chunk still costs one host round-trip) and
+        prefilling rows consume the next span of their prompt from the
+        host-planned packed layout. Shapes depend only on (slots, chunk,
+        step_tokens, table width) — the request mix, prompt lengths and
+        admission timing never recompile anything."""
+        L = self._L
+        mcfg = self.model_config
+        cfg = self.config
+        n_rows = self.num_slots
+
+        def run(params, ids, use_carry, token_row, positions, kv_lens,
+                last_idx, sample_mask, tok, k_pages, v_pages, bt, key):
+            def micro(carry, xs):
+                tok, kp, vp, key = carry
+                ids_k, uc_k, tr_k, pos_k, kvl_k, li_k, sm_k = xs
+                row_c = jnp.clip(tr_k, 0, n_rows - 1)
+                # decode slots take the row's carry token (last sample);
+                # prefill slots take the host-fed prompt tokens
+                ids_eff = jnp.where(uc_k, jnp.take(tok, row_c), ids_k)
+                logits, kp, vp = L.ragged_step(
+                    params, ids_eff, tr_k, pos_k, kvl_k, li_k, kp, vp,
+                    bt, mcfg)
+                key, sub = jax.random.split(key)
+                nxt = _sample(logits, sub, cfg)            # (R,)
+                # emit the INPUT carry: step outputs chain across steps
+                # and a finished prefill's first sample arrives with the
+                # row's first decode round (same contract as the legacy
+                # decode chunk)
+                emit = tok
+                tok = jnp.where(sm_k, nxt, tok)
+                return (tok, kp, vp, key), emit
+
+            (tok, k_pages, v_pages, _), toks = jax.lax.scan(
+                micro, (tok, k_pages, v_pages, key),
+                (ids, use_carry, token_row, positions, kv_lens, last_idx,
+                 sample_mask))
+            return toks, tok, k_pages, v_pages             # toks (K, R)
+
+        return jax.jit(run, donate_argnums=(9, 10))
+
+    def _plan_step(self):
+        """Host-side layout of one unified step: simulate ``chunk``
+        micro-rounds over the live slots, packing each round's tokens
+        into the fixed ``step_tokens`` axis. Decode rows (no pending
+        prompt) always claim one slot each; prefill rows share the
+        remaining budget in slot order, transitioning to decode the
+        round after their prompt completes. Returns the device metadata
+        arrays plus host-only unpack masks; advances the slot mirrors
+        (positions, pending suffixes)."""
+        K, tb, n_rows = self.chunk, self._step_tokens, self.num_slots
+        ids = np.zeros((K, tb), np.int32)
+        use_carry = np.zeros((K, tb), bool)
+        token_row = np.full((K, tb), -1, np.int32)
+        positions = np.zeros((K, tb), np.int32)
+        kv_lens = np.zeros((K, n_rows), np.int32)
+        last_idx = np.zeros((K, n_rows), np.int32)
+        sample_mask = np.zeros((K, n_rows), bool)
+        emit = np.zeros((K, n_rows), bool)
+        fed = np.zeros((n_rows,), np.int64)   # prefill tokens consumed
+        pos = self._pos.astype(np.int64).copy()
+        rem = {s: len(self._pend[s]) for s in range(n_rows)
+               if self._slot_rid[s] is not None and self._pend[s] is not None}
+        for k in range(K):
+            live = [s for s in range(n_rows)
+                    if self._slot_rid[s] is not None]
+            budget = tb - sum(1 for s in live if rem.get(s, 0) == 0)
+            take = {}
+            for s in live:
+                if rem.get(s, 0) > 0:
+                    take[s] = min(rem[s], budget)
+                    budget -= take[s]
+            cursor = 0
+            for s in live:
+                if rem.get(s, 0) > 0:          # prefilling
+                    n = take[s]
+                    if n == 0:
+                        continue               # starved this round
+                    sl = slice(cursor, cursor + n)
+                    ids[k, sl] = self._pend[s][fed[s]:fed[s] + n]
+                    token_row[k, sl] = s
+                    positions[k, sl] = pos[s] + np.arange(n)
+                    pos[s] += n
+                    fed[s] += n
+                    rem[s] -= n
+                    last_idx[k, s] = cursor + n - 1
+                    if rem[s] == 0:
+                        # prompt complete: this round's last logits are
+                        # the row's first sample (kept in the carry)
+                        sample_mask[k, s] = True
+                    cursor += n
+                else:                          # decoding
+                    use_carry[k, cursor] = True
+                    token_row[k, cursor] = s
+                    positions[k, cursor] = pos[s]
+                    pos[s] += 1
+                    last_idx[k, s] = cursor
+                    sample_mask[k, s] = True
+                    emit[k, s] = True
+                    cursor += 1
+                kv_lens[k, s] = pos[s]
+        self._pos = pos.astype(np.int32)
+        for s in list(rem):
+            self._pend[s] = (None if rem[s] == 0
+                             else self._pend[s][fed[s]:])
+        return (ids, use_carry, token_row, positions, kv_lens, last_idx,
+                sample_mask), emit, fed
+
+    def _step_unified(self, params) -> int:
+        """One ragged round: host-only admission, ONE dispatch serving
+        the mixed prefill+decode batch, unpack. The single device→host
+        transfer is the step's emitted tokens — identical host-fence
+        discipline to the legacy path, minus its prefill dispatches."""
+        picked = self._admit_pick()
+        for s, req, pages, lp, nc in picked:
+            self._slot_rid[s] = req.rid
+            self._live[req.rid] = req
+            self._pos[s] = nc                 # next position to write
+            self._bt[s] = 0
+            self._bt[s, :len(pages)] = pages
+            # a warm/COW suffix row IS "a row whose first position > 0";
+            # cold rows just start at 0 — one code path for all three
+            # legacy programs
+            self._pend[s] = np.asarray(req.prompt[nc:], np.int32)
+        if not self._live:
+            if self._check_invariants:
+                self.mgr.check_conservation()
+            return 0
+        fresh = self._unified_step is None
+        if fresh:
+            # the engine's ONE compile-cache miss (plus at most one
+            # device remat): every later step reuses this program
+            recompiles.record_miss(
+                "cbe.unified_step",
+                (self.num_slots, self.chunk, self._step_tokens,
+                 self._table_width))
+            self._unified_step = self._build_unified_step()
+        plan, emit, fed = self._plan_step()
+        # tokens that actually run through prefill THIS step (cancelled
+        # mid-prefill requests never inflate the skip-ratio math)
+        self._prefill_tokens += int(fed.sum())
+        self._rng, sub = jax.random.split(self._rng)
+        if fresh:
+            c0 = time.perf_counter()   # dispatch-only window, like legacy
+        t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
+        toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
+            self._unified_step(
+                params, *(jnp.asarray(a) for a in plan), self._tok_dev,
+                self.mgr.k_pages, self.mgr.v_pages, jnp.asarray(self._bt),
+                sub)
+        if fresh:
+            jax.block_until_ready(toks)
+            recompiles.observe_compile("cbe.unified_step",
+                                       time.perf_counter() - c0)
+        toks = np.asarray(toks)                    # the one fence
+        if t0_ns:
+            # per-request phase spans over the dispatch window: the
+            # trace keeps its prefill/decode lanes even though both now
+            # ride one program
+            t1_ns = time.perf_counter_ns()
+            for s in range(self.num_slots):
+                rid = self._slot_rid[s]
+                if rid is None:
+                    continue
+                req = self._live[rid]
+                if fed[s] > 0:
+                    emit_span("engine.prefill", t0_ns, t1_ns,
+                              event_type="Operator", trace_id=req.trace_id,
+                              args={"request_id": rid, "slot": s,
+                                    "prefill_tokens": int(fed[s])})
+                if emit[:, s].any():
+                    emit_span("engine.decode_chunk", t0_ns, t1_ns,
+                              event_type="Operator", trace_id=req.trace_id,
+                              args={"request_id": rid, "slot": s,
+                                    "chunk": int(emit[:, s].sum())})
+        for s in range(self.num_slots):
+            if self._slot_rid[s] is None:
+                continue
+            self._deliver_tokens(
+                s, (toks[k, s] for k in range(self.chunk) if emit[k, s]))
+        if self.cache is not None:
+            if self._check_invariants:
+                # the ownership-model anchor: every page is free, live
+                # (refcounted) or cached — checked after EVERY ragged
+                # step, COW suffix rows included
                 self.mgr.check_conservation()
             self.cache.update_gauges()
         return len(self._live)

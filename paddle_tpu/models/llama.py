@@ -1118,6 +1118,99 @@ def prefill_paged_suffix(params, ids, seq_lens, start_pos, k_pages, v_pages,
             vp_flat.reshape(v_pages.shape))
 
 
+def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
+                k_pages, v_pages, block_tables, config: LlamaConfig):
+    """One forward over a RAGGED packed token batch — the unified model
+    step behind the engine's single-dispatch serving loop.
+
+    Mixed prefill+decode in one program: every live row contributes a
+    span of the flat token axis (a decode row its one new token, a
+    prefill row the next chunk of its prompt — a warm/COW suffix row is
+    just "a row whose first position > 0"). Rope is taken at each
+    token's absolute position, K/V scatter into the row's pages, and
+    attention is the ragged paged kernel's one mask rule
+    ``key_pos <= position`` (ops.paged_attention.ragged_paged_attention),
+    which subsumes the in-prompt causal mask, the suffix offset mask and
+    the decode ``kv_len`` mask. The compiled shape depends only on
+    (T, rows, table width) — never on the request mix.
+
+    ids:       (T,) int32 packed tokens (pad slots: anything)
+    token_row: (T,) int32 owning row per token; -1 = pad slot
+    positions: (T,) int32 absolute KV position per token
+    kv_lens:   (R,) int32 per-row attendable span this call (0 = idle)
+    last_idx:  (R,) int32 flat index of each row's last token (rows
+               without tokens may point anywhere; callers mask the
+               resulting logits)
+    k_pages/v_pages: (L, P, page, nkv, d); block_tables: (R, max_pages)
+    Returns (row_logits (R, V), k_pages', v_pages').
+    """
+    from ..ops import paged_attention as pa
+    t = ids.shape[0]
+    d = config.head_dim
+    page = k_pages.shape[2]
+    n_rows, width = block_tables.shape
+    s_max = width * page
+    cos_full, sin_full = rope_ops.build_rope_cache(s_max, config.head_dim,
+                                                   config.rope_theta)
+    # clamp: over-decoded tokens past the table span land in the last
+    # slot (their outputs are trimmed by the host, same as the legacy
+    # decode path's clipped take_along_axis)
+    pos_c = jnp.minimum(positions.astype(jnp.int32), s_max - 1)
+    cos = jnp.take(cos_full, pos_c, axis=0)[None]          # (1, T, d)
+    sin = jnp.take(sin_full, pos_c, axis=0)[None]
+    x = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)[None]
+
+    valid = token_row >= 0
+    row_c = jnp.clip(token_row.astype(jnp.int32), 0, n_rows - 1)
+    page_idx = pos_c // page
+    page_off = pos_c % page
+    phys = jnp.take(block_tables.reshape(-1), row_c * width + page_idx)
+    phys = jnp.where(valid, phys, 0)                       # pads -> page 0
+
+    # flat-pool carry with per-layer page offsets — see prefill_paged's
+    # structure note (pools as scan xs/ys would copy both pools per step)
+    n_layers, pool_p = k_pages.shape[0], k_pages.shape[1]
+    kp_flat = k_pages.reshape((n_layers * pool_p,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((n_layers * pool_p,) + v_pages.shape[2:])
+
+    def body(carry, lp_l):
+        xc, kp, vp = carry
+        lp, l = lp_l
+        xn = _rms(xc, lp["ln1"], config.rms_norm_eps)
+        q = _mm_prefill(xn, lp["wq"]).reshape(1, t, -1, d)
+        k = _mm_prefill(xn, lp["wk"]).reshape(1, t, -1, d)
+        v = _mm_prefill(xn, lp["wv"]).reshape(1, t, -1, d)
+        q, k = rope_ops.apply_rope_array(q, k, cos, sin)
+        # scatter FIRST: every token (decode and prefill alike) attends
+        # through the page gather, its own fresh K/V included
+        kp = kp.at[phys + l * pool_p, page_off].set(k[0].astype(kp.dtype))
+        vp = vp.at[phys + l * pool_p, page_off].set(v[0].astype(vp.dtype))
+        attn = pa.ragged_paged_attention(
+            q[0], kp, vp, block_tables + l * pool_p, token_row, pos_c,
+            kv_lens, scale=1.0 / math.sqrt(d))             # (T, nh, d)
+        xo = xc + _mm_prefill(attn.reshape(1, t, -1),
+                              lp["wo"]).astype(xc.dtype)
+        xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)
+        g = _mm_prefill(xn2, lp["w_gate"])
+        u = _mm_prefill(xn2, lp["w_up"])
+        xo = xo + jnp.einsum("btm,mh->bth", jax.nn.silu(g) * u,
+                             _dense(lp["w_down"]))
+        # int8-quantized weights dequantize to f32; keep the carry dtype
+        return (xo.astype(xc.dtype), kp, vp), None
+
+    layer_params = {k: params[k] for k in LAYER_KEYS}
+    (x, kp_flat, vp_flat), _ = lax.scan(
+        body, (x, kp_flat, vp_flat),
+        (layer_params, jnp.arange(n_layers)))
+    x = _rms(x, params["ln_f"], config.rms_norm_eps)
+    # lm_head over ONLY each row's last token: (R, h) @ (h, V), not the
+    # full (T, V) logits the bucketed prefill paid for
+    h_last = jnp.take(x[0], last_idx.astype(jnp.int32), axis=0)
+    logits = jnp.einsum("rh,hv->rv", h_last, _dense(params["lm_head"]))
+    return (logits, kp_flat.reshape(k_pages.shape),
+            vp_flat.reshape(v_pages.shape))
+
+
 def decode_step_paged(params, tok, positions, k_pages, v_pages, block_tables,
                       config: LlamaConfig):
     """One ragged decode step. tok: (B,); positions: (B,) absolute position

@@ -115,10 +115,18 @@ class RecompileDetector:
     def __init__(self):
         self._seen: Dict[str, set] = {}
         self._lock = threading.Lock()
-        self._counter = get_registry().counter(
+        reg = get_registry()
+        self._counter = reg.counter(
             "paddle_runtime_recompiles_total",
             "XLA trace-cache misses (first compile included), by function",
             labels=("fn",))
+        self._compile_s = reg.histogram(
+            "paddle_runtime_compile_seconds",
+            "wall time of XLA trace+compile per cache miss, by function",
+            labels=("fn",),
+            bounds=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+                    30.0, 60.0, 120.0))
+        self._compile_sums: Dict[str, float] = {}
 
     def note(self, fn_name: str, shape_key) -> bool:
         """Record a compile-cache lookup for ``fn_name`` with hashable
@@ -149,6 +157,23 @@ class RecompileDetector:
         self._counter.inc(fn=fn_name)
         extra = {} if distinct is None else {"distinct_signatures": distinct}
         emit_event("recompile", fn=fn_name, shapes=repr(shape_key), **extra)
+
+    def observe_compile(self, fn_name: str, seconds: float) -> None:
+        """Record one compile's wall time (the caller times its first
+        invocation of a freshly built program, blocked to completion) so
+        warmup cost shows up in ``paddle_runtime_compile_seconds{fn}``
+        on /metrics and in bench JSON lines."""
+        self._compile_s.observe(float(seconds), fn=fn_name)
+        with self._lock:
+            self._compile_sums[fn_name] = (
+                self._compile_sums.get(fn_name, 0.0) + float(seconds))
+
+    def compile_seconds_total(self, fn_name: str) -> float:
+        """Summed compile wall time recorded for ``fn_name`` (local
+        mirror — reading an unseen fn must NOT materialize an empty
+        labeled series on /metrics)."""
+        with self._lock:
+            return self._compile_sums.get(fn_name, 0.0)
 
     def count(self, fn_name: Optional[str] = None) -> float:
         if fn_name is not None:

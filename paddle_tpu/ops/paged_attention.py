@@ -149,6 +149,218 @@ def paged_prefill_attention_array(q, k_pages, v_pages, block_tables, q_start,
 
 
 # ---------------------------------------------------------------------------
+# Ragged paged attention: ONE program for mixed prefill+decode rows
+# ---------------------------------------------------------------------------
+
+def ragged_paged_attention_array(q, k_pages, v_pages, block_tables, token_row,
+                                 positions, kv_lens=None,
+                                 scale: Optional[float] = None):
+    """XLA reference of the unified ragged kernel (gather/mask composition).
+
+    The serving engine's single-dispatch step packs every live row's
+    tokens — decode rows contribute one token, prefill rows a chunk of
+    their prompt — into one flat token axis. Each token attends to ITS
+    row's pages under the one mask rule that subsumes both phases::
+
+        key_pos <= positions[t]            (self-inclusive causality)
+
+    A decode token at absolute position p sees keys [0, p] — exactly
+    ``paged_attention``'s ``pos < kv_len`` with ``kv_len = p+1``; a
+    prefill token at p sees the cached/scattered prefix plus itself —
+    exactly ``paged_prefill_attention_array``'s ``key_pos <= q_start+t``.
+
+    q:            (T, nh, d)   — packed queries (pad slots: token_row -1)
+    k_pages:      (P, page, nkv, d)
+    v_pages:      (P, page, nkv, d)
+    block_tables: (R, max_pages) int32 (pad: reserved page 0)
+    token_row:    (T,) int32 — owning row per token; -1 = pad slot
+    positions:    (T,) int32 — absolute KV position per token
+    kv_lens:      (R,) int32 — per-row attendable span (page-skip hint for
+                  the Pallas kernel; unused by this reference)
+    Returns (T, nh, d).
+    """
+    t, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    n_rows, max_pages = block_tables.shape
+    rep = nh // nkv
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    row_c = jnp.clip(token_row, 0, n_rows - 1)
+    bt_tok = jnp.take(block_tables, row_c, axis=0)      # (T, max_pages)
+    k = jnp.take(k_pages, bt_tok, axis=0)               # (T, W, page, ..)
+    v = jnp.take(v_pages, bt_tok, axis=0)
+    k = k.reshape(t, max_pages * page, nkv, d)
+    v = v.reshape(t, max_pages * page, nkv, d)
+
+    key_pos = jnp.arange(max_pages * page)[None, :]     # (1, S)
+    mask = (key_pos <= positions[:, None]) & (token_row >= 0)[:, None]
+    if rep > 1:
+        # grouped attention without materializing repeated KV (same
+        # bandwidth argument as paged_attention_array)
+        qg = q.reshape(t, nkv, rep, d)
+        scores = jnp.einsum("tgrd,tsgd->tgrs", qg.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("tgrs,tsgd->tgrd", probs.astype(v.dtype), v)
+        return out.reshape(t, nh, d)
+    scores = jnp.einsum("thd,tshd->ths", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("ths,tshd->thd", probs.astype(v.dtype), v)
+
+
+def _ragged_attention_kernel(block_tables_ref, kv_lens_ref, token_row_ref,
+                             positions_ref, q_ref, k_ref, v_ref, o_ref,
+                             m_ref, l_ref, acc_ref, *, page: int,
+                             n_pages: int, n_rows: int, scale: float,
+                             nh: int, nkv: int, d: int, t: int):
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((r == 0) & (j == 0))
+    def _zero_out():
+        # pad slots (token_row -1) belong to no row and are never merged;
+        # zero the whole output once so their lanes hold finite values
+        # (uninitialized VMEM garbage scattered into the pool could poison
+        # masked softmax lanes of OTHER rows via 0 * NaN)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # skip pages beyond this row's attendable span (rows with no tokens
+    # this round carry kv_len 0 and stream nothing)
+    run = j * page < kv_lens_ref[r]
+
+    @pl.when(run)
+    def _compute():
+        rep = nh // nkv
+        q = q_ref[...].astype(jnp.float32)          # (T, nh, d)
+        k = k_ref[0].astype(jnp.float32)            # (page, nkv, d)
+        v = v_ref[0].astype(jnp.float32)
+        tr = token_row_ref[...]                     # (T, 1) int32
+        pos = positions_ref[...]                    # (T, 1) int32
+        key_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (t, page), 1)                # (T, page)
+        mask = (tr == r) & (key_pos <= pos)         # (T, page)
+        # batched matmul wants the batch (kv-head) dim leading on both
+        # operands (Mosaic "batch dims must be equal" — round-2 finding)
+        qg = q.reshape(t, nkv, rep, d).swapaxes(0, 1).reshape(
+            nkv, t * rep, d)
+        kt = k.swapaxes(0, 1)                       # (nkv, page, d)
+        vt = v.swapaxes(0, 1)
+        s = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        mg = jnp.broadcast_to(mask[None, :, None, :], (nkv, t, rep, page)
+                              ).reshape(nkv, t * rep, page)
+        s = jnp.where(mg, s, _NEG_INF)
+        # flatten to (T*nh, page) rows for the online-softmax state
+        s2 = s.reshape(nkv, t, rep, page).swapaxes(0, 1).reshape(
+            t * nh, page)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)                     # (T*nh, page)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        pg = p.reshape(t, nkv, rep, page).swapaxes(0, 1).reshape(
+            nkv, t * rep, page)
+        pv = jax.lax.dot_general(
+            pg, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # (nkv, T*rep, d)
+        pv2 = pv.reshape(nkv, t, rep, d).swapaxes(0, 1).reshape(t * nh, d)
+        acc_ref[...] = acc_ref[...] * alpha + pv2
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[...] / safe_l).reshape(t, nh, d)
+        mine = (token_row_ref[...] == r)            # (T, 1)
+        o_ref[...] = jnp.where(mine[:, :, None], out.astype(o_ref.dtype),
+                               o_ref[...])
+
+
+def ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                  token_row, positions, kv_lens,
+                                  scale: Optional[float] = None,
+                                  interpret: bool = False):
+    """Pallas ragged kernel: same contract as
+    :func:`ragged_paged_attention_array`.
+
+    Grid (rows, pages): each step streams exactly ONE physical page of
+    one row HBM→VMEM via the scalar-prefetched block table (Mosaic
+    double-buffers consecutive steps) and folds it into the online
+    softmax of every packed token that belongs to the row — decode and
+    prefill tokens alike, so a mixed batch is one dispatch whose shape
+    is invariant to the request mix (PAPERS.md ragged paged attention).
+    """
+    t, nh, d = q.shape
+    page = k_pages.shape[1]
+    nkv = k_pages.shape[2]
+    n_rows, max_pages = block_tables.shape
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, kv_lens
+        grid=(n_rows, max_pages),
+        in_specs=[
+            pl.BlockSpec((t, 1), lambda r, j, bt, kvl: (0, 0)),
+            pl.BlockSpec((t, 1), lambda r, j, bt, kvl: (0, 0)),
+            pl.BlockSpec((t, nh, d), lambda r, j, bt, kvl: (0, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda r, j, bt, kvl: (bt[r, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, nkv, d),
+                         lambda r, j, bt, kvl: (bt[r, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, nh, d), lambda r, j, bt, kvl: (0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t * nh, 128), jnp.float32),
+            pltpu.VMEM((t * nh, 128), jnp.float32),
+            pltpu.VMEM((t * nh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_attention_kernel, page=page, n_pages=max_pages,
+        n_rows=n_rows, scale=s, nh=nh, nkv=nkv, d=d, t=t)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, nh, d), v_pages.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      token_row.astype(jnp.int32).reshape(t, 1),
+      positions.astype(jnp.int32).reshape(t, 1),
+      q, k_pages, v_pages)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, token_row,
+                           positions, kv_lens, scale: Optional[float] = None):
+    """Dispatcher: Pallas ragged kernel on TPU (FLAGS_use_pallas_kernels),
+    XLA gather/mask fallback elsewhere — selected automatically, same
+    contract either way (see ragged_paged_attention_array)."""
+    from ._common import use_pallas
+    if use_pallas():
+        return ragged_paged_attention_pallas(
+            q, k_pages, v_pages, block_tables, token_row, positions,
+            kv_lens, scale)
+    return ragged_paged_attention_array(
+        q, k_pages, v_pages, block_tables, token_row, positions, kv_lens,
+        scale)
+
+
+# ---------------------------------------------------------------------------
 # Host-side page pool (the allocator metadata; device arrays hold the data)
 # ---------------------------------------------------------------------------
 

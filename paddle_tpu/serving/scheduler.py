@@ -577,7 +577,14 @@ class ServingScheduler:
         engine's internal FIFO must stay empty or priority inversions
         sneak in behind it: a request parked there (slot free but pages
         scarce) would be served before any later, higher-priority
-        submission the moment pages return."""
+        submission the moment pages return.
+
+        Page math is unchanged by the unified ragged step, but the wave
+        assumption is gone: an admission handed over here joins the
+        engine's CURRENT step's ragged batch (prefill rides the same
+        single dispatch as everyone's decode) instead of waiting for a
+        bucketed prefill wave, so admission latency is one step, not one
+        wave boundary."""
         now = self._clock()
         headroom = self.engine.num_free_slots - len(self.engine._queue)
         free_pages = self.engine.mgr.num_free_pages

@@ -396,7 +396,8 @@ def test_engine_compile_cache_miss_counts_and_logs(tmp_path):
         while sched.pending:
             sched.step(params)
         first_delta = recompiles.count() - before
-        assert first_delta >= 2        # prefill + decode chunk compiled
+        assert first_delta >= 1        # the unified step compiled (the
+        # legacy engine pays >= 2 here: prefill bucket + decode chunk)
         # same shapes again: nothing new compiles
         before = recompiles.count()
         h2 = sched.submit(np.array([4, 5, 6], np.int32))

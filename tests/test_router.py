@@ -197,8 +197,13 @@ def test_mid_stream_failover_byte_identical(tmp_path):
         p = np.arange(3, 8, dtype=np.int32)
         h = router.submit(p)
         assert h.replica_id == 0
-        router.step(params)             # first chunk streamed
-        clock.advance(0.05)
+        # step until the first tokens stream (the unified ragged step
+        # prefills within the step, so tokens land a round later)
+        for _ in range(4):
+            router.step(params)
+            clock.advance(0.05)
+            if h.stream.tokens:
+                break
         streamed = len(h.stream.tokens)
         assert 0 < streamed < 6
         replicas[0].kill()

@@ -1,0 +1,239 @@
+"""Unified ragged paged-attention step (ROADMAP item 1, per PAPERS.md
+"Ragged Paged Attention"): ONE Pallas/XLA kernel and ONE compiled engine
+step serve mixed prefill+decode rows of arbitrary lengths — byte-identical
+greedy output to the legacy three-program pipeline, O(1) recompiles across
+a length-diverse storm, conservation after every ragged step."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                           GenerationConfig)
+from paddle_tpu.observability.runtime import recompiles
+from paddle_tpu.ops import paged_attention as pa
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: the ragged composition vs the pair it replaces
+# ---------------------------------------------------------------------------
+
+def _mixed_batch(seed=0, PAGE=4, NPAGES=32, NKV=2, NH=4, D=8):
+    """A packed mixed batch: row 0 decodes (1 token), rows 1-2 prefill
+    suffixes at different offsets (one warm: q_start > 0)."""
+    rng = np.random.RandomState(seed)
+    mgr = pa.PagedKVCacheManager(1, NPAGES, PAGE, NKV, D, dtype=jnp.float32)
+    k_pool = rng.randn(NPAGES, PAGE, NKV, D).astype(np.float32)
+    v_pool = rng.randn(NPAGES, PAGE, NKV, D).astype(np.float32)
+    # row 0: decode at kv_len 9 -> one token at position 8
+    # row 1: cold prefill of 6 tokens (positions 0..5)
+    # row 2: warm suffix of 3 tokens at q_start 5 (positions 5..7)
+    kv_lens = [9, 6, 8]
+    for sid, n in enumerate(kv_lens):
+        mgr.allocate(sid, n)
+    bt, _ = mgr.block_tables([0, 1, 2])
+    token_row = np.array([0] + [1] * 6 + [2] * 3 + [-1, -1], np.int32)
+    positions = np.array([8] + list(range(6)) + [5, 6, 7] + [0, 0],
+                         np.int32)
+    T = len(token_row)
+    q = rng.randn(T, NH, D).astype(np.float32)
+    return (q, k_pool, v_pool, bt.astype(np.int32), token_row, positions,
+            np.asarray(kv_lens, np.int32))
+
+
+def test_ragged_array_matches_legacy_decode_and_prefill_pair():
+    """Elementwise parity of the unified XLA reference against BOTH
+    programs it replaces: paged_attention_array for the decode token and
+    paged_prefill_attention_array for the prefill/suffix rows."""
+    q, kp, vp, bt, token_row, positions, kv_lens = _mixed_batch()
+    out = np.asarray(pa.ragged_paged_attention_array(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+        jnp.asarray(token_row), jnp.asarray(positions),
+        jnp.asarray(kv_lens)))
+
+    # decode token (row 0): legacy decode op with kv_len = pos + 1
+    dec = np.asarray(pa.paged_attention_array(
+        jnp.asarray(q[:1]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt[:1]), jnp.asarray([9], np.int32)))
+    np.testing.assert_allclose(out[0], dec[0], rtol=1e-5, atol=1e-6)
+
+    # prefill rows: legacy suffix op at each row's q_start
+    for row, sl, q_start in ((1, slice(1, 7), 0), (2, slice(7, 10), 5)):
+        t = sl.stop - sl.start
+        ref = np.asarray(pa.paged_prefill_attention_array(
+            jnp.asarray(q[sl][None]), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt[row:row + 1]),
+            jnp.asarray([q_start], np.int32)))
+        np.testing.assert_allclose(out[sl], ref[0], rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_pallas_interpret_matches_array():
+    """The Pallas ragged kernel (interpret mode on CPU) must match the
+    XLA gather/mask reference elementwise on a mixed batch, pad slots
+    included."""
+    q, kp, vp, bt, token_row, positions, kv_lens = _mixed_batch(seed=3)
+    ref = np.asarray(pa.ragged_paged_attention_array(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+        jnp.asarray(token_row), jnp.asarray(positions),
+        jnp.asarray(kv_lens)))
+    out = np.asarray(pa.ragged_paged_attention_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+        jnp.asarray(token_row), jnp.asarray(positions),
+        jnp.asarray(kv_lens), interpret=True))
+    real = token_row >= 0
+    np.testing.assert_allclose(out[real], ref[real], rtol=1e-5, atol=1e-6)
+    # pad slots must come out finite (zeros): garbage there would be
+    # scattered into the pool and could poison other rows' masked lanes
+    assert np.all(np.isfinite(out))
+    assert np.all(out[~real] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine: byte-identical greedy output vs the legacy pipeline
+# ---------------------------------------------------------------------------
+
+def _engine(unified, prefix_cache=False, max_new=6, num_slots=2, chunk=3,
+            seed=3, **kw):
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new),
+        num_slots=num_slots, page_size=4, max_seq_len=64, chunk=chunk,
+        prefix_cache=prefix_cache, unified=unified, **kw)
+    return cfg, eng
+
+
+def _ragged_prompts(cfg, n, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size,
+                        (int(lens[i % len(lens)]),)).astype(np.int32)
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_unified_byte_identical_to_legacy(prefix_cache):
+    """The whole acceptance surface in one sweep: ragged lengths, slot
+    reuse, and (with the cache) warm suffix + COW rows — the unified
+    single-dispatch engine must emit exactly the legacy pipeline's greedy
+    tokens."""
+    cfg, leg = _engine(False, prefix_cache=prefix_cache)
+    params = L.init_stacked_params(cfg, seed=3)
+    prompts = _ragged_prompts(cfg, 8, (5, 12, 3, 9, 17, 2, 7, 30), seed=1)
+    if prefix_cache:
+        # shared prefixes + an exact repeat (the COW wave: full-prompt
+        # match forces a copy-on-write of the final page)
+        prompts[3] = np.concatenate([prompts[1], prompts[2]])
+        prompts[5] = prompts[1].copy()
+    legacy = leg.serve(params, prompts)
+    cfg2, uni = _engine(True, prefix_cache=prefix_cache)
+    unified = uni.serve(params, prompts)
+    assert unified == legacy
+
+
+def test_mid_decode_admission_byte_identical_and_conserved():
+    """A request admitted while others are mid-decode joins the current
+    ragged step immediately and still produces byte-identical greedy
+    output to running it against a fresh engine; page conservation holds
+    after every ragged step (engine-internal check + explicit audits)."""
+    cfg, eng = _engine(True, prefix_cache=True, max_new=6, num_slots=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    early = _ragged_prompts(cfg, 2, (11, 4), seed=5)
+    late = _ragged_prompts(cfg, 1, (7,), seed=9)[0]
+    r_early = [eng.submit(p) for p in early]
+    for _ in range(2):                      # early requests now mid-decode
+        eng.step(params)
+        eng.mgr.check_conservation()
+    assert any(len(eng._live[eng._slot_rid[s]].tokens) > 0
+               for s in range(eng.num_slots)
+               if eng._slot_rid[s] is not None)
+    r_late = eng.submit(late)               # mid-decode admission
+    results = {}
+    for _ in range(60):
+        eng.step(params)
+        eng.mgr.check_conservation()        # incl. COW/suffix rows
+        results.update(eng.collect())
+        if len(results) == 3:
+            break
+    assert set(results) == set(r_early) | {r_late}
+
+    cfg3, fresh = _engine(True, prefix_cache=True, max_new=6, num_slots=2)
+    assert fresh.serve(params, [late]) == [results[r_late]]
+    # and the storm's early rows match a legacy engine end to end
+    cfg4, leg = _engine(False, prefix_cache=True, max_new=6, num_slots=2)
+    assert leg.serve(params, early) == [results[r] for r in r_early]
+
+
+# ---------------------------------------------------------------------------
+# O(1) recompiles across a length-diverse storm
+# ---------------------------------------------------------------------------
+
+def test_storm_recompiles_o1_where_legacy_recompiles_per_bucket():
+    """A length-diverse request storm (the recompile cliff): the unified
+    engine's step cache misses at most twice (one compile, one optional
+    remat) while the legacy engine recompiles per (bucket, batch) shape."""
+    cfg, uni = _engine(True, max_new=4, num_slots=4)
+    params = L.init_stacked_params(cfg, seed=3)
+    lens = (2, 3, 5, 7, 9, 12, 17, 23, 31, 44)
+    prompts = _ragged_prompts(cfg, 12, lens, seed=7)
+
+    u0 = recompiles.count("cbe.unified_step")
+    out_u = uni.serve(params, prompts)
+    u_misses = recompiles.count("cbe.unified_step") - u0
+    assert u_misses <= 2, u_misses          # O(1): the acceptance bound
+
+    l0 = (recompiles.count("cbe.prefill")
+          + recompiles.count("cbe.decode_chunk"))
+    cfg2, leg = _engine(False, max_new=4, num_slots=4)
+    out_l = leg.serve(params, prompts)
+    l_misses = (recompiles.count("cbe.prefill")
+                + recompiles.count("cbe.decode_chunk")) - l0
+    assert l_misses > u_misses              # the cliff the kernel removes
+    assert out_u == out_l                   # and identical output
+
+    # compile wall time surfaced for warmup visibility (/metrics + bench)
+    assert recompiles.compile_seconds_total("cbe.unified_step") > 0
+
+
+def test_unified_single_program_reused_across_admission_mixes():
+    """Every step — pure prefill, mixed, pure decode, re-admission into
+    freed slots — runs the SAME compiled program object."""
+    cfg, eng = _engine(True, max_new=4, num_slots=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    [eng.submit(p) for p in _ragged_prompts(cfg, 5, (3, 13, 6, 21, 2),
+                                            seed=11)]
+    eng.step(params)
+    prog = eng._unified_step
+    assert prog is not None
+    while eng.step(params) or eng._queue:
+        assert eng._unified_step is prog
+    assert eng._unified_step is prog
+
+
+# ---------------------------------------------------------------------------
+# dead-path guard: the legacy trio stays an inference/-internal detail
+# ---------------------------------------------------------------------------
+
+def test_no_legacy_prefill_trio_callers_outside_inference():
+    """`_build_prefill` / `_build_prefill_suffix` / `_build_decode_chunk`
+    remain only as the engine's opt-in legacy path (unified=False, kept
+    for A/B benches): nothing outside paddle_tpu/inference/ may reach
+    for them."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pat = re.compile(
+        r"_build_prefill_suffix|_build_prefill|_build_decode_chunk")
+    offenders = []
+    for top in ("paddle_tpu", "benchmarks"):
+        for dirpath, _dirs, files in os.walk(os.path.join(repo, top)):
+            if os.path.join("paddle_tpu", "inference") in dirpath:
+                continue
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, f)
+                src = open(path, encoding="utf-8").read()
+                if pat.search(src):
+                    offenders.append(os.path.relpath(path, repo))
+    assert not offenders, offenders
