@@ -439,7 +439,8 @@ def bench_decode_cb():
     import paddle_tpu as paddle
     from paddle_tpu.models import llama as L
     from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
-                                               GenerationConfig)
+                                               GenerationConfig,
+                                               _prefill_flags)
 
     if smoke:
         cfg = L.llama_tiny(num_hidden_layers=2)
@@ -480,6 +481,9 @@ def bench_decode_cb():
     eng._compiled_prefill = compiled_prefill
     eng._decode_chunk = compiled_chunk
     eng._unified_step = compiled_unified
+    # carry the host state the program baked in, or the fresh engine
+    # treats the transplant as stale and recompiles (decoding._prefill_flags)
+    eng._unified_flags = _prefill_flags()
     t0 = time.perf_counter()
     outs = eng.serve(params, prompts)
     dt = time.perf_counter() - t0

@@ -1,0 +1,80 @@
+"""CLI: ``python -m paddle_tpu.analysis [--format json|text] ...``.
+
+Exit code 0 when the tree is clean against the baseline; 1 when any
+unbaselined finding or stale baseline entry exists. ``--write-baseline``
+regenerates the checked-in baseline deterministically (sorted by
+fingerprint; existing justifications are preserved)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import BASELINE_PATH, REPO_ROOT, default_rules, run_repo
+from .engine import Baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tpu-lint: AST-based invariant analyzer")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--root", type=Path, default=REPO_ROOT,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--baseline", type=Path, default=BASELINE_PATH,
+                    help="baseline file (default: analysis/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to cover current findings "
+                         "(sorted, deterministic; keeps justifications)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:22s} {r.protects}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    baseline_path = None if args.no_baseline else args.baseline
+    report = run_repo(root=args.root, rules=rules,
+                      baseline_path=baseline_path)
+
+    if args.write_baseline:
+        old = Baseline.load(args.baseline)
+        ran = {r.id for r in rules}
+        # keep entries owned by rules that did NOT run (a --rules subset
+        # regeneration must not delete the other rules' grandfathered
+        # findings and their justifications), refresh the rest
+        entries = {}
+        for fp, why in old.entries.items():
+            parts = fp.split(":")
+            if (parts[1] if len(parts) > 1 else "") not in ran:
+                entries[fp] = why
+        entries.update({f.fingerprint: old.entries.get(f.fingerprint, "")
+                        for f in report.findings})
+        Baseline(entries).write(args.baseline)
+        print(f"baseline written: {args.baseline} "
+              f"({len(entries)} entries)")
+        return 0
+
+    print(report.to_json() if args.format == "json"
+          else report.to_text())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""Rule family 3: metrics / events contracts.
+
+``observability/catalog.py`` declares every registry-owned metric family
+(name, kind, label tuple) and every structured-event kind. These rules
+hold the tree to it, statically and in both directions:
+
+* ``metric-contract`` — every ``reg.counter/gauge/histogram("paddle_*")``
+  registration must match the catalog (kind + exact label tuple); every
+  use of a bound metric object (``self._c_x.inc(...)``) must pass exactly
+  the declared label names; a catalog entry nothing registers is dead and
+  fails too. Subsystem *sinks* are covered through their own declaration:
+  string-keyed ``ServingMetrics`` calls (``inc("x")``, ``observe("x")``,
+  ``set_gauge("x")``) in ``serving/`` must name a family declared in
+  ``ServingMetrics.__init__`` — a typo there silently mints a new series,
+  which is exactly the failure mode this family exists to stop.
+* ``event-contract`` — every literal ``emit_event("kind", ...)`` /
+  ``event_log.emit("kind", ...)`` must use a declared kind; declared
+  kinds nothing emits fail.
+
+The catalog is parsed from source (``ast.literal_eval``), never imported
+— the analyzer stays runnable without jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import dotted
+from .engine import Finding, Project
+
+CATALOG_REL = "paddle_tpu/observability/catalog.py"
+SINK_REL = "paddle_tpu/serving/metrics.py"
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_USE_METHODS = {"inc", "set", "observe", "value", "hist"}
+_SINK_METHODS = {"inc": "counters", "observe": "histograms",
+                 "set_gauge": "gauges"}
+
+
+def _top_level_literal(mod, name: str):
+    """(value, Dict/Set node) for a top-level ``NAME = <literal>``."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return ast.literal_eval(node.value), node.value
+                    except ValueError:
+                        return None, node.value
+    return None, None
+
+
+def _str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return tuple(vals)
+    return None
+
+
+def _registration(node: ast.Call):
+    """(name, kind, labels-or-None, lineno) when ``node`` registers an
+    owned metric; labels is () when the kwarg is absent and None when it
+    is present but not a string-literal sequence."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REG_METHODS and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("paddle_")):
+        return None
+    labels: Optional[Tuple[str, ...]] = ()
+    for kw in node.keywords:
+        if kw.arg == "labels":
+            labels = _str_tuple(kw.value)
+    return (node.args[0].value, node.func.attr, labels, node.lineno)
+
+
+class MetricContractRule:
+    id = "metric-contract"
+    protects = ("every registry metric registration matches the central "
+                "catalog (name, kind, exact label tuple), every labeled "
+                "use passes exactly the declared labels, every "
+                "ServingMetrics string key names a declared family — "
+                "typos can no longer mint phantom series")
+    example = 'reg.counter("paddle_kvcache_hits_totl")  # typo: new series'
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        catalog_mod = project.module(CATALOG_REL)
+        if catalog_mod is None:
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "metrics catalog module missing",
+                            symbol="catalog-missing")]
+        metrics, metrics_node = _top_level_literal(catalog_mod, "METRICS")
+        if not isinstance(metrics, dict):
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "METRICS is not a literal dict",
+                            symbol="catalog-unparsable")]
+        key_lines = {k.value: k.lineno for k in metrics_node.keys
+                     if isinstance(k, ast.Constant)}
+        registered: Set[str] = set()
+        for mod in project.iter_modules(("paddle_tpu/",)):
+            for node in mod.nodes_of(ast.Call):
+                reg = _registration(node)
+                if reg is None:
+                    continue
+                name, kind, labels, line = reg
+                registered.add(name)
+                declared = metrics.get(name)
+                if declared is None:
+                    out.append(Finding(
+                        mod.rel, line, self.id,
+                        f"metric {name!r} is not declared in "
+                        f"observability/catalog.py — typo, or add it to "
+                        "METRICS", symbol=f"undeclared:{name}"))
+                    continue
+                dkind, dlabels = declared[0], tuple(declared[1])
+                if kind != dkind:
+                    out.append(Finding(
+                        mod.rel, line, self.id,
+                        f"metric {name!r} registered as {kind}, catalog "
+                        f"declares {dkind}", symbol=f"kind:{name}"))
+                if labels is not None and labels != dlabels:
+                    out.append(Finding(
+                        mod.rel, line, self.id,
+                        f"metric {name!r} registered with labels "
+                        f"{labels}, catalog declares {dlabels}",
+                        symbol=f"labels:{name}"))
+            # label-usage check: bound metric objects used with kwargs
+            out.extend(self._check_usages(mod, metrics))
+        for name in sorted(set(metrics) - registered):
+            out.append(Finding(
+                CATALOG_REL, key_lines.get(name, 1), self.id,
+                f"catalog declares metric {name!r} but nothing in "
+                "paddle_tpu/ registers it — remove the entry or wire "
+                "the metric", symbol=f"unused:{name}"))
+        out.extend(self._check_sink_keys(project))
+        return out
+
+    # -- bound-object label usage -------------------------------------------
+
+    def _check_usages(self, mod, metrics) -> List[Finding]:
+        out: List[Finding] = []
+        bindings: Dict[str, str] = {}       # "self.X" / "X" -> metric name
+        for node in mod.nodes_of(ast.Assign):
+            if isinstance(node.value, ast.Call):
+                reg = _registration(node.value)
+                if reg is None:
+                    continue
+                for t in node.targets:
+                    d = dotted(t)
+                    if d is not None:
+                        bindings[d] = reg[0]
+        if not bindings:
+            return out
+        for node in mod.nodes_of(ast.Call):
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _USE_METHODS):
+                continue
+            recv = dotted(node.func.value)
+            name = bindings.get(recv) if recv else None
+            if name is None or name not in metrics:
+                continue
+            declared = set(metrics[name][1])
+            given = {kw.arg for kw in node.keywords
+                     if kw.arg is not None and kw.arg != "by"}
+            if any(kw.arg is None for kw in node.keywords):
+                continue                     # **labels — can't check
+            if given != declared:
+                out.append(Finding(
+                    mod.rel, node.lineno, self.id,
+                    f"{recv}.{node.func.attr}() on metric {name!r} "
+                    f"passes labels {tuple(sorted(given))}, declared "
+                    f"labels are {tuple(sorted(declared))}",
+                    symbol=f"use:{name}:{node.func.attr}"))
+        return out
+
+    # -- ServingMetrics sink families ---------------------------------------
+
+    def _sink_declared(self, project: Project) -> Dict[str, Set[str]]:
+        """{'counters': {...}, 'histograms': {...}, 'gauges': {...}} from
+        the dict literals in ServingMetrics.__init__."""
+        mod = project.module(SINK_REL)
+        decl: Dict[str, Set[str]] = {"counters": set(), "histograms": set(),
+                                     "gauges": set()}
+        if mod is None:
+            return decl
+        for node in mod.nodes_of(ast.Assign, ast.AnnAssign):
+            if not isinstance(node.value, ast.Dict):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = dotted(t)
+                if attr in ("self.counters", "self.histograms",
+                            "self.gauges"):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            decl[attr.split(".")[1]].add(k.value)
+        return decl
+
+    def _check_sink_keys(self, project: Project) -> List[Finding]:
+        decl = self._sink_declared(project)
+        if not any(decl.values()):
+            return [Finding(SINK_REL, 1, self.id,
+                            "could not parse ServingMetrics declared "
+                            "families", symbol="sink-unparsable")]
+        out: List[Finding] = []
+        for mod in project.iter_modules(("paddle_tpu/serving/",)):
+            for node in mod.nodes_of(ast.Call):
+                if not (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SINK_METHODS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                family = _SINK_METHODS[node.func.attr]
+                name = node.args[0].value
+                if name not in decl[family]:
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.id,
+                        f".{node.func.attr}({name!r}) names a "
+                        f"{family[:-1]} family ServingMetrics.__init__ "
+                        "never declares — it would be minted on first "
+                        "use and missing from /metrics until then",
+                        symbol=f"sink:{node.func.attr}:{name}"))
+        return out
+
+
+class EventContractRule:
+    id = "event-contract"
+    protects = ("every emit_event/event_log.emit kind is declared in "
+                "observability/catalog.py EVENT_KINDS (and every "
+                "declared kind is emitted somewhere) — a typo'd kind "
+                "silently forks the event stream")
+    example = 'emit_event("relpica_ejected", replica=3)  # typo'
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        catalog_mod = project.module(CATALOG_REL)
+        if catalog_mod is None:
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "event catalog module missing",
+                            symbol="catalog-missing")]
+        kinds, kinds_node = _top_level_literal(catalog_mod, "EVENT_KINDS")
+        if not isinstance(kinds, (set, frozenset)):
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "EVENT_KINDS is not a literal set",
+                            symbol="catalog-unparsable")]
+        kind_lines = {}
+        if isinstance(kinds_node, ast.Set):
+            kind_lines = {e.value: e.lineno for e in kinds_node.elts
+                          if isinstance(e, ast.Constant)}
+        emitted: Set[str] = set()
+        for mod in project.iter_modules(("paddle_tpu/",)):
+            for node in mod.nodes_of(ast.Call):
+                f = node.func
+                is_emit = (isinstance(f, ast.Name)
+                           and f.id == "emit_event") or \
+                          (isinstance(f, ast.Attribute) and f.attr == "emit"
+                           and (dotted(f) or "").split(".")[-2:-1]
+                           == ["event_log"])
+                if not is_emit or not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                emitted.add(arg.value)
+                if arg.value not in kinds:
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.id,
+                        f"event kind {arg.value!r} is not declared in "
+                        "observability/catalog.py EVENT_KINDS — typo, "
+                        "or declare it",
+                        symbol=f"undeclared:{arg.value}"))
+        for kind in sorted(set(kinds) - emitted):
+            out.append(Finding(
+                CATALOG_REL, kind_lines.get(kind, 1), self.id,
+                f"EVENT_KINDS declares {kind!r} but nothing in "
+                "paddle_tpu/ emits it — remove or wire the event",
+                symbol=f"unused:{kind}"))
+        return out
+
+
+CONTRACT_RULES = (MetricContractRule(), EventContractRule())

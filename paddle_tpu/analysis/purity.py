@@ -1,0 +1,240 @@
+"""Rule family 1: trace purity / recompile hazards.
+
+The unified ragged step's O(1)-recompile guarantee (PR 7) and the
+engine's byte-identical replays hold only while code that runs *under
+trace* stays pure: no wall-clock reads, no Python-side randomness, no
+host synchronisation, no per-shape Python branching hiding inside a
+jitted body. The runtime ``RecompileDetector`` catches the symptom
+(cache misses); these rules catch the cause before it ships.
+
+Reachability comes from :mod:`.callgraph`: roots are functions handed to
+``jax.jit``/``pl.pallas_call`` (or ``@partial(jax.jit, ...)``-decorated),
+and edges are conservatively resolved calls, so every flagged line is in
+code that demonstrably CAN run under trace.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .callgraph import FunctionInfo, dotted
+from .engine import Finding, Project
+
+_WALL_CLOCK = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.perf_counter_ns", "time.time_ns",
+               "time.monotonic_ns", "datetime.datetime.now"}
+
+#: call prefixes that are host/Python randomness (jax.random is fine —
+#: it is keyed and traceable)
+_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "onp.asarray", "onp.array"}
+
+
+def _is_stdlib_random(mi, name: str) -> bool:
+    """``random`` resolves to the stdlib module in this file (not a
+    local variable that happens to share the name)."""
+    return mi.import_aliases.get("random") == "random"
+
+
+class TracedRuleBase:
+    def _iter_traced(self, project: Project) -> Iterable[FunctionInfo]:
+        return project.index.traced_functions()
+
+
+class TraceWallClockRule(TracedRuleBase):
+    id = "trace-wall-clock"
+    protects = ("traced code never reads the wall clock — a clock read "
+                "baked into a compiled program is a constant, not a "
+                "measurement, and breaks byte-identical replays")
+    example = "def step(x): t0 = time.time()  # inside a jax.jit body"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in self._iter_traced(project):
+            for node in fi.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d in _WALL_CLOCK:
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f"{d}() inside traced function "
+                        f"'{fi.qualname}' — the value freezes at trace "
+                        "time; hoist it to the host caller",
+                        symbol=f"{fi.qualname}:{d}"))
+        return out
+
+
+class TraceRandomRule(TracedRuleBase):
+    id = "trace-random"
+    protects = ("traced code never uses Python/NumPy RNG — host RNG "
+                "draws once at trace time and replays the same value "
+                "forever; use jax.random with an explicit key")
+    example = "def kernel(x): return x * random.random()  # under jit"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in self._iter_traced(project):
+            mi = project.index.by_rel[fi.module.rel]
+            for node in fi.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                if d.startswith("random.") and _is_stdlib_random(mi, d):
+                    hit = d
+                elif d.startswith(_RANDOM_PREFIXES[1:]):
+                    hit = d
+                else:
+                    continue
+                out.append(Finding(
+                    fi.module.rel, node.lineno, self.id,
+                    f"host RNG call {hit}() inside traced function "
+                    f"'{fi.qualname}' — traces once, replays forever; "
+                    "use jax.random with a threaded key",
+                    symbol=f"{fi.qualname}:{hit}"))
+        return out
+
+
+class TraceHostSyncRule(TracedRuleBase):
+    id = "trace-host-sync"
+    protects = ("traced code never forces a host sync: .item()/.tolist()"
+                "/np.asarray on a traced value aborts tracing or blocks "
+                "the device pipeline")
+    example = "def step(x): return x[0].item()  # under jit"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in self._iter_traced(project):
+            params = fi.param_names()
+            for node in fi.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_ATTRS
+                        and not node.args):
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f".{node.func.attr}() inside traced function "
+                        f"'{fi.qualname}' forces a host sync (or "
+                        "aborts tracing)",
+                        symbol=f"{fi.qualname}:{node.func.attr}"))
+                elif d in _HOST_SYNC_CALLS:
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f"{d}() inside traced function '{fi.qualname}' "
+                        "materialises a traced value on the host",
+                        symbol=f"{fi.qualname}:{d}"))
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("float", "int", "bool")
+                      and node.args
+                      and isinstance(node.args[0], ast.Name)
+                      and node.args[0].id in params):
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f"{node.func.id}({node.args[0].id}) on a "
+                        f"parameter of traced function '{fi.qualname}' "
+                        "— concretises a tracer",
+                        symbol=f"{fi.qualname}:{node.func.id}"
+                               f"({node.args[0].id})"))
+        return out
+
+
+class TraceShapeBranchRule(TracedRuleBase):
+    """Shape-dependent Python branching inside traced bodies: each
+    distinct shape takes a different branch at trace time, so every new
+    shape is a new program — the recompile cliff the ragged unified step
+    removed. Deliberate shape specialisation (kernel block-size pickers,
+    pallas-vs-XLA selectors whose shapes an engine cache buckets) is
+    recorded in the baseline with a justification instead of staying
+    invisible."""
+
+    id = "trace-shape-branch"
+    protects = ("traced bodies never branch on .shape/.ndim/len() — "
+                "every distinct shape is a distinct compiled program "
+                "(the recompile cliff the ragged unified step removed); "
+                "deliberate specialisation is baselined, not invisible")
+    example = "def step(x):\n    if x.shape[0] > 8: ...  # under jit"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in self._iter_traced(project):
+            params = fi.param_names()
+            for node in fi.own_nodes():
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                tok = self._shape_token(node.test, params)
+                if tok is not None:
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f"Python branch on {tok} inside traced function "
+                        f"'{fi.qualname}' — one compiled program per "
+                        "distinct shape; pad/bucket (or baseline the "
+                        "deliberate specialisation)",
+                        symbol=f"{fi.qualname}:{tok}"))
+        return out
+
+    @staticmethod
+    def _shape_token(test: ast.AST, params) -> str:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("shape", "ndim"):
+                d = dotted(sub)
+                return d or f"<expr>.{sub.attr}"
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len" and sub.args
+                    and isinstance(sub.args[0], ast.Name)
+                    and sub.args[0].id in params):
+                return f"len({sub.args[0].id})"
+        return None
+
+
+class TraceHostStateRule(TracedRuleBase):
+    """Mutable host state (the FLAGS registry, os.environ) read inside a
+    traced body: the value is baked into the compiled program at trace
+    time, so later ``set_flags``/env changes silently do NOTHING unless
+    every compile cache that guards the program keys on the same state.
+    The runtime ``RecompileDetector`` cannot see this — the program never
+    recompiles, it just keeps stale behaviour. Reads that ARE keyed into
+    the owning compile caches get a baseline entry saying so."""
+
+    id = "trace-host-state"
+    protects = ("traced code never reads mutable host state (flag_value,"
+                " os.environ) unless the owning compile caches key on "
+                "it — otherwise set_flags after first trace is a silent "
+                "no-op the RecompileDetector cannot even see")
+    example = ("def fwd(x):\n"
+               "    if flag_value('serving_a8w8_prefill'): ...  # traced")
+
+    _ENV = {"os.environ.get", "os.getenv"}
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in self._iter_traced(project):
+            for node in fi.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                if d.split(".")[-1] == "flag_value" or d in self._ENV:
+                    out.append(Finding(
+                        fi.module.rel, node.lineno, self.id,
+                        f"mutable host state read {d}() inside traced "
+                        f"function '{fi.qualname}' — baked in at trace "
+                        "time; key the compile cache on it or hoist it "
+                        "to the host caller",
+                        symbol=f"{fi.qualname}:{d}"))
+        return out
+
+
+PURITY_RULES = (TraceWallClockRule(), TraceRandomRule(),
+                TraceHostSyncRule(), TraceShapeBranchRule(),
+                TraceHostStateRule())

@@ -22,8 +22,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..flags import flag_value
 from ..observability.runtime import recompiles
 from ..profiler.record import emit_span, host_recorder
+
+
+def _prefill_flags() -> Tuple:
+    """Mutable host state the prefill/unified programs bake in at trace
+    time (``llama._mm_prefill`` reads FLAGS_serving_a8w8_prefill to pick
+    the int8 prefill matmul). Every compile-cache key that guards such a
+    program includes this tuple, so a ``set_flags`` flip RETRACES — a
+    counted ``paddle_runtime_recompiles_total`` miss — instead of
+    silently keeping the stale program (tpu-lint: trace-host-state)."""
+    return (bool(flag_value("serving_a8w8_prefill")),)
 
 
 @dataclass
@@ -143,7 +154,7 @@ class GenerationEngine:
         padded[:, :t] = ids
         # right-padding is safe: pad rows in the cache sit beyond kv_len
         # until decode overwrites each position before first attending to it
-        key = (bucket, cfg.max_new_tokens, b)
+        key = (bucket, cfg.max_new_tokens, b) + _prefill_flags()
         if key not in self._compiled:
             recompiles.record_miss("generation_engine.run", key)
             self._compiled[key] = self._build(bucket, cfg.max_new_tokens)
@@ -256,7 +267,8 @@ class PagedGenerationEngine:
             mgr._lens[i] = lens[i]  # prompt length is the live length
         bt, seq_lens = mgr.block_tables(list(range(b)))
 
-        key = (t_bucket, cfg.max_new_tokens, b, bt.shape[1])
+        key = (t_bucket, cfg.max_new_tokens, b,
+               bt.shape[1]) + _prefill_flags()
         if key not in self._compiled:
             recompiles.record_miss("paged_engine.run", key)
             self._compiled[key] = self._build(cfg.max_new_tokens)
@@ -385,6 +397,7 @@ class ContinuousBatchingEngine:
         self._step_tokens = max(step_tokens or
                                 max(num_slots, chunk, page_size), num_slots)
         self._unified_step = None
+        self._unified_flags = None      # host state baked into the program
         self._pend = [None] * num_slots   # per-slot unfed prompt suffix
         #: prompt tokens actually run through prefill (cache hits skip
         #: their cached prefix; benchmarks diff this against submitted
@@ -472,6 +485,14 @@ class ContinuousBatchingEngine:
     def num_free_slots(self) -> int:
         """Slots not occupied by a live sequence (pending queue not counted)."""
         return self._slot_rid.count(None)
+
+    @property
+    def num_queued(self) -> int:
+        """Submitted requests waiting in the engine's internal FIFO (not
+        yet holding a slot). The scheduler's admission headroom math uses
+        this instead of reaching into ``._queue`` (tpu-lint
+        private-engine)."""
+        return len(self._queue)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                trace_id: str = "") -> int:
@@ -617,7 +638,8 @@ class ContinuousBatchingEngine:
                 rows[i, :len(pages)] = pages
                 lens[i] = lp - nc
                 starts[i] = nc
-            key = ("sfx", bucket, b_pad) if warm else (bucket, b_pad)
+            key = (("sfx", bucket, b_pad) if warm
+                   else (bucket, b_pad)) + _prefill_flags()
             fresh = key not in self._compiled_prefill
             if fresh:
                 recompiles.record_miss("cbe.prefill", key)
@@ -921,14 +943,19 @@ class ContinuousBatchingEngine:
             if self._check_invariants:
                 self.mgr.check_conservation()
             return 0
-        fresh = self._unified_step is None
+        fresh = (self._unified_step is None
+                 or self._unified_flags != _prefill_flags())
         if fresh:
             # the engine's ONE compile-cache miss (plus at most one
-            # device remat): every later step reuses this program
+            # device remat): every later step reuses this program. A
+            # set_flags flip of host state the program bakes in (see
+            # _prefill_flags) is the ONE sanctioned extra miss — counted
+            # here instead of silently serving the stale program.
+            self._unified_flags = _prefill_flags()
             recompiles.record_miss(
                 "cbe.unified_step",
                 (self.num_slots, self.chunk, self._step_tokens,
-                 self._table_width))
+                 self._table_width) + self._unified_flags)
             self._unified_step = self._build_unified_step()
         plan, emit, fed = self._plan_step()
         # tokens that actually run through prefill THIS step (cancelled

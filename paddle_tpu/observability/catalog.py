@@ -1,0 +1,82 @@
+"""Central telemetry catalog: every registry-owned metric and every
+structured event kind, declared in ONE place.
+
+Motivation (ISSUE 8): a typo'd metric name or label, or a misspelled
+``emit_event`` kind, silently mints a brand-new series — dashboards and
+alerts keep watching the old name and see flatlines. This module is the
+contract; ``tpu-lint``'s ``metric-contract`` / ``event-contract`` rules
+(:mod:`paddle_tpu.analysis.contracts`) statically check every call site
+in the tree against it, in both directions (undeclared use AND declared-
+but-unused entries fail).
+
+Scope notes:
+
+* only *registry-owned* families appear in ``METRICS`` — subsystem sinks
+  (``ServingMetrics``, ``ResilienceMetrics``) declare their own families
+  in their ``__init__`` and are checked against those declarations;
+* label tuples are positional contracts: every call site must pass
+  exactly these label names (the registry enforces it at runtime too —
+  this catches it at lint time, before the conflicting registration
+  crashes a prod scrape).
+"""
+
+from __future__ import annotations
+
+#: registry-owned families: name -> (kind, label names)
+METRICS = {
+    # -- runtime dispatch / compile telemetry (observability/runtime.py) --
+    "paddle_runtime_op_duration_us": ("histogram", ()),
+    "paddle_runtime_recompiles_total": ("counter", ("fn",)),
+    "paddle_runtime_compile_seconds": ("histogram", ("fn",)),
+    # -- event log (observability/events.py) ------------------------------
+    "paddle_events_dropped_total": ("counter", ()),
+    # -- SLO engine (observability/slo.py) ---------------------------------
+    "paddle_slo_burn_rate": ("gauge", ("slo", "window")),
+    "paddle_slo_budget_remaining": ("gauge", ("slo",)),
+    "paddle_slo_breached": ("gauge", ("slo",)),
+    "paddle_slo_breaches_total": ("counter", ("slo",)),
+    # -- goodput / stragglers (observability/goodput.py) -------------------
+    "paddle_goodput_ratio": ("gauge", ()),
+    "paddle_stragglers_total": ("counter", ("source",)),
+    # -- fleet router (serving/router.py) ----------------------------------
+    "paddle_router_requests_total": ("counter", ("replica", "outcome")),
+    "paddle_router_replica_state": ("gauge", ("replica",)),
+    "paddle_router_failovers_total": ("counter", ()),
+    "paddle_router_prefix_affinity_hits_total": ("counter", ()),
+    # -- prefix cache (kvcache/cache.py) -----------------------------------
+    "paddle_kvcache_hits_total": ("counter", ()),
+    "paddle_kvcache_misses_total": ("counter", ()),
+    "paddle_kvcache_evictions_total": ("counter", ()),
+    "paddle_kvcache_cow_copies_total": ("counter", ()),
+    "paddle_kvcache_cached_tokens_total": ("counter", ()),
+    "paddle_kvcache_pages": ("gauge", ("state",)),
+}
+
+#: every structured-event kind the tree may emit (observability/events.py)
+EVENT_KINDS = {
+    # serving scheduler
+    "shed", "cancel", "step_retry", "degraded", "slo_degrade_shed",
+    # SLO engine
+    "slo_breach", "slo_recovered",
+    # resilience trainer
+    "save_failure", "preempt_flush", "rollback", "step_skipped",
+    "straggler",
+    # runtime compile telemetry
+    "recompile",
+    # flight recorder
+    "debug_dump",
+    # fleet router
+    "replica_ejected", "replica_recovered", "replica_draining",
+    "replica_drained", "failover",
+    # prefix cache
+    "cache_hit", "cache_evict",
+}
+
+
+def declared_metric(name: str):
+    """(kind, labels) or None — runtime helper mirror of the lint rule."""
+    return METRICS.get(name)
+
+
+def declared_event(kind: str) -> bool:
+    return kind in EVENT_KINDS

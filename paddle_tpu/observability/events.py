@@ -107,7 +107,7 @@ class EventLog:
                 if self._path is None:
                     return
                 if self._size and self._size + len(data) > self._max_bytes:
-                    self._rotate()
+                    self._rotate_locked()
                 with open(self._path, "ab") as f:
                     f.write(data)
                 self._size += len(data)
@@ -116,8 +116,10 @@ class EventLog:
             # path (scheduler, trainer) must never see event-log errors
             _count_dropped()
 
-    def _rotate(self) -> None:
-        """path -> path.1 -> … -> path.backups (oldest dropped)."""
+    def _rotate_locked(self) -> None:
+        """path -> path.1 -> … -> path.backups (oldest dropped). Caller
+        holds ``self._lock`` (the ``_locked`` suffix is the repo-wide
+        lock-discipline convention, see tpu-lint lock-unguarded-write)."""
         if self._backups <= 0:
             try:
                 os.remove(self._path)

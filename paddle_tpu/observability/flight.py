@@ -95,15 +95,24 @@ class FlightRecorder:
 
     def note_event(self, record: Dict[str, Any]) -> None:
         """Called by ``events.EventLog.emit`` with the already-built
-        record dict (shared, not copied — emit never mutates it after)."""
-        self._events.append(record)
+        record dict (shared, not copied — emit never mutates it after).
+
+        The lock matters even though ``deque.append`` is atomic:
+        :meth:`arm` REBINDS the rings when it resizes them, and an
+        unlocked append can land in the abandoned deque — a recorded
+        event silently missing from the next debug bundle (tpu-lint
+        lock-unguarded-write)."""
+        with self._lock:
+            self._events.append(record)
 
     def note_span(self, span: tuple) -> None:
         """Called by ``profiler.record`` with a ``HostSpan`` tuple."""
-        self._spans.append(span)
+        with self._lock:
+            self._spans.append(span)
 
     def note_metrics(self, label: str, payload: Dict[str, Any]) -> None:
-        self._metrics.append({"label": label, **payload})
+        with self._lock:
+            self._metrics.append({"label": label, **payload})
 
     # -- dumping ------------------------------------------------------------
 

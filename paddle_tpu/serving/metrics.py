@@ -70,13 +70,20 @@ class ServingMetrics:
         }
         #: shed counts keyed by reason ("queue_full", "deadline", ...)
         self.shed: Dict[str, float] = {}
-        #: last-value gauges (utilizations in [0, 1], depths in requests)
+        #: last-value gauges (utilizations in [0, 1], depths in requests).
+        #: EVERY family set_gauge() may touch is declared here — an
+        #: undeclared name would be minted on first set and missing from
+        #: /metrics until then, so the scrape schema would depend on
+        #: which code paths have run (tpu-lint metric-contract)
         self.gauges: Dict[str, float] = {
             "queue_depth": 0.0,
             "slot_utilization": 0.0,
             "page_utilization": 0.0,
+            "live_page_utilization": 0.0,
+            "cached_page_utilization": 0.0,
             "inflight": 0.0,
             "degraded": 0.0,
+            "slo_breached": 0.0,
         }
         get_registry().register_sink(self.namespace, self._prometheus_lines,
                                      self.summary)

@@ -586,7 +586,7 @@ class ServingScheduler:
         bucketed prefill wave, so admission latency is one step, not one
         wave boundary."""
         now = self._clock()
-        headroom = self.engine.num_free_slots - len(self.engine._queue)
+        headroom = self.engine.num_free_slots - self.engine.num_queued
         free_pages = self.engine.mgr.num_free_pages
         cache = getattr(self.engine, "cache", None)
         protect: List[int] = []     # pages THIS step's admissions rely on

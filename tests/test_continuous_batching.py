@@ -108,3 +108,32 @@ def test_pool_exhaustion_defers_admission():
     outs = eng.serve(params, prompts)
     for p, got in zip(prompts, outs):
         assert got == _greedy_ref(params, cfg, p, 4)
+
+
+def test_a8w8_flag_flip_retraces_unified_step():
+    """ISSUE 8 regression (tpu-lint trace-host-state): llama._mm_prefill
+    reads FLAGS_serving_a8w8_prefill at TRACE time, so the engine's
+    unified-step cache keys on it — a set_flags flip must produce a
+    fresh program and a counted recompile, not silently keep serving
+    the stale one (which the runtime RecompileDetector cannot see)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.observability.runtime import recompiles
+
+    cfg, params, eng = _setup(max_new=3, num_slots=2)
+    rng = np.random.RandomState(5)
+    p = rng.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+    out1 = eng.serve(params, [p])
+    prog1 = eng._unified_step
+    before = recompiles.count("cbe.unified_step")
+    paddle.set_flags({"FLAGS_serving_a8w8_prefill": 0})
+    try:
+        out2 = eng.serve(params, [p])
+        assert eng._unified_step is not prog1, (
+            "flag flip must rebuild the unified program")
+        assert recompiles.count("cbe.unified_step") == before + 1, (
+            "the rebuild must be a COUNTED recompile")
+    finally:
+        paddle.set_flags({"FLAGS_serving_a8w8_prefill": 1})
+    # dense (unquantized) params: the flag selects the same math path,
+    # so outputs stay byte-identical across the retrace
+    assert out1 == out2

@@ -5,7 +5,6 @@ disabled, >=50% of prefill tokens skipped on warm shared-prefix traffic,
 and the page conservation invariant holding after every engine step."""
 
 import re
-from pathlib import Path
 
 import numpy as np
 import pytest
@@ -13,7 +12,6 @@ import pytest
 from paddle_tpu.kvcache import (LRUEvictionPolicy, PrefixCache,
                                 RefcountedKVCacheManager, RadixTree)
 
-REPO = Path(__file__).resolve().parent.parent
 
 
 def _mgr(num_pages=12, page_size=4):
@@ -101,6 +99,8 @@ def test_cached_pages_survive_release_and_evict_to_free():
 def test_conservation_detects_violations():
     mgr = _mgr()
     mgr.allocate("a", 4)
+    # corruption injection MUST bypass the public surface — that is the
+    # point of the test  # tpu-lint: disable=private-kvcache
     mgr._free.append(mgr._tables["a"][0])          # free a live page
     with pytest.raises(RuntimeError, match="overlap"):
         mgr.check_conservation()
@@ -398,19 +398,13 @@ def test_no_private_pool_access_outside_ops_and_kvcache():
     paddle_tpu/kvcache/: every other layer sizes requests via the public
     ``pages_for()``/``usable_pages`` surface, and only the pool itself
     touches the free list (the refcount/cached states make direct free-
-    list surgery unsound)."""
-    pattern = re.compile(r"\._pages_for\b|\._free\b")
-    offenders = []
-    for sub in ("paddle_tpu", "tests", "benchmarks"):
-        for path in sorted((REPO / sub).rglob("*.py")):
-            rel = path.relative_to(REPO).as_posix()
-            if (rel.startswith("paddle_tpu/ops/")
-                    or rel.startswith("paddle_tpu/kvcache/")
-                    or path == Path(__file__).resolve()):
-                continue
-            for i, line in enumerate(path.read_text().splitlines(), 1):
-                if pattern.search(line):
-                    offenders.append(f"{rel}:{i}")
-    assert not offenders, (
-        f"private page-pool access in {offenders}; use pages_for()/"
-        "usable_pages, or route page ownership through paddle_tpu.kvcache")
+    list surgery unsound). Ported to tpu-lint (rule ``private-kvcache``
+    — AST attribute analysis, so this file no longer needs to exclude
+    itself: the deliberate corruption-injection above carries an inline
+    ``# tpu-lint: disable=`` instead)."""
+    from paddle_tpu import analysis
+    bad = analysis.cached_report().new_for_rule("private-kvcache")
+    assert not bad, (
+        "private page-pool access:\n" + "\n".join(f.text() for f in bad)
+        + "\nuse pages_for()/usable_pages, or route page ownership "
+        "through paddle_tpu.kvcache")

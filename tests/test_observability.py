@@ -8,7 +8,6 @@ tests/test_serving.py); a fixed engine seed keeps assertions stable."""
 import json
 import os
 import re
-from pathlib import Path
 
 import numpy as np
 import pytest
@@ -29,7 +28,6 @@ from paddle_tpu.profiler.record import RecordEvent, host_recorder
 from paddle_tpu.resilience import ResilienceMetrics
 from paddle_tpu.serving import SchedulerConfig, ServingMetrics, ServingScheduler
 
-REPO = Path(__file__).resolve().parent.parent
 
 
 def _setup(max_new=4, num_slots=2, chunk=2, seed=3, **sched_kw):
@@ -623,20 +621,13 @@ def test_no_adhoc_prometheus_formatters_outside_observability():
     """Forbid new private Prometheus/histogram formatters: any module
     emitting bucket/TYPE exposition lines must delegate to
     ``paddle_tpu.observability.format`` (the single formatter), like the
-    serving and resilience sinks do."""
-    patterns = re.compile(
-        r'_bucket\{+le=|\{le="|# TYPE \{|"# TYPE |f"# TYPE|'
-        r"quantile=\\\"|_prometheus_fmt")
-    pkg = REPO / "paddle_tpu"
-    allowed = {pkg / "observability" / "format.py"}
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        if path in allowed:
-            continue
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            if patterns.search(line):
-                offenders.append(f"{path.relative_to(REPO)}:{i}")
-    assert not offenders, (
-        f"ad-hoc Prometheus formatting in {offenders}; assemble exposition "
-        "lines via paddle_tpu.observability.format so the registry stays "
-        "the single valid /metrics surface")
+    serving and resilience sinks do. Ported to tpu-lint (rule
+    ``layer-prom-format`` — scans string CONSTANTS in the AST, so code
+    mentioning the tokens in comments/docs can't false-positive)."""
+    from paddle_tpu import analysis
+    bad = analysis.cached_report().new_for_rule("layer-prom-format")
+    assert not bad, (
+        "ad-hoc Prometheus formatting:\n"
+        + "\n".join(f.text() for f in bad)
+        + "\nassemble exposition lines via paddle_tpu.observability."
+        "format so the registry stays the single valid /metrics surface")

@@ -1,99 +1,56 @@
-"""Tooling lint for the diagnostics layer (ISSUE 5 satellite).
+"""Architectural lints for the diagnostics layer — ported to tpu-lint.
 
-Two architectural rules, enforced over the whole package source:
+These used to be four regex greps with their own ``_offenders()``
+walker; they are now thin asserts over the shared
+:func:`paddle_tpu.analysis.cached_report` run (ISSUE 8 satellite — one
+engine, one parse per file, suppressions + baseline instead of
+hard-coded allowlists). The rules themselves live in
+``paddle_tpu/analysis/layering.py``:
 
-1. **One debug surface.** ``http.server`` (and new raw ``socket``
-   listeners) live ONLY in ``observability/server.py`` — ad-hoc debug
-   endpoints fragment the operable surface and dodge the /healthz
-   semantics. The pre-existing collective-bootstrap networking
-   (``distributed/launch``, ``distributed/store``) is grandfathered: it
-   implements the training rendezvous protocol, not diagnostics.
-
-2. **Deterministic SLO math.** ``slo.py`` and ``goodput.py`` must never
-   read the wall clock (``time.time``): SLO windows advance only on the
-   injected step-driven clock, goodput only on durations fed by the
-   trainer — that is what makes breach/recover transitions and goodput
-   breakdowns byte-reproducible in chaos replays.
-
-3. **Replica encapsulation** (ISSUE 6 satellite). Nothing outside
-   ``paddle_tpu/serving/`` reaches into ``ReplicaHandle`` privates
-   (``._scheduler``, ``._fault``): the router's public surface
-   (``submit``/``cancel``/``step``/``statusz``/``health``/chaos
-   methods) is the replica contract, and bypassing it would let other
-   layers race the breaker/drain state machine.
+* ``layer-http``       — http.server ONLY in observability/server.py
+* ``layer-socket``     — raw sockets only in the DiagServer + the
+                         grandfathered distributed rendezvous modules
+* ``private-replica``  — nothing outside serving/ touches ReplicaHandle
+                         privates (``._scheduler``, ``._fault``)
+* ``layer-wall-clock`` — slo.py / goodput.py never read time.time
 """
 
-import re
-from pathlib import Path
-
-REPO = Path(__file__).resolve().parent.parent
-PKG = REPO / "paddle_tpu"
+from paddle_tpu import analysis
 
 
-def _offenders(pattern: re.Pattern, paths, allowed=()):
-    allowed = {PKG / a for a in allowed}
-    out = []
-    for path in sorted(paths):
-        if path in allowed:
-            continue
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            if pattern.search(line):
-                out.append(f"{path.relative_to(REPO)}:{i}: {line.strip()}")
-    return out
+def _assert_clean(rule: str, hint: str) -> None:
+    rep = analysis.cached_report()
+    bad = rep.new_for_rule(rule)
+    assert not bad, (
+        f"[{rule}] {hint}:\n" + "\n".join(f.text() for f in bad))
 
 
 def test_http_server_only_in_diagserver():
-    pattern = re.compile(r"^\s*(import http\.server|from http\.server\b|"
-                         r"import http\b|from http import)")
-    offenders = _offenders(pattern, PKG.rglob("*.py"),
-                           allowed=("observability/server.py",))
-    assert not offenders, (
-        f"http.server outside observability/server.py: {offenders}; the "
-        "DiagServer is the ONE debug endpoint — register a /statusz "
-        "provider instead of opening another listener")
+    _assert_clean("layer-http",
+                  "the DiagServer is the ONE debug endpoint — register "
+                  "a /statusz provider instead of opening a listener")
 
 
 def test_raw_sockets_only_in_sanctioned_modules():
-    pattern = re.compile(r"^\s*(import socket\b|from socket import)")
-    # distributed networking predates the rule and implements the
-    # launch/rendezvous protocol (not a diagnostics surface)
-    allowed = ("observability/server.py",
-               "distributed/launch/context.py",
-               "distributed/launch/master.py",
-               "distributed/store.py")
-    offenders = _offenders(pattern, PKG.rglob("*.py"), allowed=allowed)
-    assert not offenders, (
-        f"raw socket usage in {offenders}; new listeners belong in "
-        "observability/server.py (diagnostics) or the sanctioned "
-        "distributed rendezvous modules")
+    _assert_clean("layer-socket",
+                  "new listeners belong in observability/server.py or "
+                  "the sanctioned distributed rendezvous modules")
 
 
 def test_replica_handle_privates_only_in_serving():
-    pattern = re.compile(r"\._(?:scheduler|fault)\b")
-    offenders = []
-    for sub in ("paddle_tpu", "tests", "benchmarks"):
-        for path in sorted((REPO / sub).rglob("*.py")):
-            rel = path.relative_to(REPO).as_posix()
-            if (rel.startswith("paddle_tpu/serving/")
-                    or path == Path(__file__).resolve()):
-                continue
-            for i, line in enumerate(path.read_text().splitlines(), 1):
-                if pattern.search(line):
-                    offenders.append(f"{rel}:{i}")
-    assert not offenders, (
-        f"ReplicaHandle private access in {offenders}; route through the "
-        "public replica surface (submit/cancel/step/statusz/health) or "
-        "the FleetRouter — the breaker/drain state machine owns those "
-        "internals")
+    _assert_clean("private-replica",
+                  "route through the public replica surface — the "
+                  "breaker/drain state machine owns those internals")
 
 
 def test_slo_and_goodput_never_read_wall_clock():
-    pattern = re.compile(r"time\.time\(")
-    paths = [PKG / "observability" / "slo.py",
-             PKG / "observability" / "goodput.py"]
-    assert all(p.exists() for p in paths)
-    offenders = _offenders(pattern, paths)
-    assert not offenders, (
-        f"wall-clock read in {offenders}; SLO/goodput math runs on "
-        "injected step-driven clocks only, so tests and chaos replays "
-        "stay deterministic")
+    _assert_clean("layer-wall-clock",
+                  "SLO/goodput math runs on injected step-driven "
+                  "clocks only, so chaos replays stay deterministic")
+
+
+def test_rules_exist_in_engine():
+    """The ported rules stay wired into the default rule set."""
+    ids = {r.id for r in analysis.default_rules()}
+    assert {"layer-http", "layer-socket", "private-replica",
+            "layer-wall-clock"} <= ids

@@ -10,7 +10,6 @@ import re
 import sys
 import textwrap
 import threading
-from pathlib import Path
 
 import numpy as np
 import pytest
@@ -31,7 +30,6 @@ from paddle_tpu.resilience import (
     restore_train_state, save_checkpoint,
 )
 
-REPO = Path(__file__).resolve().parent.parent
 
 
 def _make_ts(seed=21, lr=1e-2):
@@ -636,18 +634,12 @@ def test_pod_restart_budget_exhausted_records_structured_reason(tmp_path):
 def test_no_unstaged_writes_in_checkpoint_package():
     """Forbid direct write-mode ``open`` under
     ``paddle_tpu/distributed/checkpoint/``; ``utils.atomic_write`` is the
-    single durable write path (stage + fsync + CRC32 + rename)."""
-    write_open = re.compile(r"""open\([^)]*,\s*["'](?:[wax]b?\+?|r\+b?)["']""")
-    pkg = REPO / "paddle_tpu" / "distributed" / "checkpoint"
-    allowed = {pkg / "utils.py"}  # atomic_write's own staging handle
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        if path in allowed:
-            continue
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            if write_open.search(line):
-                offenders.append(f"{path.relative_to(REPO)}:{i}")
-    assert not offenders, (
-        f"unstaged write-mode open() in {offenders}; use "
-        "paddle_tpu.distributed.checkpoint.utils.atomic_write so a crash "
-        "can never leave a torn checkpoint file")
+    single durable write path (stage + fsync + CRC32 + rename). Ported
+    to tpu-lint (rule ``layer-atomic-write`` — AST call analysis instead
+    of a line regex, so multi-line opens and mode= kwargs are covered)."""
+    from paddle_tpu import analysis
+    bad = analysis.cached_report().new_for_rule("layer-atomic-write")
+    assert not bad, (
+        "unstaged write-mode open():\n" + "\n".join(f.text() for f in bad)
+        + "\nuse paddle_tpu.distributed.checkpoint.utils.atomic_write so "
+        "a crash can never leave a torn checkpoint file")

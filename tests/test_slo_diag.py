@@ -620,3 +620,52 @@ def test_straggler_detector_mad_zscore():
     after = get_registry().get("paddle_stragglers_total") \
         .value(source="unit")
     assert after - before == 1
+
+
+# ---------------------------------------------------------------------------
+# regression (ISSUE 8, tpu-lint lock-unguarded-write): flight-recorder
+# ring appends hold the lock
+# ---------------------------------------------------------------------------
+
+class _CountingLock:
+    """Context-manager lock stand-in that counts acquisitions."""
+
+    def __init__(self):
+        self.entries = 0
+
+    def __enter__(self):
+        self.entries += 1
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_flight_note_methods_hold_the_lock():
+    """``arm()`` REBINDS the rings when resizing; an unlocked
+    ``note_event``/``note_span``/``note_metrics`` could append into the
+    abandoned deque and silently lose the record from the next debug
+    bundle. tpu-lint's lock-unguarded-write rule flagged exactly that —
+    the fix takes the lock, asserted here."""
+    rec = FlightRecorder(capacity=4)
+    lock = _CountingLock()
+    rec._lock = lock
+    rec.note_event({"kind": "x"})
+    assert lock.entries == 1
+    rec.note_span(("s",))
+    assert lock.entries == 2
+    rec.note_metrics("m", {"v": 1})
+    assert lock.entries == 3
+
+
+def test_flight_rearm_resize_keeps_concurrent_events():
+    """End-to-end shape of the race the lock closes: records noted
+    around an ``arm(capacity=...)`` resize land in the LIVE ring."""
+    rec = FlightRecorder(capacity=2)
+    rec.arm()
+    rec.note_event({"kind": "before"})
+    rec.arm(capacity=8)                     # rebinds the rings
+    rec.note_event({"kind": "after"})
+    status = rec.snapshot_status()
+    assert status["events"] == 2            # both survived the rebind
+    flight_armed[0] = False
