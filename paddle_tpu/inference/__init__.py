@@ -18,3 +18,6 @@ from .decoding import (  # noqa: F401
     ContinuousBatchingEngine, GenerationConfig, GenerationEngine,
     PagedGenerationEngine, KVCache,
 )
+from .speculative import (  # noqa: F401
+    Drafter, DraftModel, NgramDrafter, SpeculationTelemetry,
+)

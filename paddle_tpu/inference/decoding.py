@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..flags import flag_value
+from ..observability.events import emit_event
 from ..observability.runtime import recompiles
 from ..profiler.record import emit_span, host_recorder
 
@@ -321,6 +322,18 @@ class ContinuousBatchingEngine:
     (``_build_decode_chunk``) — for A/B benches; both paths emit
     byte-identical greedy tokens.
 
+    Speculative decoding (``speculative=True``, default off): each
+    decode row's round becomes ``[carry] + up to spec_k drafted
+    tokens`` (inference/speculative.py — prompt-lookup self-drafting by
+    default, ``DraftModel`` hook for a small draft model), verified by
+    the SAME single-dispatch ragged program: the per-row last-token
+    logits generalize to per-candidate logits, accept/reject is a
+    host-side argmax comparison, and rejection rolls the paged pool
+    back per row (``mgr.truncate_pages``). Greedy output stays
+    byte-identical to non-speculative by construction
+    (verify-then-commit); ``check_conservation`` runs after every
+    speculative step.
+
     Host-fence discipline (the axon tunnel makes every device->host value
     dependency a full round trip): the ONLY transfer per round is the
     decode chunk's emitted tokens. Slot tokens live on device (admission
@@ -342,7 +355,9 @@ class ContinuousBatchingEngine:
                  max_seq_len: int = 2048, num_pages: Optional[int] = None,
                  chunk: int = 16, prefix_cache: bool = False,
                  check_invariants: bool = True, unified: bool = True,
-                 step_tokens: Optional[int] = None):
+                 step_tokens: Optional[int] = None,
+                 speculative: bool = False, spec_k: int = 4,
+                 drafter=None):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -371,9 +386,12 @@ class ContinuousBatchingEngine:
                 mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
             self.cache = None
         # the conservation audit is O(pool) host work per step; on by
-        # default (it anchors the shared-ownership model) but opt-out for
-        # latency-critical deployments with very large pools
-        self._check_invariants = check_invariants and prefix_cache
+        # default (it anchors the shared-ownership model, and speculative
+        # draft growth/rollback is the first path that returns pages
+        # mid-sequence) but opt-out for latency-critical deployments
+        # with very large pools
+        self._check_invariants = check_invariants and (prefix_cache
+                                                       or speculative)
         # host slot state
         self._slot_rid = [None] * num_slots       # rid occupying each slot
         self._queue: list = []                    # pending _Request
@@ -399,6 +417,42 @@ class ContinuousBatchingEngine:
         self._unified_step = None
         self._unified_flags = None      # host state baked into the program
         self._pend = [None] * num_slots   # per-slot unfed prompt suffix
+        # speculative decoding (inference/speculative.py): each decode
+        # row's round becomes [carry + up to spec_k drafted tokens] — a
+        # short prefill the same ragged program verifies in ONE dispatch
+        # whose per-candidate argmax IS the accept/reject oracle.
+        # Default OFF: the non-speculative paths above are byte-for-byte
+        # untouched.
+        self._speculative = bool(speculative)
+        self.spec_k = int(spec_k)
+        self.spec = None                # SpeculationTelemetry when enabled
+        self.drafter = drafter
+        self._spec_step = None
+        self._spec_flags = None
+        if speculative:
+            if not unified:
+                raise ValueError(
+                    "speculative decoding rides the unified ragged step; "
+                    "construct with unified=True")
+            if self.config.do_sample:
+                raise ValueError(
+                    "speculative decoding is greedy-only: accept/reject "
+                    "compares drafts against the model's argmax, and "
+                    "committed tokens are byte-identical to "
+                    "non-speculative greedy decoding by construction. "
+                    "Sampling needs a rejection-sampling verifier "
+                    "(see README) — disable do_sample or speculative")
+            from .speculative import NgramDrafter, SpeculationTelemetry
+            self.drafter = drafter or NgramDrafter()
+            self.spec = SpeculationTelemetry()
+            # packed axis: every slot may speculate (1 carry + spec_k
+            # drafts) in the same round; prefill shares what's left
+            self._spec_tokens = max(self._step_tokens,
+                                    num_slots * (self.spec_k + 1))
+            # admission's page reservation per slot: rollback never
+            # truncates below it (it is the row's guarantee that
+            # committed decode can't OOM mid-flight)
+            self._reserved = np.zeros((num_slots,), np.int64)
         #: prompt tokens actually run through prefill (cache hits skip
         #: their cached prefix; benchmarks diff this against submitted
         #: prompt lengths for the skip ratio)
@@ -712,9 +766,15 @@ class ContinuousBatchingEngine:
                 # instead of draining to the free list. Positions past the
                 # kept output may hold over-decoded garbage, but those
                 # never complete a block (full blocks end <= kept length).
-                self.cache.insert(
-                    [int(t) for t in req.prompt] + [int(t) for t in out],
-                    self.mgr._tables[rid])
+                toks = ([int(t) for t in req.prompt]
+                        + [int(t) for t in out])
+                if self._speculative and out:
+                    # the last delivered token may be the verify bonus —
+                    # committed but never fed back, so its K/V slot was
+                    # never written. Index one token short so a future
+                    # cache hit can never attend a hole.
+                    toks = toks[:-1]
+                self.cache.insert(toks, self.mgr._tables[rid])
             if self.finish_callback is not None:
                 self.finish_callback(rid, out)
         self.mgr.free(rid)
@@ -755,7 +815,11 @@ class ContinuousBatchingEngine:
         the round is ONE ragged dispatch — newly admitted prompts join
         the current step's packed batch immediately, alongside every
         decoding row. Legacy mode replays the pre-unified pipeline
-        (bucketed prefill waves + per-shape decode chunk)."""
+        (bucketed prefill waves + per-shape decode chunk). Speculative
+        mode folds draft verification into the same single dispatch
+        (``_step_spec``)."""
+        if self._speculative:
+            return self._step_spec(params)
         if self._unified:
             return self._step_unified(params)
         return self._step_legacy(params)
@@ -1006,6 +1070,256 @@ class ContinuousBatchingEngine:
                 # (refcounted) or cached — checked after EVERY ragged
                 # step, COW suffix rows included
                 self.mgr.check_conservation()
+            self.cache.update_gauges()
+        return len(self._live)
+
+    # -- speculative decoding (draft + verify in ONE ragged dispatch) --------
+
+    def _build_spec_step(self):
+        """ONE compiled program for every speculative round the engine
+        will ever run: a single ragged model step whose logits are taken
+        at EVERY packed candidate index (``cand_idx`` — the generalized
+        ``last_idx`` of ``models.llama.ragged_step``) and argmax'd
+        in-program. A speculating row's span ``[carry, d1..dk]`` is just
+        a short prefill at consecutive positions under the kernel's one
+        ``key_pos <= position`` mask rule, so the per-candidate greedy
+        tokens that come back ARE the verifier: ``g[j]`` is the model's
+        next token after the row's history + ``span[0..j]``, valid
+        exactly while the drafted prefix matches — the host accepts the
+        longest matching prefix plus the bonus token. Shapes depend only
+        on (spec_tokens, slots*(k+1), table width) fixed at construction
+        — the request mix, draft lengths and acceptance history never
+        recompile anything."""
+        L = self._L
+        mcfg = self.model_config
+
+        def run(params, ids, token_row, positions, kv_lens, cand_idx,
+                k_pages, v_pages, bt):
+            logits, kp, vp = L.ragged_step(
+                params, ids, token_row, positions, kv_lens, cand_idx,
+                k_pages, v_pages, bt, mcfg)
+            # greedy-only by construction (__init__ rejects do_sample):
+            # the in-program argmax keeps the fence at (slots*(k+1),)
+            # int32 instead of shipping full (C, V) logits to the host
+            toks = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+            return toks.astype(jnp.int32), kp, vp
+
+        return jax.jit(run, donate_argnums=(6, 7))
+
+    def _plan_spec(self):
+        """Host layout of one speculative round. Every decode row claims
+        a span of ``[carry] + up to spec_k drafted tokens`` — its page
+        table grows to cover the speculative tail (``mgr.grow_to``);
+        pool pressure or the block-table span shrink the draft, never
+        fail the round. Prefill rows share the remaining packed budget
+        exactly like ``_plan_step``'s single micro-round. Returns the
+        device metadata arrays, the per-slot verify plan and the
+        per-slot prefill-token counts."""
+        T, n_rows = self._spec_tokens, self.num_slots
+        k1 = self.spec_k + 1
+        cap_tokens = self._table_width * self.page_size
+        ids = np.zeros((T,), np.int32)
+        token_row = np.full((T,), -1, np.int32)
+        positions = np.zeros((T,), np.int32)
+        kv_lens = np.zeros((n_rows,), np.int32)
+        cand_idx = np.zeros((n_rows * k1,), np.int32)
+        info: Dict[int, tuple] = {}
+        fed = np.zeros((n_rows,), np.int64)
+        live = [s for s in range(n_rows) if self._slot_rid[s] is not None]
+        spans: Dict[int, tuple] = {}
+        for s in live:
+            if self._pend[s] is not None:
+                continue                      # prefilling: planned below
+            rid = self._slot_rid[s]
+            req = self._live[rid]
+            # committed history (prompt + delivered tokens; the last
+            # delivered token IS the carry whose K/V this round writes)
+            history = [int(t) for t in req.prompt] + req.tokens
+            draft = [int(t) for t in
+                     self.drafter.draft(history, self.spec_k)]
+            pos0 = int(self._pos[s])
+            # clamp the draft to (a) the remaining token budget: a
+            # round commits at most accepted+1 <= len(draft)+1 tokens
+            # and _deliver_tokens trims at the budget, so positions
+            # past rem-1 could never commit — verifying them would be
+            # pure waste and the page they'd grow would be freed right
+            # back; (b) the row's block-table span (the model clips
+            # positions past it into the last slot, which would corrupt
+            # real pages)
+            rem = self._budget(req) - len(req.tokens)
+            draft = draft[:max(0, min(self.spec_k, rem - 1,
+                                      cap_tokens - 1 - pos0))]
+            # ensure the page table covers the span. With the budget
+            # clamp above the span sits inside the admission
+            # reservation and this is a no-op; it is the engine's
+            # safety net (and the hook a lazy-allocation admission mode
+            # would grow through — mgr.grow_to/truncate_pages are
+            # exercised as the speculative substrate by the kvcache
+            # interleaving property test). Under pool pressure the
+            # draft shrinks; the carry's own slot always fits.
+            while True:
+                try:
+                    self.mgr.grow_to(rid, pos0 + len(draft) + 1)
+                    break
+                except MemoryError:
+                    draft.pop()
+            tbl = self.mgr._tables[rid]
+            self._bt[s] = 0
+            self._bt[s, :len(tbl)] = tbl
+            spans[s] = (pos0, [history[-1]] + draft, draft)
+        budget = T - sum(1 + len(d) for _, _, d in spans.values())
+        cursor = 0
+        for s in live:
+            if s in spans:                    # decode: speculative span
+                pos0, span, draft = spans[s]
+                n = len(span)
+                ids[cursor:cursor + n] = span
+                token_row[cursor:cursor + n] = s
+                positions[cursor:cursor + n] = pos0 + np.arange(n)
+                kv_lens[s] = pos0 + n
+                cand_idx[s * k1:s * k1 + n] = cursor + np.arange(n)
+                info[s] = ("spec", pos0, draft)
+                cursor += n
+            else:                             # prefilling
+                rem = len(self._pend[s])
+                n = min(rem, budget)
+                if n == 0:
+                    continue                  # starved this round
+                pos0 = int(self._pos[s])
+                ids[cursor:cursor + n] = self._pend[s][:n]
+                token_row[cursor:cursor + n] = s
+                positions[cursor:cursor + n] = pos0 + np.arange(n)
+                kv_lens[s] = pos0 + n
+                budget -= n
+                fed[s] = n
+                self._pos[s] = pos0 + n
+                if n == rem:
+                    # prompt complete: this round's last logits are the
+                    # row's first (greedy) sample
+                    cand_idx[s * k1] = cursor + n - 1
+                    info[s] = ("first_sample",)
+                    self._pend[s] = None
+                else:
+                    self._pend[s] = self._pend[s][n:]
+                cursor += n
+        return (ids, token_row, positions, kv_lens, cand_idx), info, fed
+
+    def _verify_spec(self, toks, info):
+        """Host accept/reject over the dispatch's per-candidate greedy
+        tokens: commit the longest drafted prefix that matches the
+        model's own argmax chain plus the bonus token, roll the paged KV
+        back on rejection, deliver through the shared
+        ``_deliver_tokens`` contract (callbacks, budget/EOS retire,
+        reentrant cancel)."""
+        k1 = self.spec_k + 1
+        for s in sorted(info):
+            rid = self._slot_rid[s]
+            if rid is None:
+                continue                    # retired by a reentrant cancel
+            entry = info[s]
+            if entry[0] == "first_sample":
+                self._deliver_tokens(s, [int(toks[s * k1])])
+                continue
+            _, pos0, draft = entry
+            g = [int(t) for t in toks[s * k1:s * k1 + len(draft) + 1]]
+            a = 0
+            while a < len(draft) and draft[a] == g[a]:
+                a += 1
+            committed = pos0 + a + 1        # carry + accepted drafts
+            self.spec.note_verify(len(draft), a)
+            if a < len(draft):
+                # rejection rollback: stale K/V *within* kept pages is
+                # overwritten before anything attends to it (scatter-
+                # first), but a page that exists only for rejected
+                # positions is stranded — deref/free it now, never
+                # dropping below the admission reservation
+                keep = max(self.mgr.pages_for(committed),
+                           int(self._reserved[s]))
+                freed = self.mgr.truncate_pages(rid, keep)
+                tbl = self.mgr._tables[rid]
+                self._bt[s] = 0
+                self._bt[s, :len(tbl)] = tbl
+                self.spec.note_rollback(len(freed))
+                emit_event("spec_rollback", request_id=rid,
+                           trace_id=self._live[rid].trace_id,
+                           drafted=len(draft), accepted=a,
+                           freed_pages=len(freed))
+            self._pos[s] = committed
+            self.mgr._lens[rid] = committed
+            self._deliver_tokens(
+                s, [int(t) for t in draft[:a]] + [g[a]])
+
+    def _step_spec(self, params) -> int:
+        """One speculative round: host-only admission, drafting + page
+        growth, ONE dispatch whose candidate argmaxes verify every
+        row's draft, host accept/reject + paged rollback. The single
+        device→host transfer is the ``(slots*(spec_k+1),)`` candidate
+        token vector — smaller than the unified step's emit matrix."""
+        picked = self._admit_pick()
+        for s, req, pages, lp, nc in picked:
+            self._slot_rid[s] = req.rid
+            self._live[req.rid] = req
+            self._pos[s] = nc               # next position to write
+            self._bt[s] = 0
+            self._bt[s, :len(pages)] = pages
+            self._pend[s] = np.asarray(req.prompt[nc:], np.int32)
+            self._reserved[s] = len(pages)
+        if not self._live:
+            if self._check_invariants:
+                self.mgr.check_conservation()
+            return 0
+        fresh = (self._spec_step is None
+                 or self._spec_flags != _prefill_flags())
+        if fresh:
+            # the speculative engine's ONE compile-cache miss; a
+            # set_flags flip of baked-in host state is the one
+            # sanctioned extra (same contract as the unified step)
+            self._spec_flags = _prefill_flags()
+            recompiles.record_miss(
+                "cbe.spec_step",
+                (self.num_slots, self._spec_tokens, self.spec_k,
+                 self._table_width) + self._spec_flags)
+            self._spec_step = self._build_spec_step()
+        plan, info, fed = self._plan_spec()
+        self._prefill_tokens += int(fed.sum())
+        if fresh:
+            c0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns() if host_recorder.enabled else 0
+        toks, self.mgr.k_pages, self.mgr.v_pages = self._spec_step(
+            params, *(jnp.asarray(a) for a in plan), self.mgr.k_pages,
+            self.mgr.v_pages, jnp.asarray(self._bt))
+        if fresh:
+            jax.block_until_ready(toks)
+            recompiles.observe_compile("cbe.spec_step",
+                                       time.perf_counter() - c0)
+        toks = np.asarray(toks)                    # the one fence
+        if t0_ns:
+            t1_ns = time.perf_counter_ns()
+            for s in range(self.num_slots):
+                rid = self._slot_rid[s]
+                if rid is None:
+                    continue
+                req = self._live[rid]
+                if fed[s] > 0:
+                    emit_span("engine.prefill", t0_ns, t1_ns,
+                              event_type="Operator",
+                              trace_id=req.trace_id,
+                              args={"request_id": rid, "slot": s,
+                                    "prefill_tokens": int(fed[s])})
+                if info.get(s, ("",))[0] == "spec":
+                    emit_span("engine.spec_round", t0_ns, t1_ns,
+                              event_type="Operator",
+                              trace_id=req.trace_id,
+                              args={"request_id": rid, "slot": s,
+                                    "drafted": len(info[s][2])})
+        self._verify_spec(toks, info)
+        if self._check_invariants:
+            # the ownership-model anchor, now also covering draft
+            # growth and rejection rollback: audited after EVERY
+            # speculative step (spec mode runs it even cache-off — the
+            # base manager grew an exclusive-ownership audit for this)
+            self.mgr.check_conservation()
+        if self.cache is not None:
             self.cache.update_gauges()
         return len(self._live)
 

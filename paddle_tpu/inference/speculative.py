@@ -1,0 +1,182 @@
+"""Speculative decoding: drafters + verification bookkeeping for the
+unified ragged step.
+
+The engine-side mechanics live in ``decoding.ContinuousBatchingEngine``
+(``speculative=True``); this module owns the two pieces that are policy,
+not engine plumbing:
+
+* **Drafters** — where candidate tokens come from. The default is
+  :class:`NgramDrafter`, prompt-lookup *self*-drafting (no second
+  model): propose the tokens that followed the most recent earlier
+  occurrence of the history's trailing n-gram. Serving traffic is full
+  of copied spans (templated prompts, quoted context, the quasi-cyclic
+  tails greedy decoding settles into), so lookup drafts are free and
+  surprisingly accurate. :class:`DraftModel` is the hook for a real
+  draft model (a small Llama): anything with ``draft(history, k) ->
+  tokens`` plugs into the engine unchanged.
+* **Telemetry** — :class:`SpeculationTelemetry` declares the
+  ``paddle_spec_*`` registry families (observability/catalog.py) and
+  keeps the host-side mirror the benchmarks/``statusz`` read.
+
+Why drafting composes with the ragged step for free: verifying k
+drafted tokens is exactly a *short prefill* of k+1 tokens at
+consecutive positions — the kernel's one mask rule
+``key_pos <= position`` already covers it, and taking the model's
+logits at every packed candidate index (instead of only each row's
+last token) turns the single dispatch into the verifier. Greedy
+accept/reject is then a host-side argmax comparison; the committed
+stream is byte-identical to non-speculative greedy decoding by
+construction (verify-then-commit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..observability.registry import get_registry
+
+
+class Drafter:
+    """Pluggable draft-token source for the speculative engine.
+
+    ``draft(history, k)`` proposes up to ``k`` continuation tokens for a
+    row whose committed tokens (prompt + generated, most recent last)
+    are ``history``. Returning fewer than ``k`` — or ``[]`` — is always
+    legal: the row simply decodes plainly that round. Drafters must be
+    pure host-side functions of the history (no device state), so a
+    rejected draft leaves nothing to roll back outside the KV pool."""
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup self-drafting (no draft model).
+
+    Find the longest trailing n-gram of the history (``max_ngram`` down
+    to ``min_ngram``) that also occurs earlier, take the MOST RECENT
+    earlier occurrence, and propose the ``k`` tokens that followed it.
+    Longest-match-first keeps precision high; most-recent-first tracks
+    the current cycle/template rather than a stale one."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        h = np.asarray(history, np.int64)
+        n_hist = len(h)
+        if k <= 0 or n_hist < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_hist - 1),
+                       self.min_ngram - 1, -1):
+            pat = h[n_hist - n:]
+            # all windows of length n except the trailing pattern itself;
+            # a match must leave >= 1 continuation token
+            wins = np.lib.stride_tricks.sliding_window_view(h, n)[:-1]
+            hits = np.nonzero((wins == pat).all(axis=1))[0]
+            if len(hits):
+                start = int(hits[-1]) + n          # most recent match
+                return [int(t) for t in h[start:start + k]]
+        return []
+
+
+class DraftModel(Drafter):
+    """Draft-model hook: greedy-draft ``k`` tokens with a (much smaller)
+    stacked-param Llama.
+
+    The draft model runs cache-less over a right-padded ``window`` of
+    the history — one compiled program total, k forwards per draft.
+    That is deliberately the simplest correct thing: the hook exists so
+    a real deployment can swap in a cached draft engine; the contract
+    is only ``draft(history, k)``."""
+
+    def __init__(self, params, config, window: int = 128):
+        from ..models import llama as L
+        import jax
+        self.params = params
+        self.config = config
+        self.window = int(window)
+        self._fwd = jax.jit(
+            functools.partial(L.forward_stacked, config=config))
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        import jax.numpy as jnp
+        out: List[int] = []
+        for _ in range(max(0, k)):
+            tail = [int(t) for t in history][-self.window:]
+            tail = (tail + out)[-self.window:]
+            ids = np.zeros((1, self.window), np.int32)
+            ids[0, :len(tail)] = tail
+            logits = self._fwd(self.params, jnp.asarray(ids))
+            out.append(int(jnp.argmax(
+                logits[0, len(tail) - 1].astype(jnp.float32))))
+        return out
+
+
+class SpeculationTelemetry:
+    """Registry families + host mirror for speculation health.
+
+    One instance per speculative engine; ``replica`` is the label value
+    (``ReplicaHandle`` stamps its replica id so the fleet view can tell
+    the engines apart — a single-engine deployment keeps ``"0"``)."""
+
+    def __init__(self, replica: str = "0"):
+        self.replica = str(replica)
+        self.stats: Dict[str, int] = {
+            "rounds": 0, "drafted": 0, "accepted": 0, "rejected": 0,
+            "rollbacks": 0, "rollback_pages": 0,
+        }
+        reg = get_registry()
+        self._c_drafted = reg.counter(
+            "paddle_spec_drafted_tokens_total",
+            "draft tokens fed into speculative verification",
+            labels=("replica",))
+        self._c_accepted = reg.counter(
+            "paddle_spec_accepted_tokens_total",
+            "draft tokens verified equal to the greedy continuation",
+            labels=("replica",))
+        self._c_rejected = reg.counter(
+            "paddle_spec_rejected_tokens_total",
+            "draft tokens rejected (KV rolled back per row)",
+            labels=("replica",))
+        self._g_ratio = reg.gauge(
+            "paddle_spec_acceptance_ratio",
+            "cumulative accepted/drafted draft-token ratio",
+            labels=("replica",))
+
+    def note_verify(self, drafted: int, accepted: int) -> None:
+        """Account one row's verify outcome (``accepted <= drafted``)."""
+        self.stats["rounds"] += 1
+        self.stats["drafted"] += drafted
+        self.stats["accepted"] += accepted
+        self.stats["rejected"] += drafted - accepted
+        if drafted:
+            self._c_drafted.inc(drafted, replica=self.replica)
+            if accepted:
+                self._c_accepted.inc(accepted, replica=self.replica)
+            if drafted - accepted:
+                self._c_rejected.inc(drafted - accepted,
+                                     replica=self.replica)
+            self._g_ratio.set(self.acceptance_ratio, replica=self.replica)
+
+    def note_rollback(self, pages_freed: int) -> None:
+        self.stats["rollbacks"] += 1
+        self.stats["rollback_pages"] += pages_freed
+
+    @property
+    def acceptance_ratio(self) -> float:
+        d = self.stats["drafted"]
+        return self.stats["accepted"] / d if d else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        out: Dict[str, object] = dict(self.stats)
+        out["acceptance_ratio"] = round(self.acceptance_ratio, 4)
+        return out

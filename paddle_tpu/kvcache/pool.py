@@ -97,6 +97,42 @@ class RefcountedKVCacheManager(PagedKVCacheManager):
             self._tables[seq_id].append(p)
         self._lens[seq_id] = new_len
 
+    def grow_to(self, seq_id, n_tokens: int) -> List[int]:
+        """Speculative tail growth under shared ownership: appended
+        pages come fresh from the free list at refcount 1 (a drafted
+        span is always written exclusively — sharing happens at
+        admission via ``allocate(shared=...)`` and at retire via the
+        radix tree, never mid-draft). Committed length untouched; see
+        the base class."""
+        added = super().grow_to(seq_id, n_tokens)
+        for p in added:
+            self._refs[p] = self._refs.get(p, 0) + 1
+        return added
+
+    def truncate_pages(self, seq_id, keep_pages: int) -> List[int]:
+        """Speculative rollback under shared ownership: each stranded
+        page is dereferenced; it returns to the free list only at
+        refcount 0 and only if the radix tree doesn't cache it (a
+        cached page stays resident/evictable — same release rule as
+        :meth:`free`). Returns the pages actually freed."""
+        table = self._tables[seq_id]
+        freed: List[int] = []
+        while len(table) > keep_pages:
+            p = table.pop()
+            r = self._refs.get(p, 0) - 1
+            if r < 0:
+                raise RuntimeError(f"page {p} refcount went negative")
+            if r == 0:
+                self._refs.pop(p)
+                if p not in self._cached:
+                    self._free.append(p)
+                    freed.append(p)
+            else:
+                self._refs[p] = r
+        if self._lens.get(seq_id, 0) > keep_pages * self.page_size:
+            self._lens[seq_id] = keep_pages * self.page_size
+        return freed
+
     def free(self, seq_id) -> None:
         """Release a sequence: decrement every page it holds; a page whose
         refcount reaches 0 returns to the free list UNLESS the radix tree

@@ -1138,11 +1138,15 @@ def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
     token_row: (T,) int32 owning row per token; -1 = pad slot
     positions: (T,) int32 absolute KV position per token
     kv_lens:   (R,) int32 per-row attendable span this call (0 = idle)
-    last_idx:  (R,) int32 flat index of each row's last token (rows
-               without tokens may point anywhere; callers mask the
-               resulting logits)
+    last_idx:  (C,) int32 flat token indices to take logits at. The
+               unified engine passes one per row (C == R, each row's
+               last token); the speculative engine passes PER-CANDIDATE
+               indices (C == R * (k+1)) — every token of a drafted span
+               yields its own next-token logits, which is what turns the
+               single dispatch into the draft verifier. Unused entries
+               may point anywhere; callers mask the resulting logits.
     k_pages/v_pages: (L, P, page, nkv, d); block_tables: (R, max_pages)
-    Returns (row_logits (R, V), k_pages', v_pages').
+    Returns (logits (C, V), k_pages', v_pages').
     """
     from ..ops import paged_attention as pa
     t = ids.shape[0]

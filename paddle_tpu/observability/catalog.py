@@ -43,6 +43,11 @@ METRICS = {
     "paddle_router_replica_state": ("gauge", ("replica",)),
     "paddle_router_failovers_total": ("counter", ()),
     "paddle_router_prefix_affinity_hits_total": ("counter", ()),
+    # -- speculative decoding (inference/speculative.py) -------------------
+    "paddle_spec_drafted_tokens_total": ("counter", ("replica",)),
+    "paddle_spec_accepted_tokens_total": ("counter", ("replica",)),
+    "paddle_spec_rejected_tokens_total": ("counter", ("replica",)),
+    "paddle_spec_acceptance_ratio": ("gauge", ("replica",)),
     # -- prefix cache (kvcache/cache.py) -----------------------------------
     "paddle_kvcache_hits_total": ("counter", ()),
     "paddle_kvcache_misses_total": ("counter", ()),
@@ -70,6 +75,8 @@ EVENT_KINDS = {
     "replica_drained", "failover",
     # prefix cache
     "cache_hit", "cache_evict",
+    # speculative decoding (draft rejection -> per-row paged rollback)
+    "spec_rollback",
 }
 
 

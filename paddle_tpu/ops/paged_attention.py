@@ -437,6 +437,79 @@ class PagedKVCacheManager:
         self._free.extend(reversed(self._tables.pop(seq_id)))
         self._lens.pop(seq_id)
 
+    # -- speculative tail growth / rollback ----------------------------------
+
+    def grow_to(self, seq_id, n_tokens: int) -> List[int]:
+        """Ensure the sequence's block table covers ``n_tokens`` without
+        committing them: speculative (drafted) tokens write into page
+        tail positions past the committed length, so the pages must
+        exist before the dispatch but the committed length (``_lens``)
+        stays put until the host verifies the draft. Appended pages come
+        fresh from the free list; raises ``MemoryError`` (leaving the
+        table untouched) when the pool can't cover the span — callers
+        shrink the draft instead. Returns the pages added."""
+        need = self.pages_for(n_tokens) - len(self._tables[seq_id])
+        if need <= 0:
+            return []
+        if len(self._free) < need:
+            raise MemoryError(
+                f"KV pool exhausted on speculative grow: need {need} "
+                f"pages, {len(self._free)} free")
+        added = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id].extend(added)
+        return added
+
+    def truncate_pages(self, seq_id, keep_pages: int) -> List[int]:
+        """Roll a sequence's page span back to its first ``keep_pages``
+        pages: the speculative-rollback primitive. A rejected draft
+        strands any page that exists only to hold rejected tokens —
+        those return to the pool here (stale K/V *within* kept pages
+        needs no scrub: the next token at a position overwrites its slot
+        before anything attends to it, the same scatter-first contract
+        over-decoded garbage already relies on). The committed length is
+        clamped into the kept span. Returns the pages returned to the
+        free list."""
+        table = self._tables[seq_id]
+        freed: List[int] = []
+        while len(table) > keep_pages:
+            p = table.pop()
+            self._free.append(p)
+            freed.append(p)
+        if self._lens.get(seq_id, 0) > keep_pages * self.page_size:
+            self._lens[seq_id] = keep_pages * self.page_size
+        return freed
+
+    def check_conservation(self) -> None:
+        """Exclusive-ownership audit (the refcounted subclass replaces
+        this with the shared-ownership version): every usable page is
+        either free or owned by exactly one sequence exactly once, the
+        two sets are disjoint, and reserved page 0 never circulates.
+        The serving engine runs this after every speculative step even
+        without the prefix cache — draft growth/rollback is the first
+        path that returns pages mid-sequence, so the books get audited
+        on every round that can move them."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise RuntimeError("duplicate pages on the free list")
+        owned: List[int] = []
+        for table in self._tables.values():
+            owned.extend(table)
+        owned_set = set(owned)
+        if len(owned) != len(owned_set):
+            raise RuntimeError("page owned by two sequences (or twice "
+                               "by one) under exclusive ownership")
+        if free & owned_set:
+            raise RuntimeError(
+                f"page state overlap: free∩owned={free & owned_set}")
+        if 0 in free | owned_set:
+            raise RuntimeError("reserved page 0 entered circulation")
+        total = len(free) + len(owned_set)
+        if total != self.usable_pages:
+            raise RuntimeError(
+                f"page conservation violated: {len(free)} free + "
+                f"{len(owned_set)} owned = {total} != "
+                f"{self.usable_pages} usable")
+
     # -- views for the op ---------------------------------------------------
 
     @property

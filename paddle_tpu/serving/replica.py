@@ -62,6 +62,11 @@ class ReplicaHandle:
                 namespace=f"paddle_serving_r{self.replica_id}"),
             clock=clock, sleep=sleep)
         self.health = HealthTracker(health_config, clock=clock)
+        spec = getattr(engine, "spec", None)
+        if spec is not None:
+            # stamp the replica id into the engine's paddle_spec_* label
+            # so fleet-wide speculation metrics split per replica
+            spec.replica = str(self.replica_id)
         self.draining = False
         self.drained_event_sent = False     # router's once-only latch
         self._fault: Optional[tuple] = None  # ("die",) | ("stall", t_end)

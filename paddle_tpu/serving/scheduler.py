@@ -814,6 +814,11 @@ class ServingScheduler:
             out["pages"]["live"] = mgr.num_live_pages
             out["pages"]["cached"] = mgr.num_cached_pages
             out["prefix_cache"] = cache.snapshot()
+        spec = getattr(self.engine, "spec", None)
+        if spec is not None:
+            # speculation health (drafted/accepted/acceptance ratio):
+            # /statusz and the router's fleet view surface it per engine
+            out["speculation"] = spec.snapshot()
         if self.slo_monitor is not None:
             out["slo"] = self.slo_monitor.states()
         return out

@@ -183,10 +183,14 @@ def test_evict_respects_protect_and_pinned_pages():
 # ---------------------------------------------------------------------------
 
 def test_pool_invariants_random_interleavings():
-    """submit/extend/cancel/retire/evict in random order: conservation
-    holds after every op, refcounts never negative (check_conservation
-    cross-checks refs against block-table occupancy, so a page in two
-    tables with a dead refcount cannot hide)."""
+    """submit/draft(grow+verify/rollback)/extend/accept/reject/cancel/
+    retire/evict in random order: conservation holds after every op,
+    refcounts never negative (check_conservation cross-checks refs
+    against block-table occupancy, so a page in two tables with a dead
+    refcount cannot hide). The ``spec`` op is the speculative row's
+    lifecycle at pool level: grow the table for a drafted span past the
+    committed length, then commit a random prefix and truncate the rest
+    — exactly what the engine's verify/rollback does per row."""
     for seed in (0, 1, 2):
         rng = np.random.RandomState(seed)
         mgr = _mgr(num_pages=16, page_size=2)
@@ -195,7 +199,8 @@ def test_pool_invariants_random_interleavings():
         next_sid = 0
         for _ in range(300):
             op = rng.choice(["submit", "extend", "retire", "cancel",
-                             "evict"], p=[0.4, 0.15, 0.2, 0.1, 0.15])
+                             "evict", "spec"],
+                            p=[0.3, 0.1, 0.2, 0.1, 0.1, 0.2])
             if op == "submit":
                 lp = int(rng.randint(1, 9))
                 prompt = [int(t) for t in rng.randint(0, 3, lp)]
@@ -241,6 +246,28 @@ def test_pool_invariants_random_interleavings():
                 mgr.free(sid)                    # cancelled: no insert
             elif op == "evict":
                 cache.evict(int(rng.randint(1, 4)))
+            elif op == "spec" and live:
+                sid = int(rng.choice(list(live)))
+                cur = mgr.seq_len(sid)
+                span = int(rng.randint(1, 6))
+                try:
+                    mgr.grow_to(sid, cur + span)     # draft the span
+                except MemoryError:
+                    cache.evict(mgr.pages_for(cur + span)
+                                - len(mgr._tables[sid]))
+                    try:
+                        mgr.grow_to(sid, cur + span)
+                    except MemoryError:
+                        mgr.check_conservation()
+                        continue                 # engine clamps instead
+                mgr.check_conservation()         # mid-draft books balance
+                accepted = int(rng.randint(0, span + 1))
+                committed = cur + accepted
+                # verify: commit the accepted prefix, roll the rest back
+                mgr.truncate_pages(sid, mgr.pages_for(committed))
+                mgr._lens[sid] = committed
+                live[sid]["gen"].extend(
+                    int(t) for t in rng.randint(0, 3, accepted))
             mgr.check_conservation()
         for sid in list(live):
             mgr.free(sid)

@@ -43,7 +43,7 @@ class FakeClock:
 
 def _fleet(n=2, max_new=4, num_slots=2, chunk=2, seed=3, page_size=4,
            eos=None, health_kw=None, router_kw=None, sched_kw=None,
-           injector=None):
+           injector=None, speculative=False):
     cfg = L.llama_tiny(num_hidden_layers=2)
     params = L.init_stacked_params(cfg, seed=seed)
     clock = FakeClock()
@@ -56,7 +56,7 @@ def _fleet(n=2, max_new=4, num_slots=2, chunk=2, seed=3, page_size=4,
             cfg, GenerationConfig(max_new_tokens=max_new, seed=seed,
                                   eos_token_id=eos),
             num_slots=num_slots, page_size=page_size, max_seq_len=32,
-            chunk=chunk)
+            chunk=chunk, speculative=speculative)
         replicas.append(ReplicaHandle(
             i, eng, config=SchedulerConfig(**sched_kw),
             health_config=HealthConfig(**(health_kw or {})),
@@ -547,7 +547,7 @@ def test_fault_injector_replica_scoped_events():
 # chaos acceptance
 # ---------------------------------------------------------------------------
 
-def _chaos_trace(inject, event_path=None):
+def _chaos_trace(inject, event_path=None, speculative=False):
     """One deterministic 4-replica fleet run: 12 requests submitted on a
     fixed step schedule, optionally with an injected replica death (mid-
     decode) and a stall. Returns (per-request outputs, router, monitor,
@@ -566,7 +566,7 @@ def _chaos_trace(inject, event_path=None):
             health_kw={"suspect_after": 1, "eject_after": 2,
                        "probe_cooldown_s": 0.4},
             router_kw={"failover_backoff_s": 0.05, "stall_s": 0.5},
-            injector=injector)
+            injector=injector, speculative=speculative)
         monitor = router.make_slo_monitor(completion_target=0.95,
                                           min_events=1)
         rng = np.random.RandomState(31)
@@ -641,6 +641,35 @@ def test_chaos_fleet_byte_identical_acceptance(tmp_path):
                                                ReplicaState.HALF_OPEN)
     assert not router.replicas[1].health.accepting
     assert router.replicas[2].health.state == ReplicaState.HEALTHY
+
+
+def test_chaos_fleet_green_with_speculation(tmp_path):
+    """ISSUE 9 acceptance: the chaos suite stays green with speculative
+    decoding enabled on every replica — same deterministic death+stall
+    schedule, and the fleet's greedy outputs are byte-identical to BOTH
+    the fault-free speculative run and the non-speculative chaos run
+    (speculation is verify-then-commit, failover replays committed
+    prefixes, so faults can never surface a drafted-but-unverified
+    token)."""
+    clean, _, _, _, _, _, _ = _chaos_trace(inject=False, speculative=True)
+    plain, _, _, _, _, _, _ = _chaos_trace(inject=True)
+    ev = tmp_path / "spec_chaos_events.jsonl"
+    chaos, prompts, router, monitor, handles, params, cfg = _chaos_trace(
+        inject=True, event_path=ev, speculative=True)
+
+    assert all(h.state == RequestState.DONE for h in handles)
+    assert all(h.stream.finished for h in handles)
+    assert chaos == clean == plain
+    for i in (0, 3):
+        assert chaos[i] == _greedy_ref(params, cfg, prompts[i], 8)
+    assert router.failed_total == 0 and router.shed_total == 0
+    assert not monitor.breached()
+    # speculation actually ran on the fleet (replica-labelled stats)
+    drafted = sum(r.engine.spec.stats["drafted"]
+                  for r in router.replicas.values())
+    assert drafted > 0
+    events = [json.loads(l) for l in ev.read_text().splitlines()]
+    assert {e["kind"] for e in events} >= {"replica_ejected", "failover"}
 
 
 def test_infeasible_request_rejected_without_poisoning_breakers():
