@@ -156,7 +156,104 @@ def main():
         "tokens_per_s_ratio": round(
             spec_on["tokens_per_s"] / spec_off["tokens_per_s"], 3),
     }
+    # ISSUE 10 acceptance: every request in a fleet storm (speculation
+    # on AND off, one mid-storm replica kill) reconstructs into a
+    # complete span tree whose exclusive segments sum to the measured
+    # e2e within 1%; the hot-chain profile is the fusion-pass input
+    out["timeline"] = {
+        "spec_off": _timeline_storm(speculative=False),
+        "spec_on": _timeline_storm(speculative=True),
+    }
+    out["hot_chains"] = _hot_chains()
     print(json.dumps(out))
+
+
+def _timeline_storm(speculative, n_req=8):
+    """2-replica fleet storm with a mid-storm replica kill under the
+    armed span collector: asserts full span-tree reconstruction and
+    <1% critical-path reconciliation for EVERY request."""
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.observability.timeline import span_collector
+    from paddle_tpu.resilience import Fault, FaultInjector
+    from paddle_tpu.serving import SchedulerConfig
+    from paddle_tpu.serving.health import HealthConfig
+    from paddle_tpu.serving.replica import ReplicaHandle
+    from paddle_tpu.serving.router import FleetRouter, RouterConfig
+
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    replicas = [
+        ReplicaHandle(
+            i,
+            ContinuousBatchingEngine(
+                cfg, GenerationConfig(max_new_tokens=8, seed=3),
+                num_slots=2, page_size=4, max_seq_len=32, chunk=2,
+                speculative=speculative),
+            config=SchedulerConfig(max_step_retries=1,
+                                   retry_backoff_s=0.001),
+            health_config=HealthConfig())
+        for i in range(2)]
+    router = FleetRouter(
+        replicas, config=RouterConfig(failover_backoff_s=0.001),
+        fault_injector=FaultInjector(
+            schedule=[Fault("replica_die", 4, replica=0)]))
+    span_collector.clear()
+    span_collector.arm()
+    rng = np.random.RandomState(0)
+    handles = []
+    steps = 0
+    while router.pending or len(handles) < n_req:
+        if len(handles) < n_req and steps % 2 == 0:   # mid-storm trickle
+            handles.append(router.submit(
+                rng.randint(1, cfg.vocab_size, (5,)).astype(np.int32)))
+        router.step(params)
+        steps += 1
+        if steps > 100_000:
+            raise RuntimeError("timeline storm stalled")
+    span_collector.disarm()
+    complete, max_err, failovers = 0, 0.0, 0
+    for h in handles:
+        tl = span_collector.attribute(h.trace_id)
+        assert tl is not None and tl["complete"], tl
+        complete += 1
+        err = abs(sum(tl["segments"].values()) - tl["e2e_ms"]) \
+            / max(tl["e2e_ms"], 1e-9)
+        max_err = max(max_err, err)
+        if "failover" in tl["segments"]:
+            failovers += 1
+    assert max_err < 0.01, max_err
+    assert failovers > 0, "the kill must produce a failover segment"
+    span_collector.clear()
+    return {"requests": n_req, "complete_trees": complete,
+            "reconcile_max_err_pct": round(max_err * 100, 4),
+            "failover_segments": failovers}
+
+
+def _hot_chains():
+    """Continuous-profiling artifact over the eager decode-tail
+    workload (ROADMAP item 2's fusion-pass input): top chains with
+    ProjectIndex-resolved symbols."""
+    import numpy as _np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability.profiling import chain_profiler
+    from paddle_tpu.observability.runtime import telemetry
+
+    telemetry.enable()
+    chain_profiler.reset()
+    chain_profiler.arm()
+    x = paddle.to_tensor(_np.ones((8, 8), _np.float32))
+    for _ in range(64):
+        y = x * 2.0
+        y = y + x
+        y = paddle.clip(y, 0.0, 8.0)
+        y = paddle.scale(y, scale=0.25)
+    chain_profiler.disarm()
+    doc = chain_profiler.profile(top_n=3, workload="decode_tail")
+    return {"top": doc["chains"], "symbols": doc["symbols"],
+            "transitions": doc["transitions"]}
 
 
 def _storm(cfg, params, unified, *, n_req, max_new, num_slots, chunk,

@@ -16,6 +16,13 @@ hold the tree to it, statically and in both directions:
 * ``event-contract`` — every literal ``emit_event("kind", ...)`` /
   ``event_log.emit("kind", ...)`` must use a declared kind; declared
   kinds nothing emits fail.
+* ``span-contract`` — every ``emit_span(...)`` / ``metrics.span(...)``
+  name must be declared in the catalog's ``SPANS`` table (namespaced
+  names — f-strings with a literal ``.suffix`` tail — resolve by that
+  suffix), every literal ``args={...}`` dict may only carry declared
+  fields, and declared span names nothing emits fail. The timeline
+  collector's critical-path attribution keys on these names, so a typo
+  silently drops a segment from every request breakdown.
 
 The catalog is parsed from source (``ast.literal_eval``), never imported
 — the analyzer stays runnable without jax.
@@ -288,4 +295,99 @@ class EventContractRule:
         return out
 
 
-CONTRACT_RULES = (MetricContractRule(), EventContractRule())
+class SpanContractRule:
+    id = "span-contract"
+    protects = ("every emit_span/metrics.span name (and its literal args "
+                "fields) is declared in observability/catalog.py SPANS, "
+                "and every declared span is emitted somewhere — the "
+                "timeline collector's segment attribution keys on these "
+                "names, so a typo silently drops a critical-path segment")
+    example = 'emit_span("engine.prefil", t0, t1)  # typo: lost segment'
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        catalog_mod = project.module(CATALOG_REL)
+        if catalog_mod is None:
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "span catalog module missing",
+                            symbol="catalog-missing")]
+        spans, spans_node = _top_level_literal(catalog_mod, "SPANS")
+        if not isinstance(spans, dict):
+            return [Finding(CATALOG_REL, 1, self.id,
+                            "SPANS is not a literal dict",
+                            symbol="catalog-unparsable")]
+        key_lines = {k.value: k.lineno for k in spans_node.keys
+                     if isinstance(k, ast.Constant)}
+        emitted: Set[str] = set()
+        for mod in project.iter_modules(("paddle_tpu/",)):
+            for node in mod.nodes_of(ast.Call):
+                f = node.func
+                is_span = ((isinstance(f, ast.Name)
+                            and f.id in ("emit_span", "make_span"))
+                           or (isinstance(f, ast.Attribute)
+                               and f.attr in ("emit_span", "make_span",
+                                              "span")))
+                if not is_span or not node.args:
+                    continue
+                name = self._span_name(node.args[0])
+                if name is None:
+                    continue        # dynamic name (metrics.mark relay)
+                declared = spans.get(name) or spans.get(
+                    name.rsplit(".", 1)[-1])
+                if declared is None:
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.id,
+                        f"span {name!r} is not declared in "
+                        "observability/catalog.py SPANS — typo, or "
+                        "declare it (segment attribution keys on span "
+                        "names)", symbol=f"undeclared:{name}"))
+                    continue
+                emitted.add(name if name in spans
+                            else name.rsplit(".", 1)[-1])
+                out.extend(self._check_fields(mod, node, name,
+                                              tuple(declared)))
+        for name in sorted(set(spans) - emitted):
+            out.append(Finding(
+                CATALOG_REL, key_lines.get(name, 1), self.id,
+                f"SPANS declares {name!r} but nothing in paddle_tpu/ "
+                "emits it — remove or wire the span",
+                symbol=f"unused:{name}"))
+        return out
+
+    @staticmethod
+    def _span_name(arg: ast.AST) -> Optional[str]:
+        """Literal span name, resolving f-strings with a literal dotted
+        tail (``f"{ns}.queue_wait"`` -> ``"queue_wait"``)."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            tail = arg.values[-1]
+            if (isinstance(tail, ast.Constant)
+                    and isinstance(tail.value, str)
+                    and tail.value.startswith(".")):
+                return tail.value[1:]
+        return None
+
+    def _check_fields(self, mod, node: ast.Call, name: str,
+                      declared: Tuple[str, ...]) -> List[Finding]:
+        for kw in node.keywords:
+            if kw.arg != "args" or not isinstance(kw.value, ast.Dict):
+                continue
+            keys = []
+            for k in kw.value.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return []        # dynamic keys — can't check
+                keys.append(k.value)
+            extra = sorted(set(keys) - set(declared))
+            if extra:
+                return [Finding(
+                    mod.rel, node.lineno, self.id,
+                    f"span {name!r} emitted with undeclared args fields "
+                    f"{tuple(extra)}; catalog allows {declared}",
+                    symbol=f"fields:{name}")]
+        return []
+
+
+CONTRACT_RULES = (MetricContractRule(), EventContractRule(),
+                  SpanContractRule())

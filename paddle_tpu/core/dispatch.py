@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from . import autograd
 from ..flags import flag_value
 from ..observability import runtime as _obs
+from ..observability.profiling import chain_armed as _chain_armed
+from ..observability.profiling import chain_profiler as _chain_profiler
 from ..observability.runtime import telemetry as _telemetry  # singleton
 from ..profiler.record import RecordEvent, host_recorder
 
@@ -66,10 +68,17 @@ def apply(fn: Callable, *args, op_name: str = "op", n_outputs: int = None, **sta
             c = tele._counts
             n = c.get(op_name, 0)
             c[op_name] = n + 1
+            if _chain_armed[0]:
+                # continuous profiling: producer->consumer transition
+                # (observability.profiling.DispatchChainProfiler)
+                _chain_profiler.note(op_name)
             if n % tele.sample_every == 0:
                 t0 = _time.perf_counter_ns()
                 out = _apply_impl(fn, args, op_name, static)
-                tele.observe_duration(_time.perf_counter_ns() - t0)
+                dur = _time.perf_counter_ns() - t0
+                tele.observe_duration(dur)
+                if _chain_armed[0]:
+                    _chain_profiler.note_duration(op_name, dur)
                 return out
     return _apply_impl(fn, args, op_name, static)
 
@@ -81,11 +90,17 @@ def _dispatch_traced(fn: Callable, args, op_name: str, static):
     ev.begin()
     try:
         tele = _telemetry
-        if tele._enabled and tele.count(op_name):
-            t0 = _time.perf_counter_ns()
-            out = _apply_impl(fn, args, op_name, static)
-            tele.observe_duration(_time.perf_counter_ns() - t0)
-            return out
+        if tele._enabled:
+            if _chain_armed[0]:
+                _chain_profiler.note(op_name)
+            if tele.count(op_name):
+                t0 = _time.perf_counter_ns()
+                out = _apply_impl(fn, args, op_name, static)
+                dur = _time.perf_counter_ns() - t0
+                tele.observe_duration(dur)
+                if _chain_armed[0]:
+                    _chain_profiler.note_duration(op_name, dur)
+                return out
         return _apply_impl(fn, args, op_name, static)
     finally:
         ev.end()

@@ -36,8 +36,20 @@ needs (every future perf PR must be measurable):
 * :mod:`.flight` — flight recorder: last-N events/spans/metric deltas
   in bounded rings, postmortem ``dump_debug_bundle`` tarballs,
   auto-dump hooks on watchdog timeout / NaN rollback / degrade.
+* :mod:`.timeline` — request timelines: a bounded :class:`SpanCollector`
+  assembles the span stream into per-request span trees (one trace id
+  across router → replica → scheduler → engine, failovers included) and
+  attributes each request's e2e latency to exclusive critical-path
+  segments; slowest-request exemplars feed ``/tracez`` and debug
+  bundles.
+* :mod:`.profiling` — continuous profiling of the eager dispatch
+  stream: :class:`DispatchChainProfiler` folds the always-on per-op
+  counters and sampled durations into ranked producer→consumer hot
+  chains, exported as the stable JSON artifact ROADMAP item 2's fusion
+  pass consumes.
 * :mod:`.server` — stdlib-only :class:`DiagServer` exposing
-  ``/metrics``, ``/healthz``, ``/statusz`` and ``/debugz`` live.
+  ``/metrics``, ``/healthz``, ``/statusz``, ``/debugz`` and
+  ``/tracez`` live.
 
 Quick start::
 
@@ -58,11 +70,13 @@ from .registry import (  # noqa: F401
 from .runtime import (  # noqa: F401
     DispatchTelemetry, RecompileDetector, recompiles, telemetry,
 )
+from .profiling import DispatchChainProfiler, chain_profiler  # noqa: F401
 from .server import DiagServer  # noqa: F401
 from .slo import (  # noqa: F401
     SLObjective, SLOMonitor, latency_objective, ratio_objective,
 )
 from .step_timer import StepTimer  # noqa: F401
+from .timeline import SpanCollector, span_collector  # noqa: F401
 from .trace import (  # noqa: F401
     TraceContext, current_trace, current_trace_id, new_trace_id,
     trace_context,
@@ -76,5 +90,6 @@ __all__ = [
     "configure_event_log", "emit_event", "event_log", "format",
     "SLObjective", "SLOMonitor", "latency_objective", "ratio_objective",
     "GoodputTracker", "StragglerDetector", "FlightRecorder",
-    "flight_recorder", "DiagServer",
+    "flight_recorder", "DiagServer", "SpanCollector", "span_collector",
+    "DispatchChainProfiler", "chain_profiler",
 ]

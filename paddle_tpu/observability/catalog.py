@@ -1,5 +1,6 @@
-"""Central telemetry catalog: every registry-owned metric and every
-structured event kind, declared in ONE place.
+"""Central telemetry catalog: every registry-owned metric, every
+structured event kind and every request-path span name, declared in ONE
+place.
 
 Motivation (ISSUE 8): a typo'd metric name or label, or a misspelled
 ``emit_event`` kind, silently mints a brand-new series — dashboards and
@@ -79,6 +80,31 @@ EVENT_KINDS = {
     "spec_rollback",
 }
 
+#: every request-path span the tree may emit (``profiler.record.
+#: emit_span`` / ``ServingMetrics.span``): canonical name -> allowed
+#: ``args`` fields. Namespaced spans (``<metrics namespace>.<name>``)
+#: are declared by their suffix — call sites build the prefix with an
+#: f-string whose trailing literal is checked. The timeline collector's
+#: critical-path attribution (observability/timeline.py) keys on these
+#: names, so a typo'd span silently drops a segment from every request
+#: breakdown; tpu-lint's ``span-contract`` rule checks both directions.
+SPANS = {
+    # scheduler request lifecycle (serving/scheduler.py)
+    "request": ("request_id",),
+    "step": (),
+    "queue_wait": ("request_id",),
+    "admission": ("request_id",),
+    # engine phases (inference/decoding.py)
+    "engine.prefill": ("request_id", "slot", "prefill_tokens", "bucket",
+                       "prompt_len", "cached_tokens"),
+    "engine.decode_chunk": ("request_id", "slot", "chunk"),
+    "engine.spec_draft": ("request_id", "slot", "drafted"),
+    "engine.spec_round": ("request_id", "slot", "drafted"),
+    # fleet router envelope + failover attribution (serving/router.py)
+    "router.request": ("request_id", "outcome", "failovers"),
+    "router.failover_gap": ("request_id", "to_replica", "attempt"),
+}
+
 
 def declared_metric(name: str):
     """(kind, labels) or None — runtime helper mirror of the lint rule."""
@@ -87,3 +113,11 @@ def declared_metric(name: str):
 
 def declared_event(kind: str) -> bool:
     return kind in EVENT_KINDS
+
+
+def declared_span(name: str):
+    """Allowed args fields for a span name (suffix-resolved like the
+    lint rule) or None — runtime helper mirror of ``span-contract``."""
+    if name in SPANS:
+        return SPANS[name]
+    return SPANS.get(name.rsplit(".", 1)[-1])

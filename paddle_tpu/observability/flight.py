@@ -22,7 +22,11 @@ allocation) exactly like ``runtime.dispatch_armed`` — guarded by
 ``metrics.prom`` (the full registry exposition), ``metrics.json`` (its
 snapshot), ``events.jsonl`` (ring), ``trace.json`` (ring spans as a
 chrome trace that loads in Perfetto), ``slo.json`` (objective states, if
-a monitor was attached) and ``manifest.json`` (reason, counts, config).
+a monitor was attached), ``fleet.json`` (the router's /statusz fleet
+view, when a :meth:`attach_router` fleet fronts the engines),
+``timelines.json`` (slowest-request span trees + segment attributions
+and every active trace, when the timeline collector is armed or a
+router is attached) and ``manifest.json`` (reason, counts, config).
 :meth:`auto_dump` is the hook the runtime calls on watchdog timeouts,
 NaN rollbacks and scheduler degradation — it rate-limits to one bundle
 per reason so a crash loop cannot fill the disk.
@@ -53,6 +57,7 @@ class FlightRecorder:
         self._metrics: Deque[Dict[str, Any]] = deque(maxlen=capacity)
         self._dump_dir: Optional[str] = None
         self._slo_monitor = None
+        self._router = None
         self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
         self.dumps = 0
 
@@ -91,6 +96,15 @@ class FlightRecorder:
         """Objective states land in ``slo.json`` of every bundle."""
         self._slo_monitor = monitor
 
+    def attach_router(self, router) -> None:
+        """Fleet router: its ``statusz()`` fleet view lands in
+        ``fleet.json`` of every bundle, so a ``replica_ejected_<id>``
+        auto-dump is self-contained — the breaker states, per-replica
+        queues and parked/probe bookkeeping at the moment of ejection
+        travel with the events and spans (``FleetRouter.__init__`` wires
+        this; a later fleet replaces the earlier one)."""
+        self._router = router
+
     # -- recording (armed-only; callers gate on flight_armed[0]) ------------
 
     def note_event(self, record: Dict[str, Any]) -> None:
@@ -109,6 +123,12 @@ class FlightRecorder:
         """Called by ``profiler.record`` with a ``HostSpan`` tuple."""
         with self._lock:
             self._spans.append(span)
+
+    def note_spans(self, spans) -> None:
+        """Batch variant (``record.emit_spans``): one lock round for an
+        engine step's whole span set."""
+        with self._lock:
+            self._spans.extend(spans)
 
     def note_metrics(self, label: str, payload: Dict[str, Any]) -> None:
         with self._lock:
@@ -179,6 +199,26 @@ class FlightRecorder:
         if self._slo_monitor is not None:
             members["slo.json"] = json.dumps(
                 self._slo_monitor.states(), indent=1).encode()
+        if self._router is not None:
+            # the fleet view at dump time; a torn router (this bundle may
+            # BE the ejection postmortem) must not lose the whole bundle
+            try:
+                fleet = self._router.statusz()
+            except Exception as e:
+                fleet = {"error": repr(e)}
+            members["fleet.json"] = json.dumps(
+                fleet, default=str, indent=1).encode()
+        from .timeline import span_collector, timeline_armed
+        if timeline_armed[0] or self._router is not None:
+            # request timelines: the slowest-request exemplars (tree +
+            # segments) plus every still-active trace tree — the
+            # "where was each request" half of an ejection postmortem
+            try:
+                tz = span_collector.tracez()
+            except Exception as e:
+                tz = {"error": repr(e)}
+            members["timelines.json"] = json.dumps(
+                tz, default=str, indent=1).encode()
         members["manifest.json"] = json.dumps({
             "reason": reason, "pid": os.getpid(),
             "capacity": self._capacity, "events": len(events),

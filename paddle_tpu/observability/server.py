@@ -17,6 +17,10 @@ route      serves
            flight-recorder status)
 /debugz    flight-recorder status; ``?dump=1`` writes a postmortem
            bundle (``dump_debug_bundle``) and returns its path
+/tracez    request timelines from the span collector: the slowest
+           requests (span tree + exclusive critical-path segments) and
+           every still-active trace tree (``?trace=<id>`` narrows to
+           one trace's tree + attribution)
 ========== ==============================================================
 
 Providers are callables returning JSON-able data, registered with
@@ -45,6 +49,7 @@ from urllib.parse import parse_qs, urlparse
 
 from .flight import flight_recorder
 from .registry import get_registry
+from .timeline import span_collector
 
 #: health states, ordered by severity (max wins when composing sources)
 _HEALTH_ORDER = {"ok": 0, "degraded": 1, "breached": 2}
@@ -70,6 +75,9 @@ class DiagServer:
             self.add_health_source("slo", monitor.health)
             self.add_statusz("slo", monitor.states)
         self.add_statusz("flight_recorder", self.flight.snapshot_status)
+        # request-timeline summary (slowest-requests table) rides along
+        # whenever the span collector is armed; /tracez serves the trees
+        self.add_statusz("timelines", span_collector.snapshot_status)
 
     # -- wiring -------------------------------------------------------------
 
@@ -172,6 +180,18 @@ class DiagServer:
                         self._send(200, json.dumps(
                             server.statusz(), default=str,
                             indent=1).encode())
+                    elif route == "/tracez":
+                        q = parse_qs(url.query)
+                        tid = q.get("trace", [None])[0]
+                        if tid:
+                            body = {"trace_id": tid,
+                                    "timeline":
+                                        span_collector.attribute(tid),
+                                    "tree": span_collector.tree(tid)}
+                        else:
+                            body = span_collector.tracez()
+                        self._send(200, json.dumps(
+                            body, default=str, indent=1).encode())
                     elif route == "/debugz":
                         q = parse_qs(url.query)
                         if q.get("dump", ["0"])[0] == "1":
@@ -185,7 +205,8 @@ class DiagServer:
                     elif route == "/":
                         self._send(200, json.dumps({
                             "endpoints": ["/metrics", "/healthz",
-                                          "/statusz", "/debugz"],
+                                          "/statusz", "/debugz",
+                                          "/tracez"],
                         }).encode())
                     else:
                         self._send(404, b'{"error":"not found"}')

@@ -23,6 +23,7 @@ from typing import List, NamedTuple, Optional
 
 from ..observability import runtime as _obs_runtime
 from ..observability.flight import flight_armed, flight_recorder
+from ..observability.timeline import span_collector, timeline_armed
 from ..observability.trace import current_trace
 
 
@@ -75,15 +76,48 @@ host_recorder = _HostRecorder()
 _MAIN_PID = threading.main_thread().ident or 0
 
 
+def spans_armed() -> bool:
+    """True when ANY span sink wants spans: a profiler capture window,
+    the flight recorder's ring, or the timeline span collector. Hot
+    call sites (engine step loops, scheduler admission) gate their span
+    bookkeeping on this so the disarmed cost stays one boolean + two
+    list indexes."""
+    return host_recorder.enabled or flight_armed[0] or timeline_armed[0]
+
+
+def make_span(name: str, start_ns: int, end_ns: int,
+              event_type: str = "UserDefined", trace_id: str = "",
+              args: Optional[dict] = None) -> HostSpan:
+    """Build a HostSpan without emitting it — for hot loops that batch
+    several per-request spans into one :func:`emit_spans` call (one lock
+    round per sink instead of one per span)."""
+    return HostSpan(name, event_type, start_ns, end_ns,
+                    threading.get_ident(), _MAIN_PID, trace_id, args)
+
+
+def emit_spans(spans) -> None:
+    """Batch-emit pre-built spans (see :func:`make_span`). Callers gate
+    on :func:`spans_armed` before building the batch."""
+    if not spans:
+        return
+    if host_recorder.enabled:
+        for sp in spans:
+            host_recorder.emit(sp)
+    if flight_armed[0]:
+        flight_recorder.note_spans(spans)
+    if timeline_armed[0]:
+        span_collector.note_spans(spans)
+
+
 def emit_span(name: str, start_ns: int, end_ns: int,
               event_type: str = "UserDefined",
               trace_id: Optional[str] = None,
               args: Optional[dict] = None) -> None:
     """Emit a span with explicit timestamps (for retroactive spans like a
     request's queue wait, whose start predates the emit site). No-op when
-    neither a capture window nor the flight recorder is armed.
+    no capture window, flight recorder or span collector is armed.
     ``trace_id=None`` picks up the ambient trace context."""
-    if not host_recorder.enabled and not flight_armed[0]:
+    if not spans_armed():
         return
     if trace_id is None:
         ctx = current_trace()
@@ -94,6 +128,8 @@ def emit_span(name: str, start_ns: int, end_ns: int,
         host_recorder.emit(span)
     if flight_armed[0]:
         flight_recorder.note_span(span)
+    if timeline_armed[0]:
+        span_collector.note_span(span)
 
 
 class RecordEvent:
@@ -107,7 +143,7 @@ class RecordEvent:
     """
 
     __slots__ = ("name", "event_type", "args", "_trace_id", "_start_ns",
-                 "_jax_ann")
+                 "_jax_ann", "_is_request")
 
     def __init__(self, name: str, event_type: str = "UserDefined",
                  args: Optional[dict] = None,
@@ -118,10 +154,17 @@ class RecordEvent:
         self._trace_id = trace_id
         self._start_ns: Optional[int] = None
         self._jax_ann = None
+        # precomputed: the timeline collector only consumes request
+        # envelopes (every other categorised span arrives via emit_span)
+        self._is_request = name.endswith(".request")
 
     def begin(self) -> None:
-        capture = host_recorder.enabled
-        if not capture and not flight_armed[0]:  # zero-overhead fast path
+        capture = host_recorder._enabled
+        # zero-overhead fast path; the timeline term only arms request
+        # envelopes — with just the collector armed, step/mark spans
+        # nobody would consume never pay the span bookkeeping
+        if not capture and not flight_armed[0] \
+                and not (timeline_armed[0] and self._is_request):
             return
         if self._trace_id is None:
             ctx = current_trace()
@@ -144,16 +187,24 @@ class RecordEvent:
                 self._jax_ann.__exit__(None, None, None)
             finally:
                 self._jax_ann = None
-        if host_recorder.enabled or flight_armed[0]:
+        if host_recorder._enabled or flight_armed[0] \
+                or (timeline_armed[0] and self._is_request):
             span = HostSpan(
                 self.name, self.event_type, self._start_ns,
                 time.perf_counter_ns(),
                 threading.get_ident(), _MAIN_PID,
                 self._trace_id or "", self.args)
-            if host_recorder.enabled:
+            if host_recorder._enabled:
                 host_recorder.emit(span)
             if flight_armed[0]:
                 flight_recorder.note_span(span)
+            if timeline_armed[0] and self._is_request:
+                # the ONLY RecordEvent the timeline consumes is the
+                # request envelope — step spans and markers carry step
+                # trace ids the collector would discard anyway, and the
+                # per-step call into it is real armed-loop cost
+                # (bench_obs_overhead)
+                span_collector.note_span(span)
         self._start_ns = None
 
     def __enter__(self) -> "RecordEvent":

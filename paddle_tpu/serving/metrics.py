@@ -39,6 +39,12 @@ from ..observability import format as _fmt
 from ..observability.registry import get_registry
 from ..profiler.record import RecordEvent
 
+#: observations per family before a non-record observation may replace
+#: the exemplar anyway ("worst RECENT", not worst-ever: a p99 spike from
+#: last week must not pin the exemplar forever)
+EXEMPLAR_WINDOW = 128
+
+
 class ServingMetrics:
     """Process-local metrics sink for one :class:`ServingScheduler`.
 
@@ -85,14 +91,34 @@ class ServingMetrics:
             "degraded": 0.0,
             "slo_breached": 0.0,
         }
+        #: per-family worst-recent exemplar: hist -> {"trace_id",
+        #: "value", "n"} (n = observation count at capture; see
+        #: EXEMPLAR_WINDOW). Answers "WHICH request was the p99" —
+        #: the trace id keys straight into the span collector / /tracez.
+        self._exemplars: Dict[str, Dict] = {}
+        self._obs_counts: Dict[str, int] = {}
         get_registry().register_sink(self.namespace, self._prometheus_lines,
                                      self.summary)
 
     # -- recording ----------------------------------------------------------
 
-    def observe(self, hist: str, value: float) -> None:
+    def observe(self, hist: str, value: float,
+                trace_id: str = None) -> None:
+        """Record into a histogram family; when ``trace_id`` is given the
+        observation competes for the family's exemplar slot (kept when it
+        is the worst seen, or when the current exemplar is older than
+        ``EXEMPLAR_WINDOW`` observations)."""
         with self._lock:
             self.histograms[hist].record(value)
+            if trace_id is None:
+                return
+            n = self._obs_counts.get(hist, 0) + 1
+            self._obs_counts[hist] = n
+            ex = self._exemplars.get(hist)
+            if (ex is None or value >= ex["value"]
+                    or n - ex["n"] >= EXEMPLAR_WINDOW):
+                self._exemplars[hist] = {"trace_id": trace_id,
+                                         "value": float(value), "n": n}
 
     def inc(self, counter: str, by: float = 1) -> None:
         with self._lock:
@@ -130,6 +156,15 @@ class ServingMetrics:
         with self._lock:
             return sum(self.shed.values())
 
+    def exemplars_snapshot(self) -> Dict[str, Dict]:
+        """{family: {"trace_id", "value"}} for the worst recent TTFT/ITL/
+        e2e/queue-wait observations (exposed on /statusz; the exposition
+        text stays exemplar-free — Prometheus 0.0.4 has no exemplar
+        syntax and the line validator would reject a nonstandard one)."""
+        with self._lock:
+            return {k: {"trace_id": v["trace_id"], "value": v["value"]}
+                    for k, v in sorted(self._exemplars.items())}
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Nested dict summary (histogram percentiles + counters + gauges)."""
         with self._lock:
@@ -140,6 +175,10 @@ class ServingMetrics:
             for reason, n in self.shed.items():
                 out["counters"][f"requests_shed_total[{reason}]"] = n
             out["gauges"] = dict(self.gauges)
+            if self._exemplars:
+                out["exemplars"] = {
+                    k: {"trace_id": v["trace_id"], "value": v["value"]}
+                    for k, v in sorted(self._exemplars.items())}
         return out
 
     def _prometheus_lines(self) -> List[str]:

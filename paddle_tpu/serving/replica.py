@@ -79,11 +79,12 @@ class ReplicaHandle:
                max_new_tokens: Optional[int] = None,
                on_token: Optional[Callable[[int], None]] = None,
                defer_s: Optional[float] = None,
-               no_shed: bool = False) -> ServingRequest:
+               no_shed: bool = False,
+               trace_id: Optional[str] = None) -> ServingRequest:
         return self._scheduler.submit(
             prompt, priority=priority, deadline_ms=deadline_ms,
             max_new_tokens=max_new_tokens, on_token=on_token,
-            defer_s=defer_s, no_shed=no_shed)
+            defer_s=defer_s, no_shed=no_shed, trace_id=trace_id)
 
     def cancel(self, rid: int) -> bool:
         return self._scheduler.cancel(rid)

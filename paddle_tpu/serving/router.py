@@ -72,6 +72,8 @@ from ..kvcache.radix import RadixTree
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
 from ..observability.registry import get_registry
+from ..observability.trace import new_trace_id
+from ..profiler.record import emit_span, spans_armed
 from .health import STATE_CODE, ReplicaState
 from .replica import ReplicaHandle
 from .scheduler import RequestState
@@ -119,6 +121,15 @@ class RouterRequest:
     submit_t: float = 0.0
     deadline_t: Optional[float] = None
     state: str = RequestState.QUEUED
+    trace_id: str = ""                 # ONE id for the whole fleet path:
+    # minted here at router submit, handed to every replica dispatch
+    # (failover resubmissions included) so the request assembles into a
+    # single span tree across replicas
+    _submit_ns: int = field(default=0, repr=False)
+    _failover_ns: int = field(default=0, repr=False)  # ejection time of a
+    # pending failover; the next dispatch emits the router.failover_gap
+    # span from it (the attributed "replica died -> sibling took over"
+    # segment) and clears it
     replica_id: Optional[int] = None   # current assignment
     handle: Any = field(default=None, repr=False)  # replica-level request
     failovers: int = 0
@@ -203,6 +214,10 @@ class FleetRouter:
             "paddle_router_prefix_affinity_hits_total",
             "requests routed to the replica with the longest cached "
             "prefix overlap")
+        # ejection bundles must be self-contained: the flight recorder
+        # embeds this fleet's /statusz view (fleet.json) and the active
+        # request timelines (timelines.json) in every debug bundle
+        flight_recorder.attach_router(self)
 
     # -- submission ---------------------------------------------------------
 
@@ -243,7 +258,9 @@ class FleetRouter:
             rid=rid, prompt=prompt, priority=int(priority), budget=budget,
             stream=TokenStream(rid, on_token=on_token), submit_t=now,
             deadline_t=None if deadline_ms is None
-            else now + deadline_ms / 1e3)
+            else now + deadline_ms / 1e3,
+            trace_id=new_trace_id("req"))
+        req._submit_ns = time.perf_counter_ns()
         # a fatal (non-Exception) router death closes consumer streams
         # via the producer-liveness poll instead of leaving them blocked
         alive = self._alive
@@ -381,7 +398,17 @@ class FleetRouter:
                               deadline_ms=remaining_ms,
                               max_new_tokens=budget, on_token=_on_token,
                               defer_s=defer_s,
-                              no_shed=req.redispatched)
+                              no_shed=req.redispatched,
+                              trace_id=req.trace_id)
+        if req._failover_ns:
+            if spans_armed():
+                # the attributed failover segment: replica ejected ->
+                # a sibling accepted the resubmission
+                emit_span("router.failover_gap", req._failover_ns,
+                          time.perf_counter_ns(), trace_id=req.trace_id,
+                          args={"request_id": req.rid, "to_replica": rid,
+                                "attempt": req.failovers})
+            req._failover_ns = 0
         req.redispatched = True
         req.replica_id = rid
         if req in self._parked:
@@ -544,6 +571,7 @@ class FleetRouter:
                     and not req.done]
         emit_event("replica_ejected", replica=rid, error=reason,
                    inflight=len(inflight),
+                   trace_ids=sorted(req.trace_id for req in inflight),
                    consecutive_failures=r.health.consecutive_failures,
                    cooldown_s=r.health.cooldown_s)
         # postmortem while the replica's torn state is inspectable
@@ -565,6 +593,8 @@ class FleetRouter:
         cfg = self.config
         req.failovers += 1
         req.failover_t = self._clock()
+        if not req._failover_ns:        # a parked retry keeps the FIRST
+            req._failover_ns = time.perf_counter_ns()   # ejection time
         toks = req.stream.tokens
         streamed = len(toks)
         eos = next(iter(self.replicas.values())).engine.config.eos_token_id
@@ -588,6 +618,7 @@ class FleetRouter:
                              f"{from_rid}: {reason})", rid=req.rid),
                          outcome="failed")
             emit_event("failover", request_id=req.rid,
+                       trace_id=req.trace_id,
                        from_replica=from_rid, to_replica=None,
                        streamed=streamed, attempt=req.failovers,
                        exhausted=True)
@@ -602,12 +633,14 @@ class FleetRouter:
         if req.handle is not None:
             self._count_failover(from_rid)
             emit_event("failover", request_id=req.rid,
+                       trace_id=req.trace_id,
                        from_replica=from_rid, to_replica=req.replica_id,
                        streamed=streamed, attempt=req.failovers,
                        backoff_s=round(defer, 4))
         else:
             req.pending_failover_from = from_rid
             emit_event("failover", request_id=req.rid,
+                       trace_id=req.trace_id,
                        from_replica=from_rid, to_replica=None,
                        streamed=streamed, attempt=req.failovers,
                        parked=True)
@@ -659,6 +692,14 @@ class FleetRouter:
                 error: Optional[ServingError], outcome: str) -> None:
         req.state = state
         req.finish_t = self._clock()
+        if req._submit_ns and spans_armed():
+            # the fleet-level request envelope: the timeline collector's
+            # root span, spanning router submit -> terminal outcome
+            # across every replica attempt
+            emit_span("router.request", req._submit_ns,
+                      time.perf_counter_ns(), trace_id=req.trace_id,
+                      args={"request_id": req.rid, "outcome": outcome,
+                            "failovers": req.failovers})
         req.stream.close(reason, error)
         self._c_requests.inc(
             replica=(str(req.replica_id) if req.replica_id is not None

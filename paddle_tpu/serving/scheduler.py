@@ -67,8 +67,9 @@ import numpy as np
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
 from ..observability.step_timer import StepTimer
+from ..observability.timeline import span_collector, timeline_armed
 from ..observability.trace import new_trace_id, trace_context
-from ..profiler.record import emit_span, host_recorder
+from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
 from .metrics import ServingMetrics
 from .stream import ServingError, TokenStream
 
@@ -192,7 +193,8 @@ class ServingScheduler:
                max_new_tokens: Optional[int] = None,
                on_token: Optional[Callable[[int], None]] = None,
                defer_s: Optional[float] = None,
-               no_shed: bool = False) -> ServingRequest:
+               no_shed: bool = False,
+               trace_id: Optional[str] = None) -> ServingRequest:
         """Queue a request. ``priority`` is a class (0 = most urgent, FIFO
         within a class); ``deadline_ms`` is the admission SLO relative to
         now — a request still queued past it is shed; ``max_new_tokens``
@@ -208,6 +210,10 @@ class ServingScheduler:
         load; a full queue sheds fresh victims around them, never them).
         ``no_shed`` grants the same exemption to an immediate
         (non-deferred) submission — the router's drain handoffs.
+        ``trace_id`` adopts an outer layer's trace identity (the fleet
+        router mints one id per router request and passes it through
+        every dispatch, failover resubmissions included, so the whole
+        path assembles into ONE span tree); None mints a fresh id.
         Returns the request handle (its
         ``.stream`` is the consumption surface). The handle may come back
         already shed if the queue cap evicts it immediately.
@@ -250,12 +256,14 @@ class ServingScheduler:
             submit_t=now,
             deadline_t=None if deadline_ms is None
             else now + deadline_ms / 1e3,
-            trace_id=new_trace_id("req"))
-        req._submit_ns = time.perf_counter_ns()
+            trace_id=trace_id or new_trace_id("req"))
         req._span = self.metrics.span("request",
                                       args={"request_id": rid},
                                       trace_id=req.trace_id)
         req._span.begin()
+        # after begin(): the request envelope starts at or before every
+        # phase span, so queue_wait nests inside it in the span tree
+        req._submit_ns = time.perf_counter_ns()
         self._requests[rid] = req
         req._key = (req.priority, self._seq)
         self._seq += 1
@@ -458,6 +466,15 @@ class ServingScheduler:
                 error: Optional[ServingError] = None) -> None:
         req.state = state
         req.finish_t = self._clock()
+        if (req.engine_rid is None and req._submit_ns
+                and spans_armed()):
+            # never admitted (queue-cap/SLO/deadline shed, queued
+            # cancel): its whole life WAS queue wait — emit the segment
+            # retroactively so the timeline attributes the shed latency
+            emit_span(f"{self.metrics.namespace}.queue_wait",
+                      req._submit_ns, time.perf_counter_ns(),
+                      trace_id=req.trace_id,
+                      args={"request_id": req.rid})
         req.stream.close(reason, error)
         if req._span is not None:
             req._span.end()
@@ -586,12 +603,14 @@ class ServingScheduler:
         bucketed prefill wave, so admission latency is one step, not one
         wave boundary."""
         now = self._clock()
+        armed = spans_armed()
         headroom = self.engine.num_free_slots - self.engine.num_queued
         free_pages = self.engine.mgr.num_free_pages
         cache = getattr(self.engine, "cache", None)
         protect: List[int] = []     # pages THIS step's admissions rely on
         while headroom > 0 and self._queue:
             req = self._queue[0]
+            adm0_ns = time.perf_counter_ns() if armed else 0
             need = self.engine.mgr.pages_for(
                 len(req.prompt) + self._engine_budget(req.max_new_tokens))
             reusing: List[int] = []
@@ -620,13 +639,24 @@ class ServingScheduler:
                 trace_id=req.trace_id)
             req.state = RequestState.RUNNING
             self._by_engine_rid[req.engine_rid] = req
-            if host_recorder.enabled:
-                emit_span(f"{self.metrics.namespace}.queue_wait",
-                          req._submit_ns, time.perf_counter_ns(),
-                          trace_id=req.trace_id,
-                          args={"request_id": req.rid})
+            if armed:
+                # two non-overlapping timeline segments, one batch:
+                # queued until this admission pass picked the request
+                # up, then the admission work itself (cache peek/evict,
+                # allocation, engine handover)
+                ns = self.metrics.namespace
+                emit_spans([
+                    make_span(f"{ns}.queue_wait", req._submit_ns,
+                              adm0_ns, trace_id=req.trace_id,
+                              args={"request_id": req.rid}),
+                    make_span(f"{ns}.admission", adm0_ns,
+                              time.perf_counter_ns(),
+                              trace_id=req.trace_id,
+                              args={"request_id": req.rid}),
+                ])
             self.metrics.observe("queue_wait_ms",
-                                 (now - req.submit_t) * 1e3)
+                                 (now - req.submit_t) * 1e3,
+                                 trace_id=req.trace_id)
             headroom -= 1
             free_pages -= need
 
@@ -742,10 +772,12 @@ class ServingScheduler:
         now = self._clock()
         if req.first_token_t is None:
             req.first_token_t = now
-            self.metrics.observe("ttft_ms", (now - req.submit_t) * 1e3)
+            self.metrics.observe("ttft_ms", (now - req.submit_t) * 1e3,
+                                 trace_id=req.trace_id)
         else:
             self.metrics.observe("itl_ms",
-                                 (now - req.last_token_t) * 1e3)
+                                 (now - req.last_token_t) * 1e3,
+                                 trace_id=req.trace_id)
         req.last_token_t = now
         self.metrics.inc("tokens_generated_total")
         req.stream.push(int(token))
@@ -757,7 +789,8 @@ class ServingScheduler:
         self._finish(req, RequestState.DONE, "complete")
         self.metrics.inc("requests_completed_total")
         self.metrics.observe("e2e_ms",
-                             (req.finish_t - req.submit_t) * 1e3)
+                             (req.finish_t - req.submit_t) * 1e3,
+                             trace_id=req.trace_id)
 
     # -- accounting ---------------------------------------------------------
 
@@ -819,6 +852,17 @@ class ServingScheduler:
             # speculation health (drafted/accepted/acceptance ratio):
             # /statusz and the router's fleet view surface it per engine
             out["speculation"] = spec.snapshot()
+        ex = self.metrics.exemplars_snapshot()
+        if ex:
+            # the worst recent TTFT/ITL/e2e observation, each carrying
+            # the trace id to pull from /tracez — histogram families
+            # alone can't answer "WHICH request was the p99"
+            out["exemplars"] = ex
+        if timeline_armed[0]:
+            # slowest-requests table (trace id, e2e, exclusive
+            # critical-path segments) from the span collector; the full
+            # trees live on /tracez
+            out["slowest_requests"] = span_collector.slowest()
         if self.slo_monitor is not None:
             out["slo"] = self.slo_monitor.states()
         return out

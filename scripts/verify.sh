@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-stop PR gate: tier-1 tests + tpu-lint + the armed-observability
+# overhead guard. Run from the repo root:
+#
+#   bash scripts/verify.sh          # everything (tier-1 is the slow part)
+#   bash scripts/verify.sh --fast   # skip tier-1 (lint + overhead only)
+#
+# Exit codes: 0 all green; first failing stage's code otherwise.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fast=0
+[ "${1:-}" = "--fast" ] && fast=1
+
+echo "== [1/3] tpu-lint (python -m paddle_tpu.analysis) =="
+python -m paddle_tpu.analysis || exit $?
+
+echo "== [2/3] bench_obs_overhead (armed <1% measured, 3% budget) =="
+JAX_PLATFORMS=cpu python benchmarks/bench_obs_overhead.py || exit $?
+
+if [ "$fast" = "1" ]; then
+    echo "== [3/3] tier-1 skipped (--fast) =="
+    exit 0
+fi
+
+echo "== [3/3] tier-1 test suite =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit $rc

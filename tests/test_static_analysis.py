@@ -256,6 +256,11 @@ _CATALOG = """
         "paddle_unused_total": ("counter", ()),
     }
     EVENT_KINDS = {"good_event", "never_emitted"}
+    SPANS = {
+        "queue_wait": ("request_id",),
+        "engine.prefill": ("request_id", "slot"),
+        "never_spanned": (),
+    }
 """
 
 _SINK = """
@@ -307,6 +312,30 @@ def test_event_contract_meta(tmp_path):
     assert "undeclared:typo_evnt" in syms
     assert "unused:never_emitted" in syms
     assert not any("good_event" in s for s in syms)
+
+
+def test_span_contract_meta(tmp_path):
+    rep = _run(tmp_path, {
+        "paddle_tpu/observability/catalog.py": _CATALOG,
+        "paddle_tpu/demo.py": """
+            from .profiler.record import emit_span, make_span
+            def f(ns, t0, t1, rid):
+                emit_span("engine.prefill", t0, t1,
+                          args={"request_id": rid, "slot": 0})
+                emit_span(f"{ns}.queue_wait", t0, t1,
+                          args={"request_id": rid})
+                emit_span("engine.prefil", t0, t1)          # typo'd name
+                make_span("engine.prefill", t0, t1,
+                          args={"request_id": rid, "bogus_field": 1})
+        """,
+    }, ["span-contract"])
+    syms = {f.symbol for f in rep.for_rule("span-contract")}
+    assert "undeclared:engine.prefil" in syms
+    assert "fields:engine.prefill" in syms      # undeclared args field
+    assert "unused:never_spanned" in syms       # dead catalog row
+    # good literal + f-string-suffix emissions produce no findings
+    assert not any("queue_wait" in s for s in syms)
+    assert len([s for s in syms if s.startswith("fields:")]) == 1
 
 
 @pytest.mark.parametrize("rule_id,rel,src,needle", [

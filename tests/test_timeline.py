@@ -1,0 +1,409 @@
+"""Request timelines & continuous profiling (ISSUE 10).
+
+Four layers:
+
+* **collector mechanics** — bounded trace ring, span-tree containment,
+  category mapping, the disarmed zero-cost gate;
+* **critical-path acceptance** — a serving run (speculation on and off)
+  and a router storm with a mid-storm replica kill reconstruct EVERY
+  request into a complete span tree under ONE trace id whose exclusive
+  segments sum to the measured e2e within 1%, with the failover gap an
+  attributed segment;
+* **surfaces** — /tracez + /statusz slowest-requests rows, TTFT/ITL
+  exemplars, self-contained ejection flight bundles (fleet.json +
+  timelines.json);
+* **DispatchChainProfiler** — deterministic top-N hot-chain JSON over
+  an eager decode-tail workload, resolved to ProjectIndex symbols: the
+  documented fusion-pass input (ROADMAP item 2).
+"""
+
+import json
+import tarfile
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                           GenerationConfig)
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability.flight import flight_recorder
+from paddle_tpu.observability.profiling import (DispatchChainProfiler,
+                                                chain_profiler,
+                                                dispatch_sites)
+from paddle_tpu.observability.timeline import (SpanCollector,
+                                               attribute_spans,
+                                               build_tree, span_category,
+                                               span_collector,
+                                               timeline_armed)
+from paddle_tpu.profiler.record import HostSpan, make_span
+from paddle_tpu.resilience import Fault, FaultInjector
+from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+from paddle_tpu.serving.health import HealthConfig
+from paddle_tpu.serving.replica import ReplicaHandle
+from paddle_tpu.serving.router import FleetRouter, RouterConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_collector():
+    span_collector.clear()
+    span_collector.disarm()
+    flight_recorder.clear()      # reset the once-per-reason dump latch
+    yield
+    span_collector.disarm()
+    span_collector.clear()
+    flight_recorder.disarm()
+
+
+def _sp(name, a, b, tid="t-1", args=None):
+    return make_span(name, int(a * 1e6), int(b * 1e6), trace_id=tid,
+                     args=args)
+
+
+# ---------------------------------------------------------------------------
+# collector mechanics
+# ---------------------------------------------------------------------------
+
+def test_category_mapping_and_roots():
+    assert span_category("engine.prefill") == "prefill"
+    assert span_category("engine.decode_chunk") == "decode"
+    assert span_category("engine.spec_draft") == "spec_draft"
+    assert span_category("engine.spec_round") == "spec_verify"
+    assert span_category("router.failover_gap") == "failover"
+    assert span_category("paddle_serving_r3.queue_wait") == "queue_wait"
+    assert span_category("paddle_serving.admission") == "admission"
+    assert span_category("paddle_serving.step") is None
+    assert span_category("router.request") is None
+
+
+def test_attribution_tiles_root_exactly():
+    spans = [
+        _sp("router.request", 0, 100),
+        _sp("paddle_serving_r0.queue_wait", 0, 10),
+        _sp("paddle_serving_r0.admission", 10, 12),
+        _sp("engine.prefill", 12, 40),
+        _sp("engine.decode_chunk", 40, 80),
+        _sp("router.failover_gap", 80, 90),
+    ]
+    tl = attribute_spans(spans, trace_id="t-1")
+    assert tl["complete"] and tl["root"] == "router.request"
+    assert tl["e2e_ms"] == pytest.approx(100.0)
+    segs = tl["segments"]
+    # exclusive tiling: segments sum EXACTLY to the root envelope
+    assert sum(segs.values()) == pytest.approx(tl["e2e_ms"], abs=1e-6)
+    assert segs["queue_wait"] == pytest.approx(10.0)
+    assert segs["prefill"] == pytest.approx(28.0)
+    assert segs["decode"] == pytest.approx(40.0)
+    assert segs["failover"] == pytest.approx(10.0)
+    assert segs["deliver"] == pytest.approx(10.0)   # tail after last span
+
+
+def test_innermost_span_wins_overlap():
+    spans = [
+        _sp("paddle_serving.request", 0, 100),
+        _sp("engine.decode_chunk", 0, 100),
+        _sp("engine.spec_round", 40, 60),
+    ]
+    segs = attribute_spans(spans)["segments"]
+    assert segs["spec_verify"] == pytest.approx(20.0)
+    assert segs["decode"] == pytest.approx(80.0)
+
+
+def test_tree_containment_nesting():
+    spans = [
+        _sp("engine.prefill", 10, 20),
+        _sp("paddle_serving.request", 0, 100),
+        _sp("router.request", 0, 101),
+    ]
+    roots = build_tree(spans)
+    assert len(roots) == 1 and roots[0]["name"] == "router.request"
+    inner = roots[0]["children"][0]
+    assert inner["name"] == "paddle_serving.request"
+    assert inner["children"][0]["name"] == "engine.prefill"
+
+
+def test_collector_bounds_and_filtering():
+    c = SpanCollector(max_traces=4, max_spans_per_trace=3, slow_k=2)
+    # an uncategorised span never STARTS a trace (step spans)
+    c.note_span(_sp("paddle_serving.step", 0, 1, tid="step-1"))
+    assert c.trace_ids() == []
+    for i in range(6):
+        tid = f"t-{i}"
+        c.note_span(_sp("paddle_serving.queue_wait", 0, 1, tid=tid))
+        for j in range(5):   # over the per-trace cap: dropped, counted
+            c.note_span(_sp("engine.decode_chunk", 1 + j, 2 + j, tid=tid))
+        c.note_span(_sp("paddle_serving.request", 0, 10 + i, tid=tid))
+    assert len(c.trace_ids()) <= 4          # trace ring bounded
+    assert c.dropped_spans > 0
+    slow = c.slowest(5)
+    assert [e["trace_id"] for e in slow] == ["t-5", "t-4"]  # slow_k=2
+    # materialised exemplars survive even after their spans evicted
+    assert "segments" in slow[0]
+
+
+def test_disarmed_is_inert():
+    assert not timeline_armed[0]
+    from paddle_tpu.profiler.record import emit_span
+    emit_span("engine.decode_chunk", 0, 1000, trace_id="t-x")
+    assert span_collector.trace_ids() == []
+
+
+# ---------------------------------------------------------------------------
+# serving acceptance: complete trees + reconciliation
+# ---------------------------------------------------------------------------
+
+def _engine(max_new=6, num_slots=2, speculative=False, seed=0):
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=seed)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new, seed=seed),
+        num_slots=num_slots, page_size=4, max_seq_len=64, chunk=2,
+        speculative=speculative)
+    return cfg, params, eng
+
+
+def _assert_reconciles(handle, wall_ms=None, tol=0.01):
+    tl = span_collector.attribute(handle.trace_id)
+    assert tl is not None and tl["complete"], tl
+    total = sum(tl["segments"].values())
+    assert total == pytest.approx(tl["e2e_ms"], rel=tol, abs=1e-3), tl
+    if wall_ms is not None:       # independent e2e measurement
+        assert tl["e2e_ms"] <= wall_ms * (1 + tol) + 1.0
+    return tl
+
+
+@pytest.mark.parametrize("speculative", [False, True])
+def test_serving_run_reconstructs_every_request(speculative):
+    cfg, params, eng = _engine(speculative=speculative)
+    sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=8))
+    span_collector.arm()
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    hs = [sched.submit(rng.randint(1, cfg.vocab_size, (5,))
+                       .astype(np.int32)) for _ in range(4)]
+    sched.run(params, max_steps=10_000)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    span_collector.disarm()
+    for h in hs:
+        tl = _assert_reconciles(h, wall_ms=wall_ms)
+        segs = tl["segments"]
+        assert segs.get("queue_wait", 0) >= 0
+        assert "admission" in segs and "prefill" in segs
+        if speculative:
+            # drafting and verify both show up as attributed segments
+            assert "spec_verify" in segs, tl
+            assert "spec_draft" in segs, tl
+        else:
+            assert "decode" in segs, tl
+        # the tree reconstructs with the request envelope as its root
+        roots = span_collector.tree(h.trace_id)
+        assert len(roots) == 1
+        assert roots[0]["name"].endswith(".request")
+        assert roots[0].get("children"), roots
+
+
+def test_statusz_slowest_requests_and_exemplars():
+    cfg, params, eng = _engine()
+    sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=8))
+    span_collector.arm()
+    rng = np.random.RandomState(1)
+    hs = [sched.submit(rng.randint(1, cfg.vocab_size, (5,))
+                       .astype(np.int32)) for _ in range(3)]
+    sched.run(params, max_steps=10_000)
+    out = sched.statusz()
+    rows = out["slowest_requests"]
+    assert rows and all({"trace_id", "e2e_ms", "segments"} <= set(r)
+                        for r in rows)
+    known = {h.trace_id for h in hs}
+    assert {r["trace_id"] for r in rows} <= known
+    # worst-recent exemplars carry the trace id into the histograms row
+    ex = out["exemplars"]
+    assert {"ttft_ms", "e2e_ms"} <= set(ex)
+    assert ex["ttft_ms"]["trace_id"] in known
+    assert sched.metrics.summary()["exemplars"]["e2e_ms"]["trace_id"] \
+        in known
+
+
+def test_tracez_endpoint_serves_trees():
+    from paddle_tpu.observability.server import DiagServer
+    cfg, params, eng = _engine()
+    sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=8))
+    span_collector.arm()
+    h = sched.submit(np.arange(1, 6, dtype=np.int32))
+    sched.run(params, max_steps=10_000)
+    with DiagServer() as srv:
+        doc = json.load(urllib.request.urlopen(f"{srv.url}/tracez"))
+        assert doc["slowest"] and doc["slowest"][0]["tree"]
+        one = json.load(urllib.request.urlopen(
+            f"{srv.url}/tracez?trace={h.trace_id}"))
+        assert one["timeline"]["complete"]
+        assert one["tree"][0]["name"].endswith(".request")
+        status = json.load(urllib.request.urlopen(f"{srv.url}/statusz"))
+        assert status["timelines"]["completed"] >= 1
+        root = json.load(urllib.request.urlopen(srv.url))
+        assert "/tracez" in root["endpoints"]
+
+
+# ---------------------------------------------------------------------------
+# fleet storm: mid-storm replica kill, trace continuity across failover
+# ---------------------------------------------------------------------------
+
+def _fleet(n=2, max_new=8, speculative=False, injector=None):
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    replicas = []
+    for i in range(n):
+        eng = ContinuousBatchingEngine(
+            cfg, GenerationConfig(max_new_tokens=max_new, seed=3),
+            num_slots=2, page_size=4, max_seq_len=32, chunk=2,
+            speculative=speculative)
+        replicas.append(ReplicaHandle(
+            i, eng,
+            config=SchedulerConfig(max_step_retries=1,
+                                   retry_backoff_s=0.001),
+            health_config=HealthConfig()))
+    router = FleetRouter(
+        replicas, config=RouterConfig(failover_backoff_s=0.001),
+        fault_injector=injector)
+    return cfg, params, router
+
+
+@pytest.mark.parametrize("speculative", [False, True])
+def test_storm_with_replica_kill_keeps_one_tree(speculative, tmp_path):
+    inj = FaultInjector(schedule=[Fault("replica_die", 3, replica=0)])
+    cfg, params, router = _fleet(speculative=speculative, injector=inj)
+    span_collector.arm()
+    flight_recorder.arm(dump_dir=str(tmp_path))
+    rng = np.random.RandomState(0)
+    hs = [router.submit(rng.randint(1, cfg.vocab_size, (5,))
+                        .astype(np.int32)) for _ in range(4)]
+    steps = 0
+    while router.pending:
+        router.step(params)
+        steps += 1
+        assert steps < 10_000
+    span_collector.disarm()
+    failed_over = [h for h in hs if h.failovers > 0]
+    assert failed_over, "the kill must interrupt at least one request"
+    for h in hs:
+        tl = _assert_reconciles(h)
+        assert tl["root"] == "router.request"
+    for h in failed_over:
+        spans = span_collector.spans(h.trace_id)
+        namespaces = {sp.name.split(".")[0] for sp in spans}
+        # ONE trace id spans both replicas and the router envelope
+        assert {"paddle_serving_r0", "paddle_serving_r1",
+                "router"} <= namespaces, namespaces
+        segs = span_collector.attribute(h.trace_id)["segments"]
+        assert segs.get("failover", 0) > 0, segs
+    # ejection auto-dump bundle is self-contained: fleet view + trees
+    bundles = list(tmp_path.glob("*replica_ejected*.tar.gz"))
+    assert bundles
+    with tarfile.open(bundles[0]) as tar:
+        names = set(tar.getnames())
+        assert {"fleet.json", "timelines.json"} <= names
+        fleet = json.load(tar.extractfile("fleet.json"))
+        assert set(fleet["replicas"]) == {"0", "1"}
+        tz = json.load(tar.extractfile("timelines.json"))
+        assert "slowest" in tz and "active" in tz
+
+
+def test_trace_id_stamped_on_request_path_events(tmp_path):
+    from paddle_tpu.observability.events import configure_event_log
+    inj = FaultInjector(schedule=[Fault("replica_die", 3, replica=0)])
+    cfg, params, router = _fleet(injector=inj)
+    log = tmp_path / "events.jsonl"
+    configure_event_log(str(log))
+    try:
+        rng = np.random.RandomState(0)
+        hs = [router.submit(rng.randint(1, cfg.vocab_size, (5,))
+                            .astype(np.int32)) for _ in range(4)]
+        steps = 0
+        while router.pending:
+            router.step(params)
+            steps += 1
+            assert steps < 10_000
+    finally:
+        configure_event_log(None)
+    events = [json.loads(line) for line in log.read_text().splitlines()]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+    assert all("trace_id" in e for e in by_kind.get("failover", [])), \
+        by_kind.get("failover")
+    assert by_kind["failover"]
+    for e in by_kind["replica_ejected"]:
+        assert "trace_ids" in e      # every interrupted request's trace
+
+
+# ---------------------------------------------------------------------------
+# DispatchChainProfiler: the fusion-pass input artifact
+# ---------------------------------------------------------------------------
+
+def _decode_tail_workload(n=40):
+    """Eager op chain standing in for the decode step's host tail
+    (ROADMAP item 2: the optimizer/k-step tail is eager-dispatched)."""
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    for _ in range(n):
+        y = x * 2.0
+        y = y + x
+        y = paddle.clip(y, 0.0, 8.0)
+        y = paddle.scale(y, scale=0.25)
+    return y
+
+
+def test_hot_chain_profile_deterministic_and_resolved(tmp_path):
+    from paddle_tpu.observability.runtime import telemetry
+    telemetry.enable()
+    chain_profiler.reset()
+    chain_profiler.arm()
+    _decode_tail_workload()
+    chain_profiler.disarm()
+    counts = dict(chain_profiler._pairs)
+    doc = chain_profiler.export(path=str(tmp_path / "chains.json"),
+                                top_n=5, workload="decode_tail")
+    # documented fusion-pass input schema
+    assert doc["version"] == 1 and doc["kind"] == "paddle_tpu.hot_chains"
+    assert doc["workload"] == "decode_tail"
+    assert doc["chains"], doc
+    top = doc["chains"][0]
+    assert {"ops", "count", "est_us"} <= set(top)
+    assert top["count"] >= 30
+    # the loop's producer->consumer chain is reconstructed in order
+    flat = [op for ch in doc["chains"] for op in ch["ops"]]
+    assert {"multiply", "add", "clip", "scale"} <= set(flat)
+    # ranked: estimated cost is non-increasing
+    ests = [ch["est_us"] for ch in doc["chains"]]
+    assert ests == sorted(ests, reverse=True)
+    # deterministic: same counters => byte-identical artifact
+    doc2 = chain_profiler.profile(top_n=5, workload="decode_tail")
+    assert json.dumps(doc, sort_keys=True) == \
+        json.dumps(doc2, sort_keys=True)
+    on_disk = json.loads((tmp_path / "chains.json").read_text())
+    assert on_disk == json.loads(json.dumps(doc, sort_keys=True))
+    # symbols resolve against the analysis ProjectIndex: ops dispatched
+    # with a literal op_name map to the defining function
+    assert doc["symbols"]["clip"] == "paddle_tpu.core.math_ops.clip"
+    assert doc["symbols"]["scale"] == "paddle_tpu.core.math_ops.scale"
+    sites = dispatch_sites()
+    for op, sym in doc["symbols"].items():
+        assert sym == sites.get(op)
+    # fresh profiler + identical transitions reproduce the ranking
+    p2 = DispatchChainProfiler()
+    p2._pairs = dict(counts)
+    p2._dur = {k: list(v) for k, v in chain_profiler._dur.items()}
+    assert p2.chains(top_n=5) == chain_profiler.chains(top_n=5)
+
+
+def test_chain_profiler_bounded_pairs():
+    p = DispatchChainProfiler(max_pairs=4)
+    p.arm()
+    try:
+        for i in range(20):
+            p.note(f"op{i}")
+    finally:
+        p.disarm()
+    assert len(p._pairs) <= 4
+    assert p.dropped_pairs > 0
