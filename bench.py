@@ -248,7 +248,14 @@ def main():
         peak = None
     mfu = achieved / peak if peak else 0.0
 
+    # run-metadata header (benchmarks/_telemetry.run_header): the
+    # schema_version + bench/runtime fields scripts/bench_sentinel.py
+    # keys trajectory comparability on
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmarks"))
+    from _telemetry import run_header
     result = {
+        **run_header("flagship_train"),
         "metric": f"llama_{n_params/1e6:.0f}M_train_mfu_{gen if on_tpu else platform}",
         "value": round(mfu, 4) if on_tpu else round(tok_per_sec, 2),
         "unit": "MFU" if on_tpu else "tokens/sec (cpu smoke)",

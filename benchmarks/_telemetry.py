@@ -1,4 +1,5 @@
-"""Shared benchmark telemetry: the ``metrics_snapshot`` field.
+"""Shared benchmark telemetry: the ``run_header`` stamp and the
+``metrics_snapshot`` field.
 
 Every benchmark appends the SAME registry view to its one-line JSON
 summary (``bench_serving.py`` and ``bench_checkpoint.py`` established
@@ -6,12 +7,39 @@ the shape; the perf-trajectory tooling diffs it across rounds):
 recompile counts per function, the total eager-dispatch count, plus any
 extra registry namespaces the benchmark asks for.
 
+:func:`run_header` is the trajectory contract (ISSUE 11): a
+``schema_version`` plus run metadata (bench name, python/platform, the
+JAX platform the run actually used) stamped FIRST into every one-line
+JSON, so ``scripts/bench_sentinel.py`` can tell whether two rounds'
+lines are comparable before MAD-banding them — an unstamped line is
+legacy and compared best-effort only.
+
 Import from a benchmark script (the benchmarks dir is sys.path[0] when
 run as ``python benchmarks/bench_x.py``)::
 
-    from _telemetry import metrics_snapshot
+    from _telemetry import metrics_snapshot, run_header
+    out = {**run_header("serving"), ...}
     out["metrics_snapshot"] = metrics_snapshot()
 """
+
+import os
+import platform
+import sys
+
+#: bump on breaking changes to the one-line JSON shape
+BENCH_SCHEMA_VERSION = 2
+
+
+def run_header(bench: str) -> dict:
+    """The leading run-metadata fields of every benchmark's one-line
+    JSON (see module docstring)."""
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "bench": bench,
+        "python": platform.python_version(),
+        "host_platform": sys.platform,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
 
 
 def metrics_snapshot(*namespaces: str) -> dict:

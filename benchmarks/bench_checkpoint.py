@@ -96,9 +96,10 @@ def main():
     # unified-telemetry snapshot: dispatch + recompile counters from the
     # process-global registry (shared shape: benchmarks/_telemetry.py)
     from _telemetry import metrics_snapshot as _snapshot
+    from _telemetry import run_header
     metrics_snapshot = _snapshot()
     print(json.dumps({
-        "bench": "checkpoint",
+        **run_header("checkpoint"),
         "platform": "tpu" if on_tpu else "cpu",
         "state_mb": round(state_bytes / 2 ** 20, 2),
         "sync_save_ms": {"p50": round(_pct(save_ms, 50), 3),

@@ -80,8 +80,9 @@ def main():
     armed_us = min(armed_samples) * 1e6
     overhead_pct = (sorted(ratios)[len(ratios) // 2] - 1.0) * 100
     ok = overhead_pct < BUDGET_PCT
+    from _telemetry import run_header
     print(json.dumps({
-        "bench": "dispatch_overhead",
+        **run_header("dispatch_overhead"),
         "n_ops": N_OPS,
         "trials": TRIALS,
         "disarmed_us_per_op": round(base_us, 3),

@@ -101,8 +101,9 @@ def main():
     warm = wave(prompts)                # same prompts: prefix resident
 
     skipped = 1.0 - warm["prefill_tokens"] / max(cold["prefill_tokens"], 1)
+    from _telemetry import run_header
     out = {
-        "bench": "prefix_cache",
+        **run_header("prefix_cache"),
         "platform": "tpu" if on_tpu else "cpu",
         "requests": n_req,
         "sys_prompt_tokens": sys_len,

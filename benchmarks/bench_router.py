@@ -131,8 +131,9 @@ def main():
     recovery_ms = [(h.finish_t - h.failover_t) * 1e3 for h in failed_over
                    if h.failover_t is not None and h.finish_t is not None]
 
+    from _telemetry import run_header
     out = {
-        "bench": "router",
+        **run_header("router"),
         "platform": "tpu" if on_tpu else "cpu",
         "replicas": 4,
         "requests": n_req,

@@ -73,8 +73,9 @@ def main():
     ttft = m.histograms["ttft_ms"].summary()
     itl = m.histograms["itl_ms"].summary()
     tokens = int(m.counters["tokens_generated_total"])
+    from _telemetry import run_header
     out = {
-        "bench": "serving",
+        **run_header("serving"),
         "platform": "tpu" if on_tpu else "cpu",
         "requests": n_req,
         "num_slots": num_slots,

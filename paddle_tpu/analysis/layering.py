@@ -100,16 +100,21 @@ class ImportConfinementRule:
 
 
 class WallClockFreeRule:
-    """``time.time`` never referenced in the deterministic SLO/goodput
-    math (injected step-driven clocks only)."""
+    """``time.time`` never referenced in the deterministic SLO/goodput/
+    sensor-plane math (injected step-driven clocks only)."""
 
     id = "layer-wall-clock"
-    protects = ("observability/slo.py + goodput.py never read the wall "
-                "clock — breach/recover transitions and goodput splits "
-                "stay byte-reproducible in chaos replays")
+    protects = ("observability/slo.py + goodput.py + the sensor plane "
+                "(timeseries.py, anomaly.py, signals.py) never read the "
+                "wall clock — breach/recover transitions, goodput "
+                "splits and anomaly events stay byte-reproducible in "
+                "chaos replays")
     example = "self._clock = time.time  # in slo.py"
     FILES = ("paddle_tpu/observability/slo.py",
-             "paddle_tpu/observability/goodput.py")
+             "paddle_tpu/observability/goodput.py",
+             "paddle_tpu/observability/timeseries.py",
+             "paddle_tpu/observability/anomaly.py",
+             "paddle_tpu/observability/signals.py")
 
     def run(self, project: Project) -> Iterable[Finding]:
         out: List[Finding] = []

@@ -47,6 +47,18 @@ needs (every future perf PR must be measurable):
   counters and sampled durations into ranked producer→consumer hot
   chains, exported as the stable JSON artifact ROADMAP item 2's fusion
   pass consumes.
+* :mod:`.timeseries` — :class:`MetricHistory`: bounded ring-buffer
+  sampling over the registry on injected clocks; counters read back as
+  windowed rates, gauges as levels + slopes, histograms as windowed
+  quantile estimates.
+* :mod:`.anomaly` — robust anomaly detection over those series: the
+  shared median/MAD z-score primitive (the straggler detector
+  delegates here), a CUSUM drift detector, and the cooldown'd
+  :class:`AnomalyMonitor` emitting ``anomaly`` events.
+* :mod:`.signals` — :class:`SignalBus`: named, smoothed,
+  autoscaler-ready signals (burn trend, queue-depth slope, queue_wait
+  share, pool pressure, spec-acceptance drift) served at ``/varz`` and
+  embedded in flight bundles as ``history.json``.
 * :mod:`.server` — stdlib-only :class:`DiagServer` exposing
   ``/metrics``, ``/healthz``, ``/statusz``, ``/debugz`` and
   ``/tracez`` live.
@@ -61,6 +73,9 @@ Quick start::
 """
 
 from . import format  # noqa: F401
+from .anomaly import (  # noqa: F401
+    AnomalyMonitor, CusumDetector, RobustZScoreDetector, robust_zscore,
+)
 from .events import EventLog, configure_event_log, emit_event, event_log  # noqa: F401
 from .flight import FlightRecorder, flight_recorder  # noqa: F401
 from .goodput import GoodputTracker, StragglerDetector  # noqa: F401
@@ -75,8 +90,10 @@ from .server import DiagServer  # noqa: F401
 from .slo import (  # noqa: F401
     SLObjective, SLOMonitor, latency_objective, ratio_objective,
 )
+from .signals import SignalBus  # noqa: F401
 from .step_timer import StepTimer  # noqa: F401
 from .timeline import SpanCollector, span_collector  # noqa: F401
+from .timeseries import MetricHistory  # noqa: F401
 from .trace import (  # noqa: F401
     TraceContext, current_trace, current_trace_id, new_trace_id,
     trace_context,
@@ -91,5 +108,7 @@ __all__ = [
     "SLObjective", "SLOMonitor", "latency_objective", "ratio_objective",
     "GoodputTracker", "StragglerDetector", "FlightRecorder",
     "flight_recorder", "DiagServer", "SpanCollector", "span_collector",
-    "DispatchChainProfiler", "chain_profiler",
+    "DispatchChainProfiler", "chain_profiler", "MetricHistory",
+    "SignalBus", "AnomalyMonitor", "RobustZScoreDetector",
+    "CusumDetector", "robust_zscore",
 ]

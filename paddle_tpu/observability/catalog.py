@@ -39,6 +39,11 @@ METRICS = {
     # -- goodput / stragglers (observability/goodput.py) -------------------
     "paddle_goodput_ratio": ("gauge", ()),
     "paddle_stragglers_total": ("counter", ("source",)),
+    # -- anomaly detection (observability/anomaly.py) -----------------------
+    "paddle_anomaly_events_total": ("counter", ("series", "detector")),
+    "paddle_anomaly_score": ("gauge", ("series",)),
+    # -- signal bus (observability/signals.py) ------------------------------
+    "paddle_signal_value": ("gauge", ("signal",)),
     # -- fleet router (serving/router.py) ----------------------------------
     "paddle_router_requests_total": ("counter", ("replica", "outcome")),
     "paddle_router_replica_state": ("gauge", ("replica",)),
@@ -64,6 +69,8 @@ EVENT_KINDS = {
     "shed", "cancel", "step_retry", "degraded", "slo_degrade_shed",
     # SLO engine
     "slo_breach", "slo_recovered",
+    # anomaly detection (sensor plane)
+    "anomaly",
     # resilience trainer
     "save_failure", "preempt_flush", "rollback", "step_skipped",
     "straggler",

@@ -26,7 +26,10 @@ a monitor was attached), ``fleet.json`` (the router's /statusz fleet
 view, when a :meth:`attach_router` fleet fronts the engines),
 ``timelines.json`` (slowest-request span trees + segment attributions
 and every active trace, when the timeline collector is armed or a
-router is attached) and ``manifest.json`` (reason, counts, config).
+router is attached), ``history.json`` (the sensor plane's metric
+time-series window, smoothed signals and emitted anomalies, when a
+:meth:`attach_signals` SignalBus exists) and ``manifest.json`` (reason,
+counts, config).
 :meth:`auto_dump` is the hook the runtime calls on watchdog timeouts,
 NaN rollbacks and scheduler degradation — it rate-limits to one bundle
 per reason so a crash loop cannot fill the disk.
@@ -58,6 +61,7 @@ class FlightRecorder:
         self._dump_dir: Optional[str] = None
         self._slo_monitor = None
         self._router = None
+        self._signals = None
         self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
         self.dumps = 0
 
@@ -104,6 +108,15 @@ class FlightRecorder:
         travel with the events and spans (``FleetRouter.__init__`` wires
         this; a later fleet replaces the earlier one)."""
         self._router = router
+
+    def attach_signals(self, bus) -> None:
+        """Sensor plane: the SignalBus's ``history_snapshot()`` — metric
+        time series, smoothed signals and emitted anomalies over the
+        trailing window — lands in ``history.json`` of every bundle, so
+        an ejection postmortem shows the minutes BEFORE the ejection
+        (``SignalBus.__init__`` wires this; a later bus replaces the
+        earlier one)."""
+        self._signals = bus
 
     # -- recording (armed-only; callers gate on flight_armed[0]) ------------
 
@@ -219,6 +232,16 @@ class FlightRecorder:
                 tz = {"error": repr(e)}
             members["timelines.json"] = json.dumps(
                 tz, default=str, indent=1).encode()
+        if self._signals is not None:
+            # the sensor plane's bounded window: series, signal trends
+            # and anomalies leading up to this dump (a torn bus must not
+            # lose the bundle)
+            try:
+                hist = self._signals.history_snapshot()
+            except Exception as e:
+                hist = {"error": repr(e)}
+            members["history.json"] = json.dumps(
+                hist, default=str, indent=1).encode()
         members["manifest.json"] = json.dumps({
             "reason": reason, "pid": os.getpid(),
             "capacity": self._capacity, "events": len(events),

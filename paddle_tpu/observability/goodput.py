@@ -39,6 +39,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, Optional
 
+from .anomaly import robust_zscore
 from .registry import get_registry
 
 #: attribution buckets, in reporting order
@@ -100,8 +101,11 @@ class StragglerDetector:
     ``observe(seconds)`` returns the robust z-score of the new sample
     against the PREVIOUS window (a straggler must not dilute its own
     baseline); a sample is flagged when ``z > z_threshold`` once at
-    least ``min_samples`` are in the window. MAD of zero (perfectly
-    uniform timings) falls back to a fraction of the median so a single
+    least ``min_samples`` are in the window. The math is the shared
+    :func:`~paddle_tpu.observability.anomaly.robust_zscore` primitive
+    (this class used to keep a private copy; the anomaly plane
+    generalised it), including its MAD-of-zero fallback: perfectly
+    uniform timings fall back to a fraction of the median so a single
     slow step still flags instead of dividing by zero.
     """
 
@@ -117,24 +121,10 @@ class StragglerDetector:
             "per-step timing outliers (rolling MAD z-score)",
             labels=("source",))
 
-    @staticmethod
-    def _median(sorted_vals) -> float:
-        n = len(sorted_vals)
-        mid = n // 2
-        if n % 2:
-            return sorted_vals[mid]
-        return 0.5 * (sorted_vals[mid - 1] + sorted_vals[mid])
-
     def zscore(self, value: float) -> float:
         """Robust z of ``value`` against the current window (0 when the
         window is still warming up)."""
-        if len(self._samples) < self.min_samples:
-            return 0.0
-        ordered = sorted(self._samples)
-        med = self._median(ordered)
-        mad = self._median(sorted(abs(s - med) for s in ordered))
-        scale = 1.4826 * mad if mad > 0 else max(abs(med) * 0.05, 1e-12)
-        return (value - med) / scale
+        return robust_zscore(value, self._samples, self.min_samples)
 
     def observe(self, seconds: float, source: str = "train_step") -> float:
         """Score ``seconds`` against the window, THEN admit it; flags

@@ -21,6 +21,10 @@ route      serves
            requests (span tree + exclusive critical-path segments) and
            every still-active trace tree (``?trace=<id>`` narrows to
            one trace's tree + attribution)
+/varz      the sensor plane's live signal document (``SignalBus.varz``
+           via :meth:`DiagServer.attach_signals`): smoothed signal
+           values + windowed trends, per-series anomaly state, history
+           ring status — the autoscaler's decision inputs
 ========== ==============================================================
 
 Providers are callables returning JSON-able data, registered with
@@ -71,6 +75,7 @@ class DiagServer:
         self._thread: Optional[threading.Thread] = None
         self._statusz: Dict[str, Callable[[], object]] = {}
         self._health_fns: Dict[str, Callable[[], str]] = {}
+        self._signals = None
         if monitor is not None:
             self.add_health_source("slo", monitor.health)
             self.add_statusz("slo", monitor.states)
@@ -107,6 +112,12 @@ class DiagServer:
 
     def attach_goodput(self, tracker) -> None:
         self.add_statusz("goodput", tracker.breakdown)
+
+    def attach_signals(self, bus) -> None:
+        """Sensor plane: mounts the SignalBus at ``/varz`` and a signal
+        summary on /statusz."""
+        self._signals = bus
+        self.add_statusz("signals", bus.values)
 
     def attach_kvcache(self, cache) -> None:
         self.add_statusz("kvcache", cache.statusz)
@@ -192,6 +203,15 @@ class DiagServer:
                             body = span_collector.tracez()
                         self._send(200, json.dumps(
                             body, default=str, indent=1).encode())
+                    elif route == "/varz":
+                        if server._signals is None:
+                            self._send(404, json.dumps(
+                                {"error": "no signal bus attached"}
+                            ).encode())
+                        else:
+                            self._send(200, json.dumps(
+                                server._signals.varz(), default=str,
+                                indent=1).encode())
                     elif route == "/debugz":
                         q = parse_qs(url.query)
                         if q.get("dump", ["0"])[0] == "1":
@@ -206,7 +226,7 @@ class DiagServer:
                         self._send(200, json.dumps({
                             "endpoints": ["/metrics", "/healthz",
                                           "/statusz", "/debugz",
-                                          "/tracez"],
+                                          "/tracez", "/varz"],
                         }).encode())
                     else:
                         self._send(404, b'{"error":"not found"}')

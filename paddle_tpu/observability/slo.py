@@ -191,6 +191,10 @@ class SLOMonitor:
             o.name: _ObjectiveState(o) for o in self.objectives}
         if len(self._states) != len(self.objectives):
             raise ValueError("duplicate SLO names")
+        # O(1) mirror of "any objective breached": the serving loop asks
+        # every step (level-triggered queue trimming), so the answer must
+        # not cost a pass over the states dict per step
+        self._breached_count = 0
         # seed a baseline sample per objective at construction, so events
         # between now and the first tick are counted (window deltas are
         # sample-to-sample; without a baseline the first tick's state
@@ -282,6 +286,12 @@ class SLOMonitor:
                     "t": now, "fast_burn": st.fast_burn,
                     "slow_burn": st.slow_burn, "bad": bad, "total": total})
             self._transition(st)
+        # resync the O(1) mirror from the states (covers tests/tools
+        # that latch st.breached directly, bypassing _transition); this
+        # runs once per EVALUATED tick, so the per-step cost of
+        # breached() stays one integer compare
+        self._breached_count = sum(
+            1 for st in self._states.values() if st.breached)
 
     def _transition(self, st: _ObjectiveState) -> None:
         thr = self.burn_threshold
@@ -292,6 +302,7 @@ class SLOMonitor:
             return
         if not st.breached and st.fast_burn > thr and st.slow_burn > thr:
             st.breached = True
+            self._breached_count += 1
             st.breach_count += 1
             self._g_breached.set(1.0, slo=obj.name)
             self._c_breaches.inc(slo=obj.name)
@@ -304,6 +315,7 @@ class SLOMonitor:
                 self.on_breach(obj.name, st.to_dict())
         elif st.breached and st.fast_burn <= thr:
             st.breached = False
+            self._breached_count -= 1
             self._g_breached.set(0.0, slo=obj.name)
             emit_event("slo_recovered", slo=obj.name,
                        fast_burn=round(st.fast_burn, 3),
@@ -316,7 +328,7 @@ class SLOMonitor:
     def health(self) -> str:
         """``breached`` | ``degraded`` | ``ok`` (see class docstring)."""
         states = self._states.values()
-        if any(st.breached for st in states):
+        if self._breached_count > 0:
             return "breached"
         if any(st.fast_burn > self.burn_threshold
                and st.fast_events >= self.min_events for st in states):
@@ -326,7 +338,7 @@ class SLOMonitor:
     def breached(self, name: Optional[str] = None) -> bool:
         if name is not None:
             return self._states[name].breached
-        return any(st.breached for st in self._states.values())
+        return self._breached_count > 0      # O(1): per-step hot path
 
     def states(self) -> List[Dict[str, object]]:
         """JSON-able per-objective state (statusz / debug bundles)."""

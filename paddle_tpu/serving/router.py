@@ -72,6 +72,7 @@ from ..kvcache.radix import RadixTree
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
 from ..observability.registry import get_registry
+from ..observability.timeseries import history_armed
 from ..observability.trace import new_trace_id
 from ..profiler.record import emit_span, spans_armed
 from .health import STATE_CODE, ReplicaState
@@ -185,6 +186,7 @@ class FleetRouter:
         self._parked: List[RouterRequest] = []  # no routable replica yet
         self._probe: Dict[int, int] = {}        # replica id -> router rid
         self.slo_monitor = None
+        self.signal_bus = None                  # see attach_signal_bus
         # router-side prefix index: one tree per replica, synthetic page
         # ids (the tree wants unique ints; pages here are just node keys)
         self._index: Dict[int, RadixTree] = {
@@ -442,6 +444,13 @@ class FleetRouter:
         """Unresolved router requests (routed + parked)."""
         return len(self._requests)
 
+    @property
+    def parked(self) -> int:
+        """Requests waiting for ANY routable replica (fleet backlog the
+        sensor plane watches: a growing parked count is the clearest
+        "scale up" signal there is)."""
+        return len(self._parked)
+
     def step(self, params) -> int:
         """One fleet round: inject scheduled chaos, advance breakers,
         retry parked requests, step every live replica (failures feed
@@ -544,6 +553,10 @@ class FleetRouter:
             self._g_state.set(self._state_code(r), replica=str(rid))
         if self.slo_monitor is not None:
             self.slo_monitor.tick()
+        if self.signal_bus is not None and history_armed[0]:
+            # sensor plane: decimated inside tick() — the common
+            # per-step cost is one clock read + compare
+            self.signal_bus.tick()
 
     def run(self, params, max_steps: Optional[int] = None) -> None:
         """Drive ``step`` until every request resolves."""
@@ -823,7 +836,25 @@ class FleetRouter:
         }
         if self.slo_monitor is not None:
             out["slo"] = self.slo_monitor.states()
+        if self.signal_bus is not None:
+            out["signals"] = self.signal_bus.values()
         return out
+
+    def attach_signal_bus(self, bus=None, **bus_kw):
+        """Wire the fleet sensor plane: a :class:`~paddle_tpu.
+        observability.signals.SignalBus` carrying fleet pending/parked
+        plus per-replica queue depth, SLO burn and speculation
+        acceptance, ticked once per router step while armed (see
+        ``ServingScheduler.attach_signal_bus``). Re-attach after
+        ``replace_replica`` so per-replica signals follow the new
+        handle."""
+        if bus is None:
+            from ..observability.signals import SignalBus
+            bus_kw.setdefault("clock", self._clock)
+            bus = SignalBus(**bus_kw)
+        bus.attach_router(self)
+        self.signal_bus = bus
+        return bus
 
     def make_slo_monitor(self, completion_target: float = 0.99,
                          **monitor_kw):

@@ -68,6 +68,7 @@ from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
 from ..observability.step_timer import StepTimer
 from ..observability.timeline import span_collector, timeline_armed
+from ..observability.timeseries import history_armed
 from ..observability.trace import new_trace_id, trace_context
 from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
 from .metrics import ServingMetrics
@@ -176,6 +177,7 @@ class ServingScheduler:
         self.step_timer = StepTimer()            # host/device + tokens/s
         self.degraded = False
         self.slo_monitor = None                  # see attach_slo_monitor
+        self.signal_bus = None                   # see attach_signal_bus
         self._slo_shed_fraction = 0.5
         # engine hooks: route chunk tokens / retirements into the streams
         engine.token_callback = self._on_engine_token
@@ -385,6 +387,22 @@ class ServingScheduler:
         monitor.on_breach = self._on_slo_breach
         monitor.on_recover = self._on_slo_recover
 
+    def attach_signal_bus(self, bus=None, **bus_kw):
+        """Wire the sensor plane (ISSUE 11): a
+        :class:`~paddle_tpu.observability.signals.SignalBus` over THIS
+        scheduler's queue/engine/SLO state, ticked once per step while
+        the plane is armed (``timeseries.history_armed`` — one list
+        index disarmed; the tick itself is decimated to the bus
+        interval). ``bus=None`` builds one on the scheduler's own clock
+        so fake-clock tests stay deterministic end to end."""
+        if bus is None:
+            from ..observability.signals import SignalBus
+            bus_kw.setdefault("clock", self._clock)
+            bus = SignalBus(**bus_kw)
+        bus.attach_scheduler(self)
+        self.signal_bus = bus
+        return bus
+
     def _on_slo_breach(self, name: str, state: dict) -> None:
         self.metrics.set_gauge("slo_breached", 1.0)
         self.metrics.mark("slo_breach")
@@ -566,6 +584,10 @@ class ServingScheduler:
                         cap = int(self.config.max_queue_depth
                                   * (1 - self._slo_shed_fraction)) or 1
                         self._shed_overflow(cap=cap, reason="slo")
+                if self.signal_bus is not None and history_armed[0]:
+                    # sensor plane: decimated inside tick() — the common
+                    # per-step cost is one clock read + compare
+                    self.signal_bus.tick()
 
     def run(self, params, max_steps: Optional[int] = None) -> None:
         """Drive ``step`` until every request resolves (or degradation)."""
@@ -865,4 +887,8 @@ class ServingScheduler:
             out["slowest_requests"] = span_collector.slowest()
         if self.slo_monitor is not None:
             out["slo"] = self.slo_monitor.states()
+        if self.signal_bus is not None:
+            # smoothed signal values + windowed trends (the full series
+            # and anomaly document lives on /varz)
+            out["signals"] = self.signal_bus.values()
         return out

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the checked-in bench trajectory.
+
+The repo keeps one ``BENCH_r<NN>.json`` per growth round (the driver's
+record of ``bench.py``'s one-line JSON). This script is the noise-aware
+gate ROADMAP item 1 requires before any fusion (or any other "perf"
+change) is kept: it compares a FRESH bench line against the trajectory
+with **MAD-banded thresholds** — the same robust statistics the anomaly
+plane uses (``paddle_tpu.observability.anomaly``) — instead of a naive
+"within X% of last round" rule that either pages on benchmark noise or
+waves real regressions through, and **exits nonzero on a regression**.
+
+Comparison model:
+
+* trajectory entries group by ``(metric, unit)`` — rounds that measured
+  a different workload (the r01 CPU smoke vs the later v5e MFU rounds)
+  never band each other;
+* for each watched numeric field shared by the fresh line and at least
+  ``--min-history`` trajectory points, the band is
+  ``median ± max(k · 1.4826 · MAD, rel_floor · |median|)`` — the MAD
+  term adapts to each series' measured noise, the relative floor stops
+  a freakishly quiet series from flagging micro-jitter;
+* direction comes from the field: throughput-like fields regress LOW
+  (``tokens_per_sec``, MFU ``value``), latency-like fields regress HIGH
+  (``*_ms``, a ``ms``/``latency`` unit). A 2x ITL regression is a
+  halved ``tokens_per_sec`` — exactly what the band catches.
+
+Modes::
+
+    # gate a fresh line (a bench's stdout JSON, or a BENCH_r*.json)
+    python scripts/bench_sentinel.py --fresh /tmp/bench_line.json
+
+    # CI self-check: every trajectory entry re-judged against the rest
+    # (proves the checked-in history is self-consistent — verify.sh's
+    # --sentinel stage)
+    python scripts/bench_sentinel.py --replay
+
+Output is ONE JSON line (``{"sentinel": ..., "pass": bool, ...}``);
+exit 0 on pass, 1 on regression, 2 on usage/IO errors, 3 when a
+``--fresh`` line had NO judgeable trajectory peers (renamed metric /
+new platform — a vacuous pass would hide a regression; override with
+``--allow-new-metric`` for a workload's first round). Entries stamped
+with ``schema_version`` (``benchmarks/_telemetry.run_header``) are
+trusted verbatim; unstamped legacy lines are compared best-effort and
+noted in the report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from paddle_tpu.observability.anomaly import MAD_SCALE, mad, median  # noqa: E402
+
+#: watched fields -> direction ("high" = regresses when it drops,
+#: "low" = regresses when it rises, "unit" = decide from the unit string)
+FIELDS: Dict[str, str] = {
+    "tokens_per_sec": "high",
+    "tokens_per_s": "high",
+    "value": "unit",
+    "acceptance_rate": "high",
+    "overhead_pct": "low",
+    "ttft_p50_ms": "low",
+    "itl_p50_ms": "low",
+}
+
+#: unit substrings that mark "value" as lower-is-better
+_LOW_UNITS = ("ms", "latency", "seconds", "s/step", "pct", "%")
+
+
+def field_direction(field: str, unit: str) -> str:
+    d = FIELDS[field]
+    if d != "unit":
+        return d
+    u = unit.lower()
+    return "low" if any(t in u for t in _LOW_UNITS) else "high"
+
+
+def load_entry(path: str) -> Dict[str, Any]:
+    """One bench line: either the raw JSON object a benchmark printed,
+    or a driver-shaped ``BENCH_r*.json`` whose ``parsed`` field holds
+    it."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def group_key(entry: Dict[str, Any]) -> Tuple[str, str]:
+    return (str(entry.get("metric", entry.get("bench", "?"))),
+            str(entry.get("unit", "")))
+
+
+def judge(fresh: Dict[str, Any], trajectory: List[Dict[str, Any]],
+          band_k: float, rel_floor: float, min_history: int
+          ) -> Dict[str, Any]:
+    """Compare one fresh entry against its same-(metric, unit) peers.
+    Returns the verdict document (``pass`` True when no watched field
+    regressed; fields without enough history are reported, not judged)."""
+    key = group_key(fresh)
+    peers = [e for e in trajectory if group_key(e) == key]
+    unit = str(fresh.get("unit", ""))
+    checked: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for field in sorted(FIELDS):
+        if not isinstance(fresh.get(field), (int, float)):
+            continue
+        series = [float(e[field]) for e in peers
+                  if isinstance(e.get(field), (int, float))]
+        value = float(fresh[field])
+        if len(series) < min_history:
+            checked.append({"field": field, "value": value,
+                            "history": len(series),
+                            "verdict": "insufficient_history"})
+            continue
+        med = median(series)
+        band = max(band_k * MAD_SCALE * mad(series, center=med),
+                   rel_floor * abs(med))
+        direction = field_direction(field, unit)
+        if direction == "high":
+            bad = value < med - band
+            bound = med - band
+        else:
+            bad = value > med + band
+            bound = med + band
+        row = {"field": field, "value": value, "median": round(med, 4),
+               "band": round(band, 4), "bound": round(bound, 4),
+               "direction": direction, "history": len(series),
+               "verdict": "regression" if bad else "ok"}
+        checked.append(row)
+        if bad:
+            regressions.append(row)
+    judged = sum(1 for row in checked
+                 if row["verdict"] in ("ok", "regression"))
+    return {"metric": key[0], "unit": key[1], "peers": len(peers),
+            "schema_version": fresh.get("schema_version"),
+            "judged": judged, "checked": checked,
+            "regressions": regressions, "pass": not regressions}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/bench_sentinel.py",
+        description="noise-aware perf-regression gate over the "
+                    "BENCH_* trajectory")
+    ap.add_argument("--fresh", help="path to the fresh bench JSON line "
+                                    "('-' reads stdin)")
+    ap.add_argument("--replay", action="store_true",
+                    help="re-judge every trajectory entry against the "
+                         "others (self-consistency gate)")
+    ap.add_argument("--trajectory",
+                    default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
+                    help="trajectory glob (default: repo BENCH_r*.json)")
+    ap.add_argument("--band-k", type=float, default=4.0,
+                    help="MAD band width in robust sigmas (default 4)")
+    ap.add_argument("--rel-floor", type=float, default=0.05,
+                    help="minimum band as a fraction of |median| "
+                         "(default 0.05)")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="trajectory points needed before a field is "
+                         "judged (default 2)")
+    ap.add_argument("--allow-new-metric", action="store_true",
+                    help="exit 0 even when the fresh line's (metric, "
+                         "unit) has no judgeable trajectory peers "
+                         "(first round of a renamed workload)")
+    args = ap.parse_args(argv)
+    if not args.replay and not args.fresh:
+        ap.error("one of --fresh or --replay is required")
+
+    paths = sorted(glob.glob(args.trajectory))
+    trajectory: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            e = load_entry(p)
+        except Exception as exc:
+            print(json.dumps({"sentinel": "error", "path": p,
+                              "error": repr(exc)}))
+            return 2
+        e["_path"] = p
+        trajectory.append(e)
+
+    if args.replay:
+        results = []
+        ok = True
+        for e in trajectory:
+            others = [o for o in trajectory if o is not e]
+            v = judge(e, others, args.band_k, args.rel_floor,
+                      args.min_history)
+            v["entry"] = os.path.basename(e["_path"])
+            results.append(v)
+            ok = ok and v["pass"]
+        print(json.dumps({"sentinel": "replay", "entries": len(results),
+                          "results": results, "pass": ok}))
+        return 0 if ok else 1
+
+    try:
+        if args.fresh == "-":
+            fresh = json.loads(sys.stdin.read())
+            if isinstance(fresh.get("parsed"), dict):
+                fresh = fresh["parsed"]
+        else:
+            fresh = load_entry(args.fresh)
+    except Exception as exc:
+        print(json.dumps({"sentinel": "error", "path": args.fresh,
+                          "error": repr(exc)}))
+        return 2
+    verdict = judge(fresh, trajectory, args.band_k, args.rel_floor,
+                    args.min_history)
+    verdict["sentinel"] = "fresh"
+    if verdict["judged"] == 0 and not args.allow_new_metric:
+        # a renamed metric / new platform suffix has no peers: passing
+        # silently would make a regression indistinguishable from a
+        # clean run — fail loudly (exit 3) unless explicitly allowed
+        verdict["pass"] = False
+        verdict["verdict"] = "no_comparable_history"
+        print(json.dumps(verdict))
+        return 3
+    print(json.dumps(verdict))
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

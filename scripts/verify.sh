@@ -1,29 +1,50 @@
 #!/usr/bin/env bash
 # One-stop PR gate: tier-1 tests + tpu-lint + the armed-observability
-# overhead guard. Run from the repo root:
+# overhead guard + the bench-trajectory sentinel. Run from the repo root:
 #
-#   bash scripts/verify.sh          # everything (tier-1 is the slow part)
-#   bash scripts/verify.sh --fast   # skip tier-1 (lint + overhead only)
+#   bash scripts/verify.sh             # everything (tier-1 is the slow part)
+#   bash scripts/verify.sh --fast      # lint + overhead only (skips the
+#                                      # sentinel and tier-1)
+#   bash scripts/verify.sh --sentinel  # ONLY the perf-regression sentinel
+#
+# The sentinel stage replays the checked-in BENCH_r*.json trajectory
+# through scripts/bench_sentinel.py (noise-aware MAD bands) — the gate
+# ROADMAP item 1 requires before any fusion/perf change is kept. Gate a
+# fresh line directly with:
+#
+#   python scripts/bench_sentinel.py --fresh /tmp/bench_line.json
 #
 # Exit codes: 0 all green; first failing stage's code otherwise.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 fast=0
+only_sentinel=0
 [ "${1:-}" = "--fast" ] && fast=1
+[ "${1:-}" = "--sentinel" ] && only_sentinel=1
 
-echo "== [1/3] tpu-lint (python -m paddle_tpu.analysis) =="
+if [ "$only_sentinel" = "1" ]; then
+    echo "== bench_sentinel (trajectory replay) =="
+    python scripts/bench_sentinel.py --replay
+    exit $?
+fi
+
+echo "== [1/4] tpu-lint (python -m paddle_tpu.analysis) =="
 python -m paddle_tpu.analysis || exit $?
 
-echo "== [2/3] bench_obs_overhead (armed <1% measured, 3% budget) =="
+echo "== [2/4] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_obs_overhead.py || exit $?
 
 if [ "$fast" = "1" ]; then
-    echo "== [3/3] tier-1 skipped (--fast) =="
+    echo "== [3/4] sentinel skipped (--fast) =="
+    echo "== [4/4] tier-1 skipped (--fast) =="
     exit 0
 fi
 
-echo "== [3/3] tier-1 test suite =="
+echo "== [3/4] bench_sentinel (trajectory replay) =="
+python scripts/bench_sentinel.py --replay || exit $?
+
+echo "== [4/4] tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
