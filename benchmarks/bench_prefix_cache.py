@@ -54,6 +54,13 @@ def main():
         cfg, GenerationConfig(max_new_tokens=max_new),
         num_slots=num_slots, page_size=16, max_seq_len=max_seq,
         chunk=chunk, prefix_cache=True)
+    # HBM ledger armed for the run: the cache study gains the byte view
+    # (how much of the pool the warm cache actually holds) plus the
+    # planner verdict the int8-pages PR must double (ISSUE 12)
+    from paddle_tpu.observability.memory import (MEM_CLASSES,
+                                                memory_ledger)
+    memory_ledger.reset()
+    memory_ledger.arm()
 
     rng = np.random.RandomState(0)
 
@@ -121,6 +128,22 @@ def main():
     # same registry view every bench carries (benchmarks/_telemetry.py)
     from _telemetry import metrics_snapshot
     out["metrics_snapshot"] = metrics_snapshot()
+    # capacity section: the byte split behind the hit rate (cached pages
+    # ARE spent HBM) + planner verdict — "same HBM, 2x the pages" (int8
+    # pages, ROADMAP item 2) must move these numbers, measurably
+    mem_snap = memory_ledger.snapshot()
+    planner = mem_snap["pools"][0]["planner"]
+    assert planner["exact"], planner
+    out["memory"] = {
+        "page_bytes": mem_snap["pools"][0]["page_bytes"],
+        "bytes": mem_snap["pools"][0]["bytes"],
+        "peak_bytes": {c: memory_ledger.peak_bytes(c)
+                       for c in MEM_CLASSES},
+        "planner_predicted_max_pages": planner["predicted_max_pages"],
+        "planner_actual_max_pages": planner["actual_max_pages"],
+        "planner_exact": planner["exact"],
+    }
+    memory_ledger.disarm()
     assert skipped >= 0.5, (
         f"warm wave skipped only {100 * skipped:.1f}% of prefill tokens")
     print(json.dumps(out))

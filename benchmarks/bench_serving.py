@@ -37,6 +37,13 @@ def main():
         n_req, max_new, num_slots, chunk = 24, 8, 4, 2
         prompt_lens = (3, 12)
     params = L.init_stacked_params(cfg, seed=0)
+    # HBM memory ledger: armed for the whole run so the JSON line gains
+    # judgeable capacity numbers (peak bytes by class, planner verdict)
+    # for the int8-pages PR to beat (ISSUE 12 / ROADMAP item 2)
+    from paddle_tpu.observability.memory import (MEM_CLASSES,
+                                                memory_ledger)
+    memory_ledger.reset()
+    memory_ledger.arm()
 
     eng = ContinuousBatchingEngine(
         cfg, GenerationConfig(max_new_tokens=max_new),
@@ -166,6 +173,30 @@ def main():
         "spec_on": _timeline_storm(speculative=True),
     }
     out["hot_chains"] = _hot_chains()
+    # capacity section: peak device bytes by class across the whole run
+    # (latency engine + storms + spec A/B) and the main engine's planner
+    # verdict — predicted max pages must match the real pool exactly,
+    # so "int8 pages double capacity" becomes a one-line diff
+    memory_ledger.observe(eng.mgr,
+                          cache_stats=eng.cache.stats, audit=False)
+    mem_snap = memory_ledger.snapshot()
+    # the pool table is LRU-ordered and the storms registered their own
+    # engines' pools — the observe above moved the MAIN engine's pool
+    # to the end, so [-1] is the one whose geometry this line reports
+    main_pool = mem_snap["pools"][-1]
+    assert main_pool["usable_pages"] == eng.mgr.usable_pages
+    planner = main_pool["planner"]
+    assert planner["exact"], planner
+    out["memory"] = {
+        "page_bytes": main_pool["page_bytes"],
+        "peak_bytes": {c: memory_ledger.peak_bytes(c)
+                       for c in MEM_CLASSES},
+        "planner_predicted_max_pages": planner["predicted_max_pages"],
+        "planner_actual_max_pages": planner["actual_max_pages"],
+        "planner_exact": planner["exact"],
+        "pools_tracked": len(mem_snap["pools"]),
+    }
+    memory_ledger.disarm()
     print(json.dumps(out))
 
 

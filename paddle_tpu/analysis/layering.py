@@ -304,9 +304,34 @@ class LayerDepsRule:
                                  "observability", "core", "ops"),
     }
 
+    #: file -> sub-packages it may not import AT ANY SCOPE (lazy
+    #: function-scope imports included). The memory ledger is FED by the
+    #: serving stack and never pulls from it — even a lazy import would
+    #: let accounting reach back into the layers it measures.
+    STRICT_CONTRACTS: Dict[str, Tuple[str, ...]] = {
+        "paddle_tpu/observability/memory.py": (
+            "serving", "inference", "kvcache", "models", "resilience",
+            "distributed"),
+    }
+
     def run(self, project: Project) -> Iterable[Finding]:
         out: List[Finding] = []
         for mod in project.iter_modules((PKG,)):
+            strict = self.STRICT_CONTRACTS.get(mod.rel)
+            if strict is not None:
+                for node in mod.nodes_of(ast.Import, ast.ImportFrom):
+                    for t in _abs_import_targets(mod.rel, node):
+                        parts = t.split(".")
+                        if parts[0] != "paddle_tpu" or len(parts) < 2:
+                            continue
+                        if parts[1] in strict:
+                            out.append(Finding(
+                                mod.rel, node.lineno, self.id,
+                                f"import of paddle_tpu.{parts[1]} from "
+                                f"{mod.rel} violates its STRICT layering "
+                                "contract (the ledger is fed, never "
+                                "pulls — lazy imports are banned here "
+                                "too)", symbol=f"strict:{parts[1]}"))
             forbidden: Optional[Tuple[str, ...]] = None
             for prefix, banned in self.CONTRACTS.items():
                 if mod.rel.startswith(prefix):

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..flags import flag_value
 from ..observability.events import emit_event
+from ..observability.memory import memory_armed, memory_ledger
 from ..observability.runtime import recompiles
 from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
 
@@ -466,6 +467,10 @@ class ContinuousBatchingEngine:
         #: their cached prefix; benchmarks diff this against submitted
         #: prompt lengths for the skip ratio)
         self._prefill_tokens = 0
+        # HBM memory ledger (observability/memory.py): when armed, every
+        # step feeds the pool's byte split + per-request holdings and
+        # runs the byte conservation audit alongside check_conservation.
+        self._mem_tick = 0
         # serving-layer hooks (paddle_tpu.serving): both default to None so
         # the plain submit/step/collect/serve surface is byte-identical.
         # token_callback(rid, token) fires for every KEPT token as step()
@@ -634,6 +639,11 @@ class ContinuousBatchingEngine:
                     # request within capacity admits (free == usable -
                     # shared); beyond capacity nothing ever will
                     if self.mgr.pages_for(total) > self.mgr.usable_pages:
+                        memory_ledger.note_oom(
+                            "infeasible", self.mgr,
+                            need_pages=self.mgr.pages_for(total),
+                            free_pages=self.mgr.num_free_pages,
+                            request_id=req.rid, trace_id=req.trace_id)
                         raise MemoryError(
                             f"request {req.rid} needs "
                             f"{self.mgr.pages_for(total)} pages but the "
@@ -653,6 +663,12 @@ class ContinuousBatchingEngine:
             else:
                 pages = self.mgr.allocate(req.rid, total)
             self.mgr._lens[req.rid] = lp
+            if memory_armed[0]:
+                # per-request HBM attribution: cached-vs-fresh page
+                # split for /memz, memory.json and the request span args
+                memory_ledger.note_request(
+                    self.mgr, req.rid, prompt_len=lp,
+                    cached_pages=len(shared), trace_id=req.trace_id)
             picked.append((s, req, pages, lp, n_cached))
         return picked
 
@@ -871,10 +887,46 @@ class ContinuousBatchingEngine:
         mode folds draft verification into the same single dispatch
         (``_step_spec``)."""
         if self._speculative:
-            return self._step_spec(params)
-        if self._unified:
-            return self._step_unified(params)
-        return self._step_legacy(params)
+            n = self._step_spec(params)
+        elif self._unified:
+            n = self._step_unified(params)
+        else:
+            n = self._step_legacy(params)
+        if memory_armed[0]:
+            # the memory half of the per-step audit: byte split by class
+            # + per-request holdings + byte conservation, run alongside
+            # check_conservation (one list index when disarmed)
+            self._note_memory(params)
+        return n
+
+    def _note_memory(self, params) -> None:
+        """Feed the HBM ledger one accounting round (armed only): model
+        weights (once per params object), the pool's page split with the
+        speculative-tail attribution, and the prefix-cache stats.
+
+        Invariant-checked engines feed EVERY step — the byte
+        conservation audit rides alongside ``check_conservation``. An
+        engine that opted out of per-step invariant checking (the
+        latency-critical large-pool configuration) decimates its feed
+        to every 16th step: the common armed-step cost collapses to one
+        counter bump, and the books refresh on that cadence instead."""
+        if not self._check_invariants:
+            self._mem_tick += 1
+            if self._mem_tick & 15:
+                return
+        # cheap on the cached path (identity + fingerprint dict hit);
+        # the ledger itself guards against id reuse across dead pytrees
+        memory_ledger.note_weights(params)
+        reserved = None
+        if self._speculative:
+            reserved = {self._slot_rid[s]: int(self._reserved[s])
+                        for s in range(self.num_slots)
+                        if self._slot_rid[s] is not None}
+        memory_ledger.observe(
+            self.mgr, reserved=reserved,
+            cache_stats=self.cache.stats if self.cache is not None
+            else None,
+            audit=self._check_invariants)
 
     def _step_legacy(self, params) -> int:
         self._admit(params)

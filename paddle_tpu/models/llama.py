@@ -249,6 +249,39 @@ def init_stacked_params(config: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
     }
 
 
+def param_count(config: LlamaConfig) -> int:
+    """Parameter count of the stacked layout (embed + L decoder layers +
+    final norm + lm_head) — the analytic twin of walking a real pytree,
+    for capacity planning before any weights exist."""
+    L, h, m = (config.num_hidden_layers, config.hidden_size,
+               config.intermediate_size)
+    kvh = config.num_key_value_heads * config.head_dim
+    per_layer = 2 * h * h + 2 * h * kvh + 3 * h * m + 2 * h
+    return (config.vocab_size * h + L * per_layer + h
+            + h * config.vocab_size)
+
+
+def param_nbytes(config: LlamaConfig) -> int:
+    """Device bytes the stacked weights occupy at ``config.dtype`` — the
+    ``weight_bytes`` input of the HBM capacity planner
+    (``observability.memory.plan_capacity``); matches
+    ``pytree_nbytes(init_stacked_params(config))`` exactly."""
+    return param_count(config) * jnp.dtype(config.dtype).itemsize
+
+
+def kv_geometry(config: LlamaConfig, page_size: int) -> Dict[str, int]:
+    """The paged-KV geometry kwargs of the HBM capacity planner: one
+    call site for "what does a page of this model cost" so planner
+    examples, benches and the engine agree byte-for-byte."""
+    return {
+        "num_layers": config.num_hidden_layers,
+        "num_kv_heads": config.num_key_value_heads,
+        "head_dim": config.head_dim,
+        "page_size": page_size,
+        "dtype_bytes": jnp.dtype(config.dtype).itemsize,
+    }
+
+
 def stacked_param_specs(config: LlamaConfig) -> Dict[str, P]:
     """PartitionSpecs: L axis over pp, Megatron dims over mp, row-sharded big
     matrices additionally over 'sharding' (ZeRO-3 style weight sharding)."""

@@ -59,9 +59,16 @@ needs (every future perf PR must be measurable):
   autoscaler-ready signals (burn trend, queue-depth slope, queue_wait
   share, pool pressure, spec-acceptance drift) served at ``/varz`` and
   embedded in flight bundles as ``history.json``.
+* :mod:`.memory` — HBM memory ledger: byte-level device accounting by
+  class (weights / kv_live / kv_spec / kv_cached / kv_free / optimizer)
+  with peak watermarks and a byte conservation audit, a capacity
+  planner (geometry + dtype + HBM budget → max pages / slots /
+  context, validated against live pools), per-request page
+  attribution, and OOM forensics (``oom_pressure`` events +
+  ``memory.json`` flight bundles).
 * :mod:`.server` — stdlib-only :class:`DiagServer` exposing
-  ``/metrics``, ``/healthz``, ``/statusz``, ``/debugz`` and
-  ``/tracez`` live.
+  ``/metrics``, ``/healthz``, ``/statusz``, ``/debugz``,
+  ``/tracez``, ``/varz`` and ``/memz`` live.
 
 Quick start::
 
@@ -79,6 +86,10 @@ from .anomaly import (  # noqa: F401
 from .events import EventLog, configure_event_log, emit_event, event_log  # noqa: F401
 from .flight import FlightRecorder, flight_recorder  # noqa: F401
 from .goodput import GoodputTracker, StragglerDetector  # noqa: F401
+from .memory import (  # noqa: F401
+    CapacityPlan, MemoryLedger, memory_ledger, plan_capacity,
+    pool_occupancy, pytree_nbytes,
+)
 from .registry import (  # noqa: F401
     Counter, Gauge, HistogramMetric, MetricsRegistry, get_registry,
 )
@@ -110,5 +121,6 @@ __all__ = [
     "flight_recorder", "DiagServer", "SpanCollector", "span_collector",
     "DispatchChainProfiler", "chain_profiler", "MetricHistory",
     "SignalBus", "AnomalyMonitor", "RobustZScoreDetector",
-    "CusumDetector", "robust_zscore",
+    "CusumDetector", "robust_zscore", "CapacityPlan", "MemoryLedger",
+    "memory_ledger", "plan_capacity", "pool_occupancy", "pytree_nbytes",
 ]

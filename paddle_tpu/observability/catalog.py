@@ -44,6 +44,10 @@ METRICS = {
     "paddle_anomaly_score": ("gauge", ("series",)),
     # -- signal bus (observability/signals.py) ------------------------------
     "paddle_signal_value": ("gauge", ("signal",)),
+    # -- HBM memory ledger (observability/memory.py) ------------------------
+    "paddle_mem_bytes": ("gauge", ("class",)),
+    "paddle_mem_peak_bytes": ("gauge", ("class",)),
+    "paddle_mem_admission_rejects_total": ("counter", ()),
     # -- fleet router (serving/router.py) ----------------------------------
     "paddle_router_requests_total": ("counter", ("replica", "outcome")),
     "paddle_router_replica_state": ("gauge", ("replica",)),
@@ -71,6 +75,8 @@ EVENT_KINDS = {
     "slo_breach", "slo_recovered",
     # anomaly detection (sensor plane)
     "anomaly",
+    # HBM memory ledger (allocation failure / page-admission shortfall)
+    "oom_pressure",
     # resilience trainer
     "save_failure", "preempt_flush", "rollback", "step_skipped",
     "straggler",
@@ -96,11 +102,15 @@ EVENT_KINDS = {
 #: names, so a typo'd span silently drops a segment from every request
 #: breakdown; tpu-lint's ``span-contract`` rule checks both directions.
 SPANS = {
-    # scheduler request lifecycle (serving/scheduler.py)
-    "request": ("request_id",),
+    # scheduler request lifecycle (serving/scheduler.py); the request
+    # envelope and admission spans carry the memory ledger's per-request
+    # attribution (pages held, cached-vs-fresh bytes) so /tracez answers
+    # "what did this request cost in HBM" next to "where did its time go"
+    "request": ("request_id", "kv_pages", "cached_bytes", "fresh_bytes"),
     "step": (),
     "queue_wait": ("request_id",),
-    "admission": ("request_id",),
+    "admission": ("request_id", "kv_pages", "cached_bytes",
+                  "fresh_bytes"),
     # engine phases (inference/decoding.py)
     "engine.prefill": ("request_id", "slot", "prefill_tokens", "bucket",
                        "prompt_len", "cached_tokens"),

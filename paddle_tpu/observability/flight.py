@@ -28,8 +28,11 @@ view, when a :meth:`attach_router` fleet fronts the engines),
 and every active trace, when the timeline collector is armed or a
 router is attached), ``history.json`` (the sensor plane's metric
 time-series window, smoothed signals and emitted anomalies, when a
-:meth:`attach_signals` SignalBus exists) and ``manifest.json`` (reason,
-counts, config).
+:meth:`attach_signals` SignalBus exists), ``memory.json`` (the HBM
+memory ledger's class bytes + peaks, per-pool planner verdicts,
+per-request page holders and last OOM, when ``observability.memory`` is
+armed — an ``oom_<source>`` auto-dump IS the allocation-failure
+postmortem) and ``manifest.json`` (reason, counts, config).
 :meth:`auto_dump` is the hook the runtime calls on watchdog timeouts,
 NaN rollbacks and scheduler degradation — it rate-limits to one bundle
 per reason so a crash loop cannot fill the disk.
@@ -242,6 +245,18 @@ class FlightRecorder:
                 hist = {"error": repr(e)}
             members["history.json"] = json.dumps(
                 hist, default=str, indent=1).encode()
+        from .memory import memory_armed, memory_ledger
+        if memory_armed[0]:
+            # the memory ledger's books: class bytes + peaks, per-pool
+            # planner verdicts, per-request page holders and the last
+            # OOM — an allocation failure's postmortem is the bundle
+            # whose reason is ``oom_<source>``
+            try:
+                mem = memory_ledger.snapshot()
+            except Exception as e:
+                mem = {"error": repr(e)}
+            members["memory.json"] = json.dumps(
+                mem, default=str, indent=1).encode()
         members["manifest.json"] = json.dumps({
             "reason": reason, "pid": os.getpid(),
             "capacity": self._capacity, "events": len(events),

@@ -25,6 +25,11 @@ route      serves
            via :meth:`DiagServer.attach_signals`): smoothed signal
            values + windowed trends, per-series anomaly state, history
            ring status — the autoscaler's decision inputs
+/memz      the HBM memory ledger (``observability.memory``): device
+           bytes by class + peak watermarks, per-pool planner verdicts,
+           per-request page holders, prefix-cache stats and the last
+           OOM — the same document every flight bundle embeds as
+           ``memory.json``
 ========== ==============================================================
 
 Providers are callables returning JSON-able data, registered with
@@ -52,6 +57,7 @@ from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .flight import flight_recorder
+from .memory import memory_ledger
 from .registry import get_registry
 from .timeline import span_collector
 
@@ -83,6 +89,9 @@ class DiagServer:
         # request-timeline summary (slowest-requests table) rides along
         # whenever the span collector is armed; /tracez serves the trees
         self.add_statusz("timelines", span_collector.snapshot_status)
+        # HBM ledger summary (class bytes + planner verdicts); the full
+        # per-request document is /memz
+        self.add_statusz("memory", memory_ledger.statusz)
 
     # -- wiring -------------------------------------------------------------
 
@@ -212,6 +221,10 @@ class DiagServer:
                             self._send(200, json.dumps(
                                 server._signals.varz(), default=str,
                                 indent=1).encode())
+                    elif route == "/memz":
+                        self._send(200, json.dumps(
+                            memory_ledger.snapshot(), default=str,
+                            indent=1).encode())
                     elif route == "/debugz":
                         q = parse_qs(url.query)
                         if q.get("dump", ["0"])[0] == "1":
@@ -226,7 +239,7 @@ class DiagServer:
                         self._send(200, json.dumps({
                             "endpoints": ["/metrics", "/healthz",
                                           "/statusz", "/debugz",
-                                          "/tracez", "/varz"],
+                                          "/tracez", "/varz", "/memz"],
                         }).encode())
                     else:
                         self._send(404, b'{"error":"not found"}')

@@ -39,6 +39,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .anomaly import AnomalyMonitor
+from .memory import memory_ledger, pool_occupancy
 from .registry import get_registry
 from .timeline import span_collector, timeline_armed
 from .timeseries import (HISTORY_SCHEMA_VERSION, MetricHistory,
@@ -92,11 +93,12 @@ def _queue_wait_share(metrics) -> float:
 
 
 def _pool_pressure(engine) -> float:
-    """Paged-pool pressure in [0, 1] straight off the engine's pool (the
-    same split the ``paddle_kvcache_pages`` gauge publishes)."""
-    mgr = engine.mgr
-    usable = mgr.usable_pages
-    return 1.0 - mgr.num_free_pages / usable if usable else 0.0
+    """Paged-pool pressure in [0, 1] off the engine's pool, via the
+    memory ledger's ONE occupancy derivation
+    (:func:`~.memory.pool_occupancy` — the scheduler's utilization
+    gauges read the same split, so the autoscaler signal and /metrics
+    can never disagree about what "full" means)."""
+    return pool_occupancy(engine.mgr)["pressure"]
 
 
 def _spec_acceptance(engine) -> float:
@@ -197,6 +199,9 @@ class SignalBus:
         self.history.track_counter(
             f"{p}tokens_total",
             lambda: float(m.counters.get("tokens_generated_total", 0)))
+        # the memory ledger's per-class byte levels ride the same rings
+        # (mem.<class>_bytes series — "where did the bytes go, lately")
+        memory_ledger.attach_history(self.history)
         return self
 
     def attach_router(self, router) -> "SignalBus":

@@ -197,6 +197,7 @@ class SpanCollector:
         self._completed_fifo: deque = deque()      # eviction order hints
         self._slowest: List[Dict[str, Any]] = []   # desc by e2e_ms
         self._slowest_raw: List[tuple] = []        # unranked (e2e, tid)
+        self._raw_tids: set = set()                # O(1) membership twin
         self.dropped_spans = 0
         self.completed = 0
 
@@ -228,6 +229,7 @@ class SpanCollector:
             self._completed_fifo.clear()
             self._slowest = []
             self._slowest_raw = []
+            self._raw_tids = set()
             self.dropped_spans = 0
             self.completed = 0
 
@@ -288,6 +290,7 @@ class SpanCollector:
                 self._completed_fifo.append(tid)
             self._slowest_raw.append(
                 ((span.end_ns - span.start_ns) / 1e6, tid))
+            self._raw_tids.add(tid)
             if len(self._slowest_raw) >= 256:   # amortised bound
                 self._prune_slowest_locked()
 
@@ -298,6 +301,7 @@ class SpanCollector:
         if not self._slowest_raw:
             return
         raw, self._slowest_raw = self._slowest_raw, []
+        self._raw_tids = set()
         by_tid = {e["trace_id"]: e for e in self._slowest}
         for e2e_ms, tid in raw:
             cur = by_tid.get(tid)
@@ -320,7 +324,13 @@ class SpanCollector:
                     break
             if victim is None:
                 victim = next(iter(self._traces))    # else plain oldest
-            self._prune_slowest_locked()
+            if victim in self._raw_tids:
+                # the victim has an unranked completion: fold the raw
+                # feed so its exemplar can rank before the spans go.
+                # Skipping the prune-sort for the common churn victim
+                # (neither raw nor ranked) is real armed-loop savings —
+                # steady serving evicts one trace per admission.
+                self._prune_slowest_locked()
             for e in self._slowest:
                 # about to lose the victim's raw spans: materialise its
                 # slowest-table entry first so the exemplar survives

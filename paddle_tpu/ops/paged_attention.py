@@ -385,6 +385,7 @@ class PagedKVCacheManager:
         self._free: List[int] = list(range(num_pages - 1, 0, -1))  # 0 reserved
         self._tables: dict = {}   # seq_id -> List[int]
         self._lens: dict = {}     # seq_id -> int
+        self._page_nb: int = 0    # page_nbytes memo (geometry is fixed)
 
     # -- allocation ---------------------------------------------------------
 
@@ -409,10 +410,35 @@ class PagedKVCacheManager:
         """Allocatable pool capacity (page 0 is the reserved pad page)."""
         return self.num_pages - 1
 
+    @property
+    def page_nbytes(self) -> int:
+        """Measured device bytes of ONE page (K + V slabs across every
+        layer) — the memory ledger's byte unit; an int8 pool halves it
+        automatically because it is read off the actual arrays.
+        Memoized: the pool's geometry and dtype never change after
+        construction."""
+        pb = self._page_nb
+        if not pb:
+            pb = self._page_nb = (
+                int(self.k_pages.nbytes)
+                + int(self.v_pages.nbytes)) // self.num_pages
+        return pb
+
+    def _oom(self, source: str, need: int) -> None:
+        """Allocation-failure forensics hook: every ``MemoryError`` this
+        pool raises first lands in the HBM ledger (``oom_pressure``
+        event + once-per-reason ``memory.json`` flight bundle). Gated on
+        ``memory_armed`` inside; lazy import keeps the hot allocator
+        free of the observability package at import time."""
+        from ..observability.memory import note_oom
+        note_oom(source, self, need_pages=need,
+                 free_pages=len(self._free))
+
     def allocate(self, seq_id, n_tokens: int) -> List[int]:
         """Reserve pages for a new sequence of n_tokens (prefill)."""
         need = self.pages_for(n_tokens)
         if len(self._free) < need:
+            self._oom("allocate", need)
             raise MemoryError(
                 f"KV pool exhausted: need {need} pages, "
                 f"{len(self._free)} free")
@@ -429,6 +455,7 @@ class PagedKVCacheManager:
         need = self.pages_for(new_len)
         for _ in range(need - have):
             if not self._free:
+                self._oom("extend", 1)
                 raise MemoryError("KV pool exhausted on extend")
             self._tables[seq_id].append(self._free.pop())
         self._lens[seq_id] = new_len
@@ -452,6 +479,7 @@ class PagedKVCacheManager:
         if need <= 0:
             return []
         if len(self._free) < need:
+            self._oom("grow_to", need)
             raise MemoryError(
                 f"KV pool exhausted on speculative grow: need {need} "
                 f"pages, {len(self._free)} free")

@@ -142,29 +142,40 @@ class RecordEvent:
     ``trace_id`` overrides the ambient trace context.
     """
 
-    __slots__ = ("name", "event_type", "args", "_trace_id", "_start_ns",
-                 "_jax_ann", "_is_request")
+    __slots__ = ("name", "event_type", "args", "_trace_id", "_tid0",
+                 "_start_ns", "_jax_ann", "_is_request", "_light")
 
     def __init__(self, name: str, event_type: str = "UserDefined",
                  args: Optional[dict] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None, light: bool = False):
         self.name = name
         self.event_type = event_type
         self.args = args
         self._trace_id = trace_id
+        self._tid0 = trace_id       # constructor value, restored on end()
+        # so a REUSED event re-resolves the ambient trace context per
+        # begin instead of pinning the first span's id forever
         self._start_ns: Optional[int] = None
         self._jax_ann = None
         # precomputed: the timeline collector only consumes request
         # envelopes (every other categorised span arrives via emit_span)
         self._is_request = name.endswith(".request")
+        # light spans record ONLY inside a profiler capture window: the
+        # per-STEP scheduler span fires hundreds of times a second and
+        # would otherwise pay the full HostSpan+ring cost on every armed
+        # serving step just to wrap the 256-deep flight ring in under a
+        # second (armed-overhead engineering, like the engine's
+        # coalesced per-slot windows — bench_obs_overhead)
+        self._light = light
 
     def begin(self) -> None:
         capture = host_recorder._enabled
         # zero-overhead fast path; the timeline term only arms request
         # envelopes — with just the collector armed, step/mark spans
         # nobody would consume never pay the span bookkeeping
-        if not capture and not flight_armed[0] \
-                and not (timeline_armed[0] and self._is_request):
+        if not capture and (self._light or (
+                not flight_armed[0]
+                and not (timeline_armed[0] and self._is_request))):
             return
         if self._trace_id is None:
             ctx = current_trace()
@@ -187,8 +198,14 @@ class RecordEvent:
                 self._jax_ann.__exit__(None, None, None)
             finally:
                 self._jax_ann = None
-        if host_recorder._enabled or flight_armed[0] \
-                or (timeline_armed[0] and self._is_request):
+        # light spans feed ONLY the capture window — a light span begun
+        # under capture with the flight recorder also armed must still
+        # stay out of the ring (it would wrap the 256-deep postmortem
+        # ring in under a second)
+        light = self._light
+        if host_recorder._enabled or (not light and (
+                flight_armed[0]
+                or (timeline_armed[0] and self._is_request))):
             span = HostSpan(
                 self.name, self.event_type, self._start_ns,
                 time.perf_counter_ns(),
@@ -196,9 +213,9 @@ class RecordEvent:
                 self._trace_id or "", self.args)
             if host_recorder._enabled:
                 host_recorder.emit(span)
-            if flight_armed[0]:
+            if flight_armed[0] and not light:
                 flight_recorder.note_span(span)
-            if timeline_armed[0] and self._is_request:
+            if not light and timeline_armed[0] and self._is_request:
                 # the ONLY RecordEvent the timeline consumes is the
                 # request envelope — step spans and markers carry step
                 # trace ids the collector would discard anyway, and the
@@ -206,6 +223,7 @@ class RecordEvent:
                 # (bench_obs_overhead)
                 span_collector.note_span(span)
         self._start_ns = None
+        self._trace_id = self._tid0
 
     def __enter__(self) -> "RecordEvent":
         self.begin()

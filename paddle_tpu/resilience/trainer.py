@@ -37,6 +37,8 @@ import numpy as np
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
 from ..observability.goodput import GoodputTracker, StragglerDetector
+from ..observability.memory import (memory_armed, memory_ledger,
+                                    pytree_nbytes)
 from ..observability.step_timer import StepTimer
 from ..observability.trace import trace_context
 from .durable import (async_save_checkpoint, checkpoint_path, latest_step,
@@ -147,6 +149,12 @@ class ResilientTrainer:
             self._harvest(block=True)  # serialize after the last save
             step = self.state.global_step
             sd = self.state.state_dict()
+            if memory_armed[0]:
+                # HBM ledger: the training side's resident state (params
+                # + optimizer accumulators), dtype-aware, refreshed on
+                # the save cadence — the "optimizer" class next to the
+                # serving pool's kv_* classes
+                memory_ledger.note_class("optimizer", pytree_nbytes(sd))
             if self.cfg.async_save and not block:
                 self._pending = async_save_checkpoint(
                     sd, self.cfg.checkpoint_dir, step, keep=self.cfg.keep,

@@ -134,14 +134,17 @@ class ServingMetrics:
 
     def span(self, name: str, event_type: str = "UserDefined",
              args: Dict[str, object] = None,
-             trace_id: str = None) -> RecordEvent:
+             trace_id: str = None, light: bool = False) -> RecordEvent:
         """A profiler span (``with metrics.span('serving.step'): ...``);
         shows up in the host recorder / xplane trace under
         ``<namespace>.<name>``. ``args``/``trace_id`` flow into the
         chrome-trace event (trace_id=None picks up the ambient trace
-        context)."""
+        context). ``light=True`` records only inside a profiler capture
+        window (see :class:`~paddle_tpu.profiler.record.RecordEvent`) —
+        for per-step spans whose flight-ring copies would be pure
+        armed-loop cost."""
         return RecordEvent(f"{self.namespace}.{name}", event_type,
-                           args=args, trace_id=trace_id)
+                           args=args, trace_id=trace_id, light=light)
 
     def mark(self, name: str) -> None:
         """Zero-length trace event (shed/cancel/retry markers)."""

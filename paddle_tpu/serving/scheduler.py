@@ -66,6 +66,8 @@ import numpy as np
 
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
+from ..observability.memory import (memory_armed, memory_ledger,
+                                    pool_occupancy)
 from ..observability.step_timer import StepTimer
 from ..observability.timeline import span_collector, timeline_armed
 from ..observability.timeseries import history_armed
@@ -175,6 +177,11 @@ class ServingScheduler:
         self._by_engine_rid: Dict[int, ServingRequest] = {}
         self._watchdog: Optional[tuple] = None   # (thread, result box)
         self.step_timer = StepTimer()            # host/device + tokens/s
+        # ONE reusable light step-span object: it wraps every scheduler
+        # round, so re-building the RecordEvent (+ its namespace
+        # f-string) per step would be standing armed-loop cost
+        # (RecordEvent begin/end resets make sequential reuse safe)
+        self._step_span = self.metrics.span("step", light=True)
         self.degraded = False
         self.slo_monitor = None                  # see attach_slo_monitor
         self.signal_bus = None                   # see attach_signal_bus
@@ -552,7 +559,12 @@ class ServingScheduler:
         # the request trace ids minted at submit)
         with trace_context(step=int(self.metrics.counters.get(
                 "steps_total", 0))):
-            with self.metrics.span("step"):
+            # light + reused: the step span fires per scheduler round —
+            # it records under a profiler capture window but skips the
+            # flight ring (it would wrap the whole ring in <1s and its
+            # HostSpan cost is THE per-step armed overhead; step timing
+            # already lives in step_ms / StepTimer)
+            with self._step_span:
                 # expire BEFORE promoting: a deferred request whose
                 # deadline lapsed while parked must shed as "deadline",
                 # not first enter the queue (its no_shed exemption would
@@ -624,24 +636,31 @@ class ServingScheduler:
         single dispatch as everyone's decode) instead of waiting for a
         bucketed prefill wave, so admission latency is one step, not one
         wave boundary."""
+        if not self._queue:
+            return              # steady decode: nothing to admit, and
+        # the span/byte prelude below is armed-loop cost per step
         now = self._clock()
         armed = spans_armed()
+        mgr = self.engine.mgr
         headroom = self.engine.num_free_slots - self.engine.num_queued
-        free_pages = self.engine.mgr.num_free_pages
+        free_pages = mgr.num_free_pages
+        page_b = mgr.page_nbytes if armed else 0   # span-args byte unit
         cache = getattr(self.engine, "cache", None)
         protect: List[int] = []     # pages THIS step's admissions rely on
         while headroom > 0 and self._queue:
             req = self._queue[0]
             adm0_ns = time.perf_counter_ns() if armed else 0
-            need = self.engine.mgr.pages_for(
+            need = mgr.pages_for(
                 len(req.prompt) + self._engine_budget(req.max_new_tokens))
+            n_shared = 0
             reusing: List[int] = []
             if cache is not None:
                 # charge only the UNCACHED SUFFIX: pages the prefix cache
                 # will lend come for free (peek: no LRU/stat distortion);
                 # the COW source isn't charged for but must survive too
                 shareable, _cached_tokens, cow_src = cache.peek(req.prompt)
-                need -= len(shareable)
+                n_shared = len(shareable)
+                need -= n_shared
                 reusing = shareable + ([cow_src] if cow_src is not None
                                        else [])
                 if need > free_pages:
@@ -652,6 +671,13 @@ class ServingScheduler:
                     free_pages += cache.evict(need - free_pages,
                                               protect=protect + reusing)
             if need > free_pages:
+                # deferred for pages: record the shortfall instead of
+                # silently waiting — the rejects counter is ROADMAP item
+                # 4's honest pressure signal, the oom_pressure event
+                # carries the bytes short (deduped per blocked request)
+                memory_ledger.note_admission_reject(
+                    mgr, request_id=req.rid, need_pages=need,
+                    free_pages=free_pages, trace_id=req.trace_id)
                 break               # wait for a completion to free pages
             protect.extend(reusing)
             self._queue.pop(0)
@@ -665,8 +691,16 @@ class ServingScheduler:
                 # two non-overlapping timeline segments, one batch:
                 # queued until this admission pass picked the request
                 # up, then the admission work itself (cache peek/evict,
-                # allocation, engine handover)
+                # allocation, engine handover). The admission span and
+                # the request envelope both carry the HBM attribution
+                # (total pages held, cached-vs-fresh bytes) so /tracez
+                # shows a request's memory cost next to its latency.
                 ns = self.metrics.namespace
+                if req._span is not None and req._span.args is not None:
+                    req._span.args.update(
+                        kv_pages=need + n_shared,
+                        cached_bytes=n_shared * page_b,
+                        fresh_bytes=need * page_b)
                 emit_spans([
                     make_span(f"{ns}.queue_wait", req._submit_ns,
                               adm0_ns, trace_id=req.trace_id,
@@ -674,7 +708,10 @@ class ServingScheduler:
                     make_span(f"{ns}.admission", adm0_ns,
                               time.perf_counter_ns(),
                               trace_id=req.trace_id,
-                              args={"request_id": req.rid}),
+                              args={"request_id": req.rid,
+                                    "kv_pages": need + n_shared,
+                                    "cached_bytes": n_shared * page_b,
+                                    "fresh_bytes": need * page_b}),
                 ])
             self.metrics.observe("queue_wait_ms",
                                  (now - req.submit_t) * 1e3,
@@ -825,18 +862,19 @@ class ServingScheduler:
         slots = self.engine.num_slots
         m.set_gauge("slot_utilization",
                     (slots - self.engine.num_free_slots) / slots)
-        mgr = self.engine.mgr
-        usable = mgr.usable_pages
-        m.set_gauge("page_utilization",
-                    1.0 - mgr.num_free_pages / usable if usable else 0.0)
+        # ONE occupancy derivation (observability.memory.pool_occupancy):
+        # these gauges, the signal bus's pool-pressure reader and the
+        # ledger's byte split all read the same math, so /metrics and
+        # the autoscaler can never disagree about what "full" means
+        occ = pool_occupancy(self.engine.mgr)
+        m.set_gauge("page_utilization", occ["pressure"])
         cache = getattr(self.engine, "cache", None)
         if cache is not None:
             # cached-vs-live split: how much of the occupied pool is
             # reusable cache vs pinned by in-flight sequences
-            m.set_gauge("live_page_utilization",
-                        mgr.num_live_pages / usable if usable else 0.0)
+            m.set_gauge("live_page_utilization", occ["live_utilization"])
             m.set_gauge("cached_page_utilization",
-                        mgr.num_cached_pages / usable if usable else 0.0)
+                        occ["cached_utilization"])
             cache.update_gauges()
 
     def statusz(self) -> Dict[str, Any]:
@@ -891,4 +929,8 @@ class ServingScheduler:
             # smoothed signal values + windowed trends (the full series
             # and anomaly document lives on /varz)
             out["signals"] = self.signal_bus.values()
+        if memory_armed[0]:
+            # HBM ledger summary (class bytes + planner verdicts); the
+            # per-request page table lives on /memz
+            out["memory"] = memory_ledger.statusz()
         return out

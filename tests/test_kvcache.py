@@ -190,13 +190,28 @@ def test_pool_invariants_random_interleavings():
     refcount cannot hide). The ``spec`` op is the speculative row's
     lifecycle at pool level: grow the table for a drafted span past the
     committed length, then commit a random prefix and truncate the rest
-    — exactly what the engine's verify/rollback does per row."""
+    — exactly what the engine's verify/rollback does per row.
+
+    The HBM ledger rides along: after EVERY op (mid-draft grow/truncate
+    included) its byte conservation audit — free + live + spec + cached
+    bytes == pool bytes — must balance too, with speculative tails
+    (pages past each sequence's committed length) split into their own
+    class."""
+    from paddle_tpu.observability.memory import MemoryLedger
     for seed in (0, 1, 2):
         rng = np.random.RandomState(seed)
         mgr = _mgr(num_pages=16, page_size=2)
         cache = PrefixCache(mgr)
+        led = MemoryLedger()
         live = {}
         next_sid = 0
+
+        def audit_bytes():
+            # committed reservation per live sequence: pages covering
+            # its committed length — anything beyond is a drafted tail
+            led.observe(mgr, reserved={
+                sid: mgr.pages_for(mgr.seq_len(sid)) for sid in live})
+
         for _ in range(300):
             op = rng.choice(["submit", "extend", "retire", "cancel",
                              "evict", "spec"],
@@ -259,8 +274,14 @@ def test_pool_invariants_random_interleavings():
                         mgr.grow_to(sid, cur + span)
                     except MemoryError:
                         mgr.check_conservation()
+                        audit_bytes()
                         continue                 # engine clamps instead
                 mgr.check_conservation()         # mid-draft books balance
+                audit_bytes()                    # ... in bytes too (the
+                # drafted tail shows up as kv_spec until the verify)
+                tail = mgr.pages_for(cur + span) - mgr.pages_for(cur)
+                assert led.class_bytes("kv_spec") == \
+                    tail * mgr.page_nbytes
                 accepted = int(rng.randint(0, span + 1))
                 committed = cur + accepted
                 # verify: commit the accepted prefix, roll the rest back
@@ -269,12 +290,20 @@ def test_pool_invariants_random_interleavings():
                 live[sid]["gen"].extend(
                     int(t) for t in rng.randint(0, 3, accepted))
             mgr.check_conservation()
+            audit_bytes()
         for sid in list(live):
             mgr.free(sid)
+        live.clear()
         mgr.check_conservation()
+        audit_bytes()
+        assert led.class_bytes("kv_live") == 0
+        assert led.class_bytes("kv_spec") == 0
         # everything unreferenced: full eviction must drain to all-free
         cache.evict(mgr.usable_pages)
         assert mgr.num_free_pages == mgr.usable_pages
+        led.observe(mgr)
+        assert led.class_bytes("kv_free") == \
+            mgr.usable_pages * mgr.page_nbytes
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,512 @@
+"""HBM memory ledger (paddle_tpu.observability.memory): byte-level
+accounting by class, the capacity planner validated against real pools,
+per-request attribution, byte conservation across storm / speculative /
+chaos serving, and OOM forensics (oom_pressure events + memory.json
+flight bundles + /memz)."""
+
+import json
+import tarfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.kvcache import RefcountedKVCacheManager
+from paddle_tpu.observability import get_registry
+from paddle_tpu.observability.events import configure_event_log
+from paddle_tpu.observability.flight import flight_recorder
+from paddle_tpu.observability.memory import (MemoryLedger, memory_armed,
+                                             memory_ledger, page_nbytes,
+                                             plan_capacity, plan_verdict,
+                                             pool_occupancy,
+                                             pytree_nbytes)
+from paddle_tpu.ops.paged_attention import PagedKVCacheManager
+
+
+@pytest.fixture()
+def mem():
+    """Armed, clean process-global ledger; disarmed + reset afterwards
+    (and the flight recorder left disarmed) so no other test inherits
+    memory-plane state."""
+    memory_ledger.reset()
+    memory_ledger.arm()
+    yield memory_ledger
+    memory_ledger.disarm()
+    memory_ledger.reset()
+    flight_recorder.disarm()
+    flight_recorder.clear()
+
+
+def _mgr(num_pages=12, page_size=4, layers=1, heads=1, dim=2):
+    return RefcountedKVCacheManager(layers, num_pages, page_size, heads,
+                                    dim)
+
+
+# ---------------------------------------------------------------------------
+# planner + pure helpers
+# ---------------------------------------------------------------------------
+
+def test_page_nbytes_is_geometry_derived_and_dtype_aware():
+    # 2 (K+V) x layers x page x heads x dim x itemsize
+    assert page_nbytes(2, 4, 1, 2, 4) == 2 * 2 * 4 * 1 * 2 * 4
+    # int8 pages halve the bf16 cost with no other change
+    assert page_nbytes(2, 4, 1, 2, 1) * 2 == page_nbytes(2, 4, 1, 2, 2)
+
+
+def test_planner_prediction_matches_real_pool_on_two_geometries():
+    """Acceptance bar: max-page prediction matches the live pool's
+    capacity EXACTLY, on two different geometries (dtype, heads, page
+    size all varied)."""
+    import jax.numpy as jnp
+    for mgr in (
+        PagedKVCacheManager(2, 10, 4, 1, 2),                  # bf16
+        RefcountedKVCacheManager(3, 33, 8, 2, 4,
+                                 dtype=jnp.float32),          # fp32
+    ):
+        shape = mgr.k_pages.shape
+        plan = plan_capacity(
+            num_layers=shape[0], num_kv_heads=shape[3],
+            head_dim=shape[4], page_size=shape[2],
+            dtype_bytes=mgr.k_pages.dtype.itemsize,
+            hbm_bytes=int(mgr.k_pages.nbytes) + int(mgr.v_pages.nbytes))
+        v = plan_verdict(plan, mgr)
+        assert v["exact"], v
+        assert plan.page_bytes == mgr.page_nbytes
+        assert plan.max_pages == mgr.usable_pages
+
+
+def test_planner_slots_and_context_math():
+    plan = plan_capacity(num_layers=2, num_kv_heads=1, head_dim=2,
+                         page_size=4, dtype_bytes=2, hbm_bytes=100_000,
+                         weight_bytes=36_000, max_seq_len=32)
+    assert plan.kv_budget_bytes == 64_000
+    assert plan.page_bytes == 2 * 2 * 4 * 1 * 2 * 2      # 64
+    assert plan.total_pages == 1000 and plan.max_pages == 999
+    assert plan.pages_per_seq == 8 and plan.max_slots == 124
+    assert plan.max_context_tokens == 999 * 4
+
+
+def test_pytree_nbytes_matches_llama_analytic_param_bytes():
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=0)
+    assert pytree_nbytes(params) == L.param_nbytes(cfg)
+    assert L.param_count(cfg) > 0
+
+
+def test_pool_occupancy_is_the_shared_derivation():
+    mgr = _mgr(num_pages=8, page_size=4)
+    t = mgr.allocate("a", 8)
+    for p in t:
+        mgr.adopt_cached(p)
+    mgr.free("a")                      # 2 cached, 5 free, 0 live
+    occ = pool_occupancy(mgr)
+    assert occ == {"usable": 7, "free": 5, "live": 0, "cached": 2,
+                   "pressure": pytest.approx(2 / 7),
+                   "live_utilization": 0.0,
+                   "cached_utilization": pytest.approx(2 / 7)}
+    # exclusive pools report owned pages as live
+    base = PagedKVCacheManager(1, 8, 4, 1, 2)
+    base.allocate("a", 8)
+    occ = pool_occupancy(base)
+    assert occ["live"] == 2 and occ["cached"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ledger accounting + byte conservation
+# ---------------------------------------------------------------------------
+
+def test_observe_splits_bytes_and_tracks_peaks(mem):
+    mgr = _mgr(num_pages=12, page_size=4)
+    pb = mgr.page_nbytes
+    t = mgr.allocate("a", 8)
+    split = mem.observe(mgr)
+    assert split == {"kv_free": 9, "kv_live": 2, "kv_spec": 0,
+                     "kv_cached": 0}
+    assert mem.class_bytes("kv_live") == 2 * pb
+    for p in t:
+        mgr.adopt_cached(p)
+    mgr.free("a")
+    mem.observe(mgr)
+    assert mem.class_bytes("kv_live") == 0
+    assert mem.class_bytes("kv_cached") == 2 * pb
+    assert mem.peak_bytes("kv_live") == 2 * pb     # watermark survives
+    snap = mem.snapshot()
+    assert snap["pools"][0]["planner"]["exact"]
+    assert snap["audits"] >= 2
+
+
+def test_speculative_tail_pages_are_their_own_class(mem):
+    mgr = _mgr(num_pages=12, page_size=4)
+    mgr.allocate("s", 4)                       # 1 reserved page
+    mgr.grow_to("s", 11)                       # +2 speculative tail pages
+    split = mem.observe(mgr, reserved={"s": 1})
+    assert split["kv_spec"] == 2 and split["kv_live"] == 1
+    mgr.truncate_pages("s", 1)                 # rejection rollback
+    split = mem.observe(mgr, reserved={"s": 1})
+    assert split["kv_spec"] == 0 and split["kv_free"] == 10
+
+
+def test_byte_conservation_audit_detects_corruption(mem):
+    mgr = _mgr(num_pages=8, page_size=4)
+    mgr.allocate("a", 8)
+    mem.observe(mgr)
+    # a page on the free list that the radix also "caches" double-counts
+    mgr._cached.add(mgr.num_pages - 3)
+    with pytest.raises(RuntimeError, match="byte conservation"):
+        mem.observe(mgr)
+
+
+def test_weights_cached_by_identity_and_summed_across_models(mem):
+    a = {"w": np.zeros((4, 4), np.float32)}
+    b = {"w": np.zeros((2, 2), np.float32)}
+    assert mem.note_weights(a) == 64
+    mem.note_weights(a)                        # same object: no double
+    assert mem.class_bytes("weights") == 64
+    mem.note_weights(b)
+    assert mem.class_bytes("weights") == 64 + 16
+
+
+def test_mem_gauges_in_registry_exposition(mem):
+    mgr = _mgr()
+    mgr.allocate("a", 4)
+    mem.observe(mgr)
+    text = get_registry().prometheus_text()
+    assert 'paddle_mem_bytes{class="kv_live"}' in text
+    assert 'paddle_mem_peak_bytes{class="kv_free"}' in text
+
+
+def test_disarmed_gate_leaves_ledger_untouched(mem):
+    mem.disarm()
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=0)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=4), num_slots=2,
+        page_size=4, max_seq_len=32, chunk=2)
+    eng.serve(params, [np.arange(1, 6, dtype=np.int32)])
+    assert not memory_armed[0]
+    assert mem.audits == 0 and mem.snapshot()["pools"] == []
+
+
+# ---------------------------------------------------------------------------
+# engine integration: conservation across storm / COW / spec / chaos
+# ---------------------------------------------------------------------------
+
+def _engine(prefix_cache=False, speculative=False, num_slots=2,
+            num_pages=None, max_new=6, seed=3):
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=seed)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new, seed=seed),
+        num_slots=num_slots, page_size=4, max_seq_len=32, chunk=3,
+        num_pages=num_pages, prefix_cache=prefix_cache,
+        speculative=speculative)
+    return cfg, params, eng
+
+
+def test_storm_byte_conservation_cache_on_with_cow(mem):
+    """Unified storm, prefix cache on, trickle admissions, COW wave: the
+    ledger audits EVERY step (alongside check_conservation) and the
+    warm wave's per-request attribution shows cached bytes."""
+    cfg, params, eng = _engine(prefix_cache=True, num_slots=2)
+    rng = np.random.RandomState(0)
+    sysp = rng.randint(1, cfg.vocab_size, (12,)).astype(np.int32)
+    prompts = [np.concatenate([sysp, rng.randint(
+        1, cfg.vocab_size, (int(rng.randint(2, 8)),)).astype(np.int32)])
+        for _ in range(4)]
+    prompts.append(prompts[0][:16])            # exactly 4 pages: COW
+    for wave in range(2):
+        for i, p in enumerate(prompts):
+            eng.submit(p)
+            eng.step(params)                   # mid-decode admissions
+        while eng._live or eng._queue:
+            eng.step(params)
+        eng.collect()
+    assert mem.audits > 10
+    assert eng.cache.stats["hits"] > 0 and eng.cache.stats["cow_copies"] > 0
+    snap = mem.snapshot()
+    pool = snap["pools"][0]
+    assert pool["planner"]["exact"]
+    assert pool["cache"]["hits"] == eng.cache.stats["hits"]
+    assert snap["classes"]["weights"] == pytree_nbytes(params)
+    assert snap["peaks"]["kv_live"] > 0
+
+
+def test_storm_warm_requests_attribute_cached_bytes(mem):
+    cfg, params, eng = _engine(prefix_cache=True, num_slots=1,
+                               max_new=4)
+    rng = np.random.RandomState(1)
+    sysp = rng.randint(1, cfg.vocab_size, (12,)).astype(np.int32)
+    p = np.concatenate([sysp, rng.randint(1, cfg.vocab_size, (6,)
+                                          ).astype(np.int32)])
+    eng.serve(params, [p])                     # cold: populates cache
+    q = np.concatenate([sysp, rng.randint(1, cfg.vocab_size, (5,)
+                                          ).astype(np.int32)])
+    eng.submit(q)
+    eng.step(params)                           # warm admission
+    reqs = mem.snapshot()["pools"][0]["requests"]
+    warm = [r for r in reqs.values() if r["cached_bytes"] > 0]
+    assert warm and warm[0]["fresh_bytes"] > 0
+    assert warm[0]["bytes"] == warm[0]["cached_bytes"] \
+        + warm[0]["fresh_bytes"]
+    while eng._live or eng._queue:
+        eng.step(params)
+    assert mem.snapshot()["pools"][0]["requests"] == {}   # pruned
+
+
+def test_speculative_storm_byte_conservation(mem):
+    """Spec engine (draft grow + rollback) audits every round cache-off:
+    the byte books balance through grow_to/truncate_pages cycles."""
+    cfg, params, eng = _engine(speculative=True, num_slots=2, max_new=8)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, cfg.vocab_size, (int(rng.randint(4, 10)),)
+                           ).astype(np.int32) for _ in range(4)]
+    for p in prompts:
+        eng.submit(p)
+        eng.step(params)
+    while eng._live or eng._queue:
+        eng.step(params)
+    assert mem.audits > 5
+    assert eng.spec.snapshot()["drafted"] > 0
+    split = mem.snapshot()["pools"][0]["pages"]
+    assert split["kv_free"] == eng.mgr.usable_pages   # all retired
+    assert split["kv_spec"] == 0
+
+
+def test_router_chaos_byte_conservation(mem):
+    """2-replica fleet with a mid-storm replica kill: every request
+    completes and the surviving replicas' books balance throughout."""
+    from paddle_tpu.inference.decoding import (ContinuousBatchingEngine,
+                                               GenerationConfig)
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.resilience import Fault, FaultInjector
+    from paddle_tpu.serving import SchedulerConfig
+    from paddle_tpu.serving.health import HealthConfig
+    from paddle_tpu.serving.replica import ReplicaHandle
+    from paddle_tpu.serving.router import FleetRouter, RouterConfig
+
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_stacked_params(cfg, seed=3)
+    replicas = [
+        ReplicaHandle(
+            i,
+            ContinuousBatchingEngine(
+                cfg, GenerationConfig(max_new_tokens=6, seed=3),
+                num_slots=2, page_size=4, max_seq_len=32, chunk=2,
+                prefix_cache=True),
+            config=SchedulerConfig(max_step_retries=1,
+                                   retry_backoff_s=0.001),
+            health_config=HealthConfig())
+        for i in range(2)]
+    router = FleetRouter(
+        replicas, config=RouterConfig(failover_backoff_s=0.001),
+        fault_injector=FaultInjector(
+            schedule=[Fault("replica_die", 3, replica=0)]))
+    rng = np.random.RandomState(0)
+    handles = [router.submit(rng.randint(1, cfg.vocab_size, (5,)
+                                         ).astype(np.int32))
+               for _ in range(6)]
+    steps = 0
+    while router.pending:
+        router.step(params)
+        steps += 1
+        assert steps < 10_000
+    assert all(h.done for h in handles)
+    assert mem.audits > 0
+    # two engines -> two pools in the books, each planner-exact
+    pools = mem.snapshot()["pools"]
+    assert len(pools) == 2
+    assert all(p["planner"]["exact"] for p in pools)
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: events, bundles, /memz
+# ---------------------------------------------------------------------------
+
+def test_oom_emits_event_and_memory_json_bundle(tmp_path, mem):
+    """Acceptance bar: a forced pool exhaustion produces a flight bundle
+    whose memory.json names the exhausting class, the per-request page
+    holders and the planner verdict — and /memz serves the same
+    snapshot."""
+    flight_recorder.clear()
+    flight_recorder.arm(dump_dir=str(tmp_path / "dumps"))
+    configure_event_log(str(tmp_path / "events.jsonl"))
+    try:
+        cfg, params, eng = _engine(prefix_cache=True, num_slots=2,
+                                   num_pages=9, max_new=4)
+        rng = np.random.RandomState(4)
+        eng.submit(rng.randint(1, cfg.vocab_size, (8,)).astype(np.int32))
+        eng.step(params)                       # one live holder
+        with pytest.raises(MemoryError):
+            eng.mgr.allocate("hog", 31)        # 8 pages > 5 free
+    finally:
+        configure_event_log(None)
+    kinds = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+    oom = [e for e in kinds if e["kind"] == "oom_pressure"]
+    assert oom and oom[0]["source"] == "allocate"
+    assert oom[0]["bytes_short"] == \
+        (oom[0]["need_pages"] - oom[0]["free_pages"]) * eng.mgr.page_nbytes
+    bundles = list((tmp_path / "dumps").glob("*oom_allocate*.tar.gz"))
+    assert len(bundles) == 1
+    with tarfile.open(bundles[0]) as tar:
+        assert "memory.json" in tar.getnames()
+        doc = json.load(tar.extractfile("memory.json"))
+    assert doc["last_oom"]["exhausting_class"] in (
+        "kv_live", "kv_spec", "kv_cached")
+    assert doc["last_oom"]["pages_short"] == 3
+    pool = doc["pools"][0]
+    assert pool["planner"]["exact"]
+    assert pool["requests"], "per-request page holders missing"
+    holder = next(iter(pool["requests"].values()))
+    assert holder["pages"] > 0 and holder["bytes"] > 0
+    # /memz serves the same document
+    from paddle_tpu.observability import DiagServer
+    srv = DiagServer()
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/memz", timeout=5) as r:
+            served = json.load(r)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=5) as r:
+            statusz = json.load(r)
+    finally:
+        srv.stop()
+    assert served == json.loads(
+        json.dumps(memory_ledger.snapshot(), default=str))
+    assert statusz["memory"]["last_oom"]["source"] == "allocate"
+    # second exhaustion with the same reason: no second bundle (rate cap)
+    with pytest.raises(MemoryError):
+        eng.mgr.allocate("hog2", 31)
+    assert len(list((tmp_path / "dumps").glob("*oom_allocate*"))) == 1
+
+
+def test_admission_reject_records_shortfall(tmp_path, mem):
+    """Satellite: a request deferred for pages counts into
+    paddle_mem_admission_rejects_total and emits ONE oom_pressure event
+    (deduped per blocked request) carrying the byte shortfall."""
+    from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+    configure_event_log(str(tmp_path / "events.jsonl"))
+    try:
+        cfg, params, eng = _engine(num_slots=2, num_pages=4, max_new=4)
+        sched = ServingScheduler(eng, SchedulerConfig(max_queue_depth=8))
+        rng = np.random.RandomState(5)
+        c0 = get_registry().counter(
+            "paddle_mem_admission_rejects_total").value()
+        h = [sched.submit(rng.randint(1, cfg.vocab_size, (8,)
+                                      ).astype(np.int32))
+             for _ in range(2)]               # each needs 3 of 3 pages
+        steps = 0
+        while sched.pending:
+            sched.step(params)
+            steps += 1
+            assert steps < 10_000
+        assert all(x.done for x in h)
+    finally:
+        configure_event_log(None)
+    rejects = get_registry().counter(
+        "paddle_mem_admission_rejects_total").value() - c0
+    assert rejects >= 1                       # one per blocked step
+    events = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+    adm = [e for e in events if e["kind"] == "oom_pressure"
+           and e["source"] == "admission"]
+    assert len(adm) == 1                      # deduped per victim
+    assert adm[0]["bytes_short"] > 0
+    assert adm[0]["request_id"] == h[1].rid
+
+
+def test_request_spans_carry_memory_attribution(mem):
+    """The admission span (and the request envelope) carry kv_pages +
+    cached/fresh bytes, visible in the /tracez span tree."""
+    from paddle_tpu.observability.timeline import span_collector
+    from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+    cfg, params, eng = _engine(num_slots=2)
+    sched = ServingScheduler(eng, SchedulerConfig())
+    span_collector.clear()
+    span_collector.arm()
+    try:
+        h = sched.submit(np.arange(1, 9, dtype=np.int32))
+        while sched.pending:
+            sched.step(params)
+    finally:
+        span_collector.disarm()
+    tree = span_collector.tree(h.trace_id)
+    assert tree, "request tree missing"
+    root = tree[0]
+    pb = eng.mgr.page_nbytes
+    need = eng.mgr.pages_for(8 + eng.config.max_new_tokens)
+    assert root["args"]["kv_pages"] == need
+    assert root["args"]["fresh_bytes"] == need * pb
+    assert root["args"]["cached_bytes"] == 0
+    flat, stack = [], list(tree)
+    while stack:
+        n = stack.pop()
+        flat.append(n)
+        stack.extend(n.get("children", []))
+    adm = [n for n in flat if n["name"].endswith(".admission")]
+    assert adm and adm[0]["args"]["kv_pages"] == need
+    span_collector.clear()
+
+
+def test_memory_series_ride_the_history_rings(mem):
+    """SignalBus.attach_scheduler samples mem.<class>_bytes into the
+    MetricHistory rings alongside the latency/queue series. (A
+    prefix-cache engine feeds the ledger every step; plain engines
+    decimate their feed — see _note_memory.)"""
+    from paddle_tpu.serving import SchedulerConfig, ServingScheduler
+    cfg, params, eng = _engine(num_slots=2, prefix_cache=True)
+    sched = ServingScheduler(eng, SchedulerConfig())
+    fake = [0.0]
+
+    def clock():
+        fake[0] += 1.0
+        return fake[0]
+    sched._clock = clock
+    bus = sched.attach_signal_bus(interval_s=0.5).arm()
+    try:
+        sched.submit(np.arange(1, 9, dtype=np.int32))
+        while sched.pending:
+            sched.step(params)
+    finally:
+        bus.disarm()
+    names = bus.history.names()
+    assert "mem.kv_live_bytes" in names and "mem.weights_bytes" in names
+    pts = bus.history.series("mem.kv_live_bytes")
+    assert pts and max(p[1] for p in pts) > 0
+
+
+def test_dead_pool_ages_out_of_class_totals(mem):
+    """A garbage-collected engine's pool must stop inflating the class
+    totals (and /memz) — liveness is tracked with a weakref and pruned
+    on the next observe/snapshot."""
+    import gc
+    mgr = _mgr(num_pages=12)
+    mgr.allocate("a", 8)
+    mem.observe(mgr)
+    assert mem.class_bytes("kv_live") > 0
+    first_label = mem.snapshot()["pools"][0]["label"]
+    del mgr
+    gc.collect()
+    mgr2 = _mgr(num_pages=6)
+    mem.observe(mgr2)
+    snap = mem.snapshot()
+    assert len(snap["pools"]) == 1
+    assert snap["pools"][0]["label"] != first_label   # labels monotonic
+    assert mem.class_bytes("kv_live") == 0
+    assert mem.class_bytes("kv_free") == \
+        mgr2.usable_pages * mgr2.page_nbytes
+
+
+def test_independent_ledger_instances_do_not_share_books():
+    led = MemoryLedger()
+    mgr = _mgr()
+    mgr.allocate("a", 4)
+    led.observe(mgr)
+    assert led.class_bytes("kv_live") > 0
+    assert memory_ledger.class_bytes("kv_live") == 0

@@ -631,3 +631,32 @@ def test_no_adhoc_prometheus_formatters_outside_observability():
         + "\n".join(f.text() for f in bad)
         + "\nassemble exposition lines via paddle_tpu.observability."
         "format so the registry stays the single valid /metrics surface")
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent reuse (the scheduler's per-step light span)
+# ---------------------------------------------------------------------------
+
+def test_record_event_reuse_resolves_ambient_trace_per_begin():
+    """A reused RecordEvent (the scheduler caches ONE light step span)
+    must re-resolve the ambient trace context on every begin — pinning
+    the first span's id onto every later step would corrupt the
+    chrome-trace step lanes."""
+    from paddle_tpu.observability.trace import trace_context
+    from paddle_tpu.profiler.record import RecordEvent, host_recorder
+    host_recorder.enabled = True
+    host_recorder.clear()
+    try:
+        ev = RecordEvent("unit.reuse", light=True)
+        with trace_context(step=1):
+            with ev:
+                pass
+        with trace_context(step=2):
+            with ev:
+                pass
+    finally:
+        spans = host_recorder.drain()
+        host_recorder.enabled = False
+    assert len(spans) == 2
+    assert spans[0].trace_id and spans[1].trace_id
+    assert spans[0].trace_id != spans[1].trace_id

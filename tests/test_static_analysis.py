@@ -361,6 +361,14 @@ def test_span_contract_meta(tmp_path):
     ("layer-deps", "paddle_tpu/resilience/bad.py",
      "from paddle_tpu.serving.scheduler import ServingScheduler\n",
      "serving"),
+    # the memory ledger's STRICT contract: even a LAZY function-scope
+    # import of the layers that feed it is a violation (fed, never pulls)
+    ("layer-deps", "paddle_tpu/observability/memory.py",
+     "def f():\n"
+     "    from paddle_tpu.inference.decoding import "
+     "ContinuousBatchingEngine\n"
+     "    return ContinuousBatchingEngine\n",
+     "STRICT"),
 ])
 def test_layering_rule_catches_synthetic_violation(tmp_path, rule_id, rel,
                                                    src, needle):
