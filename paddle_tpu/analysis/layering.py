@@ -312,6 +312,12 @@ class LayerDepsRule:
         "paddle_tpu/observability/memory.py": (
             "serving", "inference", "kvcache", "models", "resilience",
             "distributed"),
+        # the fusion pass consumes SYMBOLS (the hot-chain artifact +
+        # ProjectIndex) and injected callables, never the serving stack
+        # it optimizes — region installation is duck-typed, and the
+        # decode-tail builders receive the model step as an argument
+        "paddle_tpu/jit/fusion.py": (
+            "serving", "inference", "kvcache", "models"),
     }
 
     def run(self, project: Project) -> Iterable[Finding]:

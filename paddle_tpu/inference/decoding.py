@@ -25,6 +25,8 @@ import numpy as np
 from ..flags import flag_value
 from ..observability.events import emit_event
 from ..observability.memory import memory_armed, memory_ledger
+from ..observability.profiling import chain_armed as _chain_armed
+from ..observability.profiling import note_chain as _note_chain
 from ..observability.runtime import recompiles
 from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
 
@@ -358,7 +360,7 @@ class ContinuousBatchingEngine:
                  check_invariants: bool = True, unified: bool = True,
                  step_tokens: Optional[int] = None,
                  speculative: bool = False, spec_k: int = 4,
-                 drafter=None):
+                 drafter=None, fused_tail: bool = False):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -417,6 +419,18 @@ class ContinuousBatchingEngine:
                                 max(num_slots, chunk, page_size), num_slots)
         self._unified_step = None
         self._unified_flags = None      # host state baked into the program
+        # profile-guided fusion (jit/fusion.py decode_tail region,
+        # default OFF): the step program is built by the fused builders
+        # — identical compute graph fed from a PACKED two-upload plan,
+        # the spec verify epilogue moves in-program, and steady-state
+        # all-decode rounds plan through a vectorized fast path. Tokens
+        # are byte-identical fused on/off; the admission gate lives in
+        # benchmarks/bench_fusion.py.
+        if fused_tail and not unified:
+            raise ValueError(
+                "fused_tail megakernel-izes the unified ragged step; "
+                "construct with unified=True")
+        self._fused_tail = bool(fused_tail)
         self._pend = [None] * num_slots   # per-slot unfed prompt suffix
         # coalesced per-slot span windows ([kind, t0_ns, t1_ns, units]):
         # armed steps MERGE each slot's prefill/decode activity into one
@@ -979,6 +993,23 @@ class ContinuousBatchingEngine:
 
     # -- unified ragged step (the default serving path) ----------------------
 
+    def enable_fused_tail(self) -> "ContinuousBatchingEngine":
+        """Install the profile-guided decode-tail megaregion (the
+        fusion pass's ``decode_tail`` region). Idempotent. Enabled
+        before the first step it keeps the engine's ONE compile-cache
+        miss; flipping mid-serve drops the compiled program and rebuilds
+        on the next step — a counted miss, same contract as a baked-in
+        flags flip."""
+        if not self._unified:
+            raise ValueError(
+                "fused_tail megakernel-izes the unified ragged step; "
+                "construct with unified=True")
+        if not self._fused_tail:
+            self._fused_tail = True
+            self._unified_step = None
+            self._spec_step = None
+        return self
+
     def _build_unified_step(self):
         """ONE compiled program for every step the engine will ever run:
         ``chunk`` micro-rounds of the ragged model step
@@ -993,6 +1024,24 @@ class ContinuousBatchingEngine:
         mcfg = self.model_config
         cfg = self.config
         n_rows = self.num_slots
+        if self._fused_tail:
+            # the fused decode-tail twin: SAME compute graph (the
+            # builder receives the model step + sampler as injected
+            # callables) fed from the packed plan — byte-identical
+            # emitted tokens, one compile, two plan uploads
+            from ..jit import fusion as _fusion
+
+            def model_step(params, ids, token_row, positions, kv_lens,
+                           last_idx, k_pages, v_pages, bt):
+                return L.ragged_step(params, ids, token_row, positions,
+                                     kv_lens, last_idx, k_pages, v_pages,
+                                     bt, mcfg)
+
+            def sample_fn(logits, key):
+                return _sample(logits, key, cfg)
+
+            return _fusion.build_fused_unified_step(model_step, sample_fn,
+                                                    n_rows)
 
         def run(params, ids, use_carry, token_row, positions, kv_lens,
                 last_idx, sample_mask, tok, k_pages, v_pages, bt, key):
@@ -1093,6 +1142,50 @@ class ContinuousBatchingEngine:
         return (ids, use_carry, token_row, positions, kv_lens, last_idx,
                 sample_mask), emit, emit_counts, fed
 
+    def _plan_step_packed(self):
+        """Fused-tail planning: the same plan arrays as
+        :meth:`_plan_step` packed into TWO int32 uploads
+        (``jit.fusion.pack_plan``), with a vectorized fast path for the
+        steady-state round where every live slot is decoding — the
+        K×slots Python simulation collapses to a handful of numpy
+        broadcasts (byte-equality with the generic planner is asserted
+        in tests/test_fusion.py)."""
+        from ..jit.fusion import pack_plan
+        K, tb, n_rows = self.chunk, self._step_tokens, self.num_slots
+        live = [s for s in range(n_rows)
+                if self._slot_rid[s] is not None]
+        if live and all(self._pend[s] is None for s in live):
+            nl = len(live)
+            lv = np.asarray(live, np.int64)
+            ids = np.zeros((K, tb), np.int32)
+            use_carry = np.zeros((K, tb), bool)
+            use_carry[:, :nl] = True
+            token_row = np.full((K, tb), -1, np.int32)
+            token_row[:, :nl] = lv
+            positions = np.zeros((K, tb), np.int32)
+            base = self._pos[lv].astype(np.int64)
+            k_col = np.arange(K, dtype=np.int64)[:, None]
+            positions[:, :nl] = base[None, :] + k_col
+            kv_lens = np.zeros((K, n_rows), np.int32)
+            kv_lens[:, lv] = base[None, :] + k_col + 1
+            last_idx = np.zeros((K, n_rows), np.int32)
+            last_idx[:, lv] = np.arange(nl, dtype=np.int64)[None, :]
+            sample_mask = np.zeros((K, n_rows), bool)
+            sample_mask[:, lv] = True
+            emit = np.zeros((K, n_rows), bool)
+            emit[:, lv] = True
+            self._pos[lv] = (base + K).astype(np.int32)
+            emit_counts = [0] * n_rows
+            for s in live:
+                emit_counts[s] = K
+            fed = [0] * n_rows
+            plan = (ids, use_carry, token_row, positions, kv_lens,
+                    last_idx, sample_mask)
+        else:
+            plan, emit, emit_counts, fed = self._plan_step()
+        plan_tt, plan_tr = pack_plan(*plan)
+        return plan_tt, plan_tr, emit, emit_counts, fed
+
     def _step_unified(self, params) -> int:
         """One ragged round: host-only admission, ONE dispatch serving
         the mixed prefill+decode batch, unpack. The single device→host
@@ -1125,9 +1218,23 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.unified_step",
                 (self.num_slots, self.chunk, self._step_tokens,
-                 self._table_width) + self._unified_flags)
+                 self._table_width, self._fused_tail)
+                + self._unified_flags)
             self._unified_step = self._build_unified_step()
-        plan, emit, emit_counts, fed = self._plan_step()
+        # armed-only continuous-profiling taps: the plan -> dispatch ->
+        # unpack phases are the fusion pass's decode_tail signature
+        # (jit/fusion.py); disarmed cost is one list index per step
+        armed_chain = _chain_armed[0]
+        tc0 = time.perf_counter_ns() if armed_chain else 0
+        if self._fused_tail:
+            plan_tt, plan_tr, emit, emit_counts, fed = \
+                self._plan_step_packed()
+        else:
+            plan, emit, emit_counts, fed = self._plan_step()
+        if armed_chain:
+            tc1 = time.perf_counter_ns()
+            _note_chain(op_name="cbe.plan_step", dur_ns=tc1 - tc0)
+            tc0 = tc1
         # tokens that actually run through prefill THIS step (cancelled
         # mid-prefill requests never inflate the skip-ratio math)
         self._prefill_tokens += sum(fed)
@@ -1135,16 +1242,31 @@ class ContinuousBatchingEngine:
         if fresh:
             c0 = time.perf_counter()   # dispatch-only window, like legacy
         t0_ns = time.perf_counter_ns() if spans_armed() else 0
-        toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
-            self._unified_step(
-                params, *(jnp.asarray(a) for a in plan), self._tok_dev,
-                self.mgr.k_pages, self.mgr.v_pages, jnp.asarray(self._bt),
-                sub)
+        if self._fused_tail:
+            toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
+                self._unified_step(
+                    params, jnp.asarray(plan_tt), jnp.asarray(plan_tr),
+                    self._tok_dev, self.mgr.k_pages, self.mgr.v_pages,
+                    jnp.asarray(self._bt), sub)
+        else:
+            toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
+                self._unified_step(
+                    params, *(jnp.asarray(a) for a in plan),
+                    self._tok_dev, self.mgr.k_pages, self.mgr.v_pages,
+                    jnp.asarray(self._bt), sub)
         if fresh:
             jax.block_until_ready(toks)
             recompiles.observe_compile("cbe.unified_step",
                                        time.perf_counter() - c0)
         toks = np.asarray(toks)                    # the one fence
+        if armed_chain:
+            tc1 = time.perf_counter_ns()
+            if self._fused_tail:
+                _note_chain(op_name="cbe.fused_unified_step",
+                            dur_ns=tc1 - tc0)
+            else:
+                _note_chain(op_name="cbe.unified_step", dur_ns=tc1 - tc0)
+            tc0 = tc1
         if t0_ns:
             # per-request phase bookkeeping over the dispatch window:
             # the trace keeps its prefill/decode lanes even though both
@@ -1179,8 +1301,17 @@ class ContinuousBatchingEngine:
         for s in range(self.num_slots):
             if self._slot_rid[s] is None:
                 continue
-            self._deliver_tokens(
-                s, (toks[k, s] for k in range(self.chunk) if emit[k, s]))
+            if self._fused_tail and emit_counts[s] == self.chunk:
+                # fused-tail fast unpack: the slot emitted every round,
+                # so its column IS the emission (no K-wide mask filter)
+                self._deliver_tokens(s, toks[:, s])
+            else:
+                self._deliver_tokens(
+                    s, (toks[k, s] for k in range(self.chunk)
+                        if emit[k, s]))
+        if armed_chain:
+            _note_chain(op_name="cbe.decode_tail",
+                        dur_ns=time.perf_counter_ns() - tc0)
         if self.cache is not None:
             if self._check_invariants:
                 # the ownership-model anchor: every page is free, live
@@ -1209,6 +1340,21 @@ class ContinuousBatchingEngine:
         recompile anything."""
         L = self._L
         mcfg = self.model_config
+        if self._fused_tail:
+            # fused decode tail, spec flavour: the same single ragged
+            # dispatch plus the verify epilogue IN-PROGRAM — the
+            # vectorized accepted-prefix count replaces the host's
+            # per-token compare loop (jit/fusion.py)
+            from ..jit import fusion as _fusion
+
+            def model_step(params, ids, token_row, positions, kv_lens,
+                           cand_idx, k_pages, v_pages, bt):
+                return L.ragged_step(params, ids, token_row, positions,
+                                     kv_lens, cand_idx, k_pages, v_pages,
+                                     bt, mcfg)
+
+            return _fusion.build_fused_spec_step(model_step, self.spec_k,
+                                                 self.num_slots)
 
         def run(params, ids, token_row, positions, kv_lens, cand_idx,
                 k_pages, v_pages, bt):
@@ -1238,6 +1384,13 @@ class ContinuousBatchingEngine:
         ids = np.zeros((T,), np.int32)
         token_row = np.full((T,), -1, np.int32)
         positions = np.zeros((T,), np.int32)
+        # per-row padded drafts for the fused in-program verify (only
+        # the fused tail consumes them — the unfused path skips the
+        # allocation and fills entirely)
+        fused = self._fused_tail
+        drafts = (np.zeros((n_rows, max(self.spec_k, 1)), np.int32)
+                  if fused else None)
+        draft_len = np.zeros((n_rows,), np.int32) if fused else None
         kv_lens = np.zeros((n_rows,), np.int32)
         cand_idx = np.zeros((n_rows * k1,), np.int32)
         info: Dict[int, tuple] = {}
@@ -1296,6 +1449,10 @@ class ContinuousBatchingEngine:
                     args={"request_id": rid, "slot": s,
                           "drafted": len(draft)}))
             spans[s] = (pos0, [history[-1]] + draft, draft)
+            if fused:
+                if draft:
+                    drafts[s, :len(draft)] = draft
+                draft_len[s] = len(draft)
         emit_spans(draft_spans)
         budget = T - sum(1 + len(d) for _, _, d in spans.values())
         cursor = 0
@@ -1332,15 +1489,18 @@ class ContinuousBatchingEngine:
                 else:
                     self._pend[s] = self._pend[s][n:]
                 cursor += n
-        return (ids, token_row, positions, kv_lens, cand_idx), info, fed
+        return ((ids, token_row, positions, kv_lens, cand_idx), info, fed,
+                drafts, draft_len)
 
-    def _verify_spec(self, toks, info):
+    def _verify_spec(self, toks, info, accepted=None):
         """Host accept/reject over the dispatch's per-candidate greedy
         tokens: commit the longest drafted prefix that matches the
         model's own argmax chain plus the bonus token, roll the paged KV
         back on rejection, deliver through the shared
         ``_deliver_tokens`` contract (callbacks, budget/EOS retire,
-        reentrant cancel)."""
+        reentrant cancel). With the fused tail the accepted-prefix
+        count arrives precomputed from the program (``accepted``);
+        committed tokens are identical either way."""
         k1 = self.spec_k + 1
         for s in sorted(info):
             rid = self._slot_rid[s]
@@ -1352,9 +1512,12 @@ class ContinuousBatchingEngine:
                 continue
             _, pos0, draft = entry
             g = [int(t) for t in toks[s * k1:s * k1 + len(draft) + 1]]
-            a = 0
-            while a < len(draft) and draft[a] == g[a]:
-                a += 1
+            if accepted is not None:
+                a = int(accepted[s])
+            else:
+                a = 0
+                while a < len(draft) and draft[a] == g[a]:
+                    a += 1
             committed = pos0 + a + 1        # carry + accepted drafts
             self.spec.note_verify(len(draft), a)
             if a < len(draft):
@@ -1408,21 +1571,46 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.spec_step",
                 (self.num_slots, self._spec_tokens, self.spec_k,
-                 self._table_width) + self._spec_flags)
+                 self._table_width, self._fused_tail) + self._spec_flags)
             self._spec_step = self._build_spec_step()
-        plan, info, fed = self._plan_spec()
+        armed_chain = _chain_armed[0]
+        tc0 = time.perf_counter_ns() if armed_chain else 0
+        plan, info, fed, drafts, draft_len = self._plan_spec()
+        if armed_chain:
+            tc1 = time.perf_counter_ns()
+            _note_chain(op_name="cbe.plan_step", dur_ns=tc1 - tc0)
+            tc0 = tc1
         self._prefill_tokens += sum(fed)
         if fresh:
             c0 = time.perf_counter()
         t0_ns = time.perf_counter_ns() if spans_armed() else 0
-        toks, self.mgr.k_pages, self.mgr.v_pages = self._spec_step(
-            params, *(jnp.asarray(a) for a in plan), self.mgr.k_pages,
-            self.mgr.v_pages, jnp.asarray(self._bt))
+        accepted = None
+        if self._fused_tail:
+            toks, accepted, self.mgr.k_pages, self.mgr.v_pages = \
+                self._spec_step(
+                    params, *(jnp.asarray(a) for a in plan),
+                    jnp.asarray(drafts), jnp.asarray(draft_len),
+                    self.mgr.k_pages, self.mgr.v_pages,
+                    jnp.asarray(self._bt))
+        else:
+            toks, self.mgr.k_pages, self.mgr.v_pages = self._spec_step(
+                params, *(jnp.asarray(a) for a in plan), self.mgr.k_pages,
+                self.mgr.v_pages, jnp.asarray(self._bt))
         if fresh:
             jax.block_until_ready(toks)
             recompiles.observe_compile("cbe.spec_step",
                                        time.perf_counter() - c0)
         toks = np.asarray(toks)                    # the one fence
+        if accepted is not None:
+            accepted = np.asarray(accepted)
+        if armed_chain:
+            tc1 = time.perf_counter_ns()
+            if self._fused_tail:
+                _note_chain(op_name="cbe.fused_spec_step",
+                            dur_ns=tc1 - tc0)
+            else:
+                _note_chain(op_name="cbe.spec_step", dur_ns=tc1 - tc0)
+            tc0 = tc1
         if t0_ns:
             t1_ns = time.perf_counter_ns()
             batch = []
@@ -1444,7 +1632,10 @@ class ContinuousBatchingEngine:
                         args={"request_id": rid, "slot": s,
                               "drafted": len(info[s][2])}))
             emit_spans(batch)
-        self._verify_spec(toks, info)
+        self._verify_spec(toks, info, accepted)
+        if armed_chain:
+            _note_chain(op_name="cbe.decode_tail",
+                        dur_ns=time.perf_counter_ns() - tc0)
         if self._check_invariants:
             # the ownership-model anchor, now also covering draft
             # growth and rejection rollback: audited after EVERY

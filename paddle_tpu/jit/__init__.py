@@ -372,3 +372,7 @@ def enable_to_static(flag: bool):
 from .save_load import save, load, TranslatedLayer  # noqa: E402,F401
 from .bucketing import ShapeBucketer, pad_to_bucket, next_bucket  # noqa: E402,F401
 from .dy2static import ConversionError, convert_control_flow  # noqa: E402,F401
+from .fusion import (FusionCandidate, FusionPass, FusionPlan,  # noqa: E402,F401
+                     FusionRegion, FusedOptimizerStep,
+                     install_optimizer_fusion, stage_eager)
+from .fusion import REGIONS as FUSION_REGIONS  # noqa: E402,F401

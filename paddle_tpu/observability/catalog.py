@@ -48,6 +48,10 @@ METRICS = {
     "paddle_mem_bytes": ("gauge", ("class",)),
     "paddle_mem_peak_bytes": ("gauge", ("class",)),
     "paddle_mem_admission_rejects_total": ("counter", ()),
+    # -- profile-guided fusion pass (jit/fusion.py) -------------------------
+    "paddle_fusion_admitted_total": ("counter", ("region",)),
+    "paddle_fusion_skipped_total": ("counter", ("reason",)),
+    "paddle_fusion_active": ("gauge", ("region",)),
     # -- fleet router (serving/router.py) ----------------------------------
     "paddle_router_requests_total": ("counter", ("replica", "outcome")),
     "paddle_router_replica_state": ("gauge", ("replica",)),
@@ -91,6 +95,10 @@ EVENT_KINDS = {
     "cache_hit", "cache_evict",
     # speculative decoding (draft rejection -> per-row paged rollback)
     "spec_rollback",
+    # profile-guided fusion pass (jit/fusion.py): a hot chain installed
+    # as a fused megaregion / skipped with a structured reason (stale
+    # artifact symbol-missing, schema-mismatch, no-region, ...)
+    "fusion_applied", "fusion_skipped",
 }
 
 #: every request-path span the tree may emit (``profiler.record.

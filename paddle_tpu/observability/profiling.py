@@ -32,14 +32,47 @@ from __future__ import annotations
 import ast
 import functools
 import json
+import os
+import platform
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 #: the one cell ``core.dispatch.apply`` checks per armed dispatch
 chain_armed = [False]
 
-#: artifact schema version (bump on breaking changes to the JSON shape)
+#: artifact schema version (bump on breaking changes to the JSON shape;
+#: stamped as ``schema_version`` like the bench one-line JSONs so the
+#: fusion pass can refuse an incompatible artifact instead of
+#: misreading it)
 PROFILE_VERSION = 1
+
+
+def run_metadata() -> Dict[str, str]:
+    """Deterministic run metadata stamped into the artifact — the same
+    fields ``benchmarks/_telemetry.run_header`` stamps into bench JSON
+    lines (no wall clock: two exports over one capture must stay
+    byte-identical)."""
+    return {
+        "python": platform.python_version(),
+        "host_platform": sys.platform,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
+
+
+def note_chain(*, op_name: str, dur_ns: Optional[float] = None) -> None:
+    """Armed-only chain tap for host code that dispatches whole
+    *compiled programs* rather than eager ops — the serving engine's
+    plan/dispatch/unpack phases and the fused megaregions. Call with a
+    literal ``op_name=`` keyword: :func:`dispatch_sites` resolves the
+    literal to the enclosing function exactly like a ``core.dispatch``
+    op, so profiled step phases map to engine symbols in the artifact.
+    One list-index when disarmed."""
+    if not chain_armed[0]:
+        return
+    chain_profiler.note(op_name)
+    if dur_ns is not None:
+        chain_profiler.note_duration(op_name, dur_ns)
 
 
 @functools.lru_cache(maxsize=1)
@@ -204,7 +237,9 @@ class DispatchChainProfiler:
             symbols = {op: sites.get(op) for op in chain_ops}
         return {
             "version": PROFILE_VERSION,
+            "schema_version": PROFILE_VERSION,
             "kind": "paddle_tpu.hot_chains",
+            "meta": run_metadata(),
             "workload": workload,
             "top_n": top_n,
             "transitions": len(self._pairs),

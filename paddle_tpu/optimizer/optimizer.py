@@ -15,6 +15,7 @@ bf16/fp16 params — parity with the reference's master-weight path.
 from __future__ import annotations
 
 import re
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -22,12 +23,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Parameter, Tensor
+from ..observability.profiling import chain_armed as _chain_armed
+from ..observability.profiling import note_chain as _note_chain
 from .lr import LRScheduler
 from .clip import ClipGradBase
 
 
 class Optimizer:
     _state_keys: Tuple[str, ...] = ()
+
+    #: jit.fusion's optimizer_chain megaregion, when installed
+    #: (install_optimizer_fusion); step() then delegates — byte-identical
+    #: updates in ONE dispatch instead of the per-param eager chain
+    _fused_step = None
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip: Optional[ClipGradBase] = None, multi_precision=False,
@@ -95,16 +103,27 @@ class Optimizer:
 
     # -- eager step ----------------------------------------------------------
     def step(self):
+        if self._fused_step is not None:
+            return self._fused_step.step()
+        # armed-only continuous-profiling taps: the eager grad-transform
+        # -> per-param-update chain is the fusion pass's optimizer_chain
+        # signature (jit/fusion.py); disarmed cost is one list index
+        armed = _chain_armed[0]
         self._step_count += 1
         lr = self.get_lr()
         params_grads = [(p, p._grad_value) for p in self._parameter_list
                         if p._grad_value is not None and p.trainable]
         if self._grad_clip is not None:
+            t0 = time.perf_counter_ns() if armed else 0
             params_grads = self._grad_clip(params_grads)
+            if armed:
+                _note_chain(op_name="grad_clip",
+                            dur_ns=time.perf_counter_ns() - t0)
         saved_wd = self._weight_decay
         for p, g in params_grads:
             if g is None:
                 continue
+            t0 = time.perf_counter_ns() if armed else 0
             state = self._state_of(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
             self._weight_decay = saved_wd if self._decay_enabled(p) else 0.0
@@ -112,6 +131,9 @@ class Optimizer:
                                             self._step_count)
             p._value = new_v
             self._accumulators[id(p)] = new_state
+            if armed:
+                _note_chain(op_name="optimizer_update",
+                            dur_ns=time.perf_counter_ns() - t0)
         self._weight_decay = saved_wd
 
     def clear_grad(self, set_to_zero: bool = False):
@@ -287,18 +309,27 @@ class AdamW(Adam):
 
     def step(self):
         # honour apply_decay_param_fun by zeroing wd per-param
-        if self._apply_decay_param_fun is None:
+        if self._apply_decay_param_fun is None or \
+                self._fused_step is not None:
+            # the fused megaregion handles per-param decay exclusion
+            # itself (it bakes _decay_enabled per parameter)
             return super().step()
+        armed = _chain_armed[0]
         wd = self._weight_decay
         self._step_count += 1
         lr = self.get_lr()
         params_grads = [(p, p._grad_value) for p in self._parameter_list
                         if p._grad_value is not None and p.trainable]
         if self._grad_clip is not None:
+            t0 = time.perf_counter_ns() if armed else 0
             params_grads = self._grad_clip(params_grads)
+            if armed:
+                _note_chain(op_name="grad_clip",
+                            dur_ns=time.perf_counter_ns() - t0)
         for p, g in params_grads:
             if g is None:
                 continue
+            t0 = time.perf_counter_ns() if armed else 0
             state = self._state_of(p)
             self._weight_decay = wd if self._apply_decay_param_fun(p.name) else 0.0
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
@@ -306,6 +337,9 @@ class AdamW(Adam):
                                             self._step_count)
             p._value = new_v
             self._accumulators[id(p)] = new_state
+            if armed:
+                _note_chain(op_name="optimizer_update",
+                            dur_ns=time.perf_counter_ns() - t0)
         self._weight_decay = wd
 
 

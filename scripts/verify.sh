@@ -1,11 +1,21 @@
 #!/usr/bin/env bash
 # One-stop PR gate: tier-1 tests + tpu-lint + the armed-observability
-# overhead guard + the bench-trajectory sentinel. Run from the repo root:
+# overhead guard + the fusion-pass smoke/A-B gate + the bench-trajectory
+# sentinel. Run from the repo root:
 #
 #   bash scripts/verify.sh             # everything (tier-1 is the slow part)
 #   bash scripts/verify.sh --fast      # lint + overhead only (skips the
-#                                      # sentinel and tier-1)
+#                                      # fusion stage, sentinel and tier-1)
 #   bash scripts/verify.sh --sentinel  # ONLY the perf-regression sentinel
+#
+# The fusion stage (ROADMAP item 1) proves the profile→pass loop end to
+# end: scripts/fusion_smoke.py runs the profiler on the CPU smoke, feeds
+# the artifact to jit/fusion.py's FusionPass, asserts BOTH shipped
+# regions fuse and that a synthetically stale artifact degrades to
+# structured skips; benchmarks/bench_fusion.py then re-runs the ABBA
+# admission gates (byte-identity, recompile-neutrality, measured win)
+# and its one-line JSON is judged against the BENCH_r*.json trajectory
+# (wide 30% relative floor until the fusion series accumulates history).
 #
 # The sentinel stage replays the checked-in BENCH_r*.json trajectory
 # through scripts/bench_sentinel.py (noise-aware MAD bands) — the gate
@@ -29,22 +39,30 @@ if [ "$only_sentinel" = "1" ]; then
     exit $?
 fi
 
-echo "== [1/4] tpu-lint (python -m paddle_tpu.analysis) =="
+echo "== [1/6] tpu-lint (python -m paddle_tpu.analysis) =="
 python -m paddle_tpu.analysis || exit $?
 
-echo "== [2/4] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
+echo "== [2/6] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_obs_overhead.py || exit $?
 
 if [ "$fast" = "1" ]; then
-    echo "== [3/4] sentinel skipped (--fast) =="
-    echo "== [4/4] tier-1 skipped (--fast) =="
+    echo "== [3-6/6] fusion + sentinel + tier-1 skipped (--fast) =="
     exit 0
 fi
 
-echo "== [3/4] bench_sentinel (trajectory replay) =="
+echo "== [3/6] fusion pass smoke (profile -> pass -> install, stale skips) =="
+JAX_PLATFORMS=cpu python scripts/fusion_smoke.py || exit $?
+
+echo "== [4/6] bench_fusion ABBA gates + sentinel fresh-line judgement =="
+JAX_PLATFORMS=cpu python benchmarks/bench_fusion.py > /tmp/_fusion_line.json \
+    || exit $?
+tail -n 1 /tmp/_fusion_line.json | python scripts/bench_sentinel.py \
+    --fresh - --min-history 1 --rel-floor 0.3 || exit $?
+
+echo "== [5/6] bench_sentinel (trajectory replay) =="
 python scripts/bench_sentinel.py --replay || exit $?
 
-echo "== [4/4] tier-1 test suite =="
+echo "== [6/6] tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -369,6 +369,17 @@ def test_span_contract_meta(tmp_path):
      "ContinuousBatchingEngine\n"
      "    return ContinuousBatchingEngine\n",
      "STRICT"),
+    # the fusion pass consumes symbols + injected callables, never the
+    # serving stack it optimizes — lazy imports banned too (ISSUE 13)
+    ("layer-deps", "paddle_tpu/jit/fusion.py",
+     "def install(target):\n"
+     "    from paddle_tpu.inference.decoding import "
+     "ContinuousBatchingEngine\n"
+     "    return ContinuousBatchingEngine\n",
+     "STRICT"),
+    ("layer-deps", "paddle_tpu/jit/fusion.py",
+     "from paddle_tpu.serving.scheduler import ServingScheduler\n",
+     "STRICT"),
 ])
 def test_layering_rule_catches_synthetic_violation(tmp_path, rule_id, rel,
                                                    src, needle):
@@ -556,3 +567,40 @@ def test_fingerprints_survive_line_drift(tmp_path):
     assert [f.fingerprint for f in rep1.findings] == \
         [f.fingerprint for f in rep2.findings]
     assert rep1.findings[0].line != rep2.findings[0].line
+
+
+def test_fusion_builders_are_traced_roots_for_purity_rules():
+    """ISSUE 13 satellite: jit/fusion.py's fused region builders hand
+    their programs to jax.jit, so the ProjectIndex call graph must see
+    them as traced roots — the purity/recompile-hazard rules then cover
+    every generated megaregion body (the whole-package acceptance test
+    above proves they come back clean)."""
+    from paddle_tpu.analysis import REPO_ROOT
+    proj = Project(REPO_ROOT, roots=("paddle_tpu",))
+    root_files = {fi.module.rel for fi in proj.index.traced_roots()}
+    assert "paddle_tpu/jit/fusion.py" in root_files
+    fusion_roots = {fi.qualname for fi in proj.index.traced_roots()
+                    if fi.module.rel == "paddle_tpu/jit/fusion.py"}
+    # both decode-tail builders' programs are rooted
+    assert any(q.startswith("build_fused_unified_step")
+               for q in fusion_roots), fusion_roots
+    assert any(q.startswith("build_fused_spec_step")
+               for q in fusion_roots), fusion_roots
+
+
+def test_fusion_purity_violation_in_builder_is_caught(tmp_path):
+    """A wall-clock read planted inside a fusion-style region builder is
+    reachable from its jax.jit root and flagged — proof the coverage is
+    real, not vacuous."""
+    rep = _run(tmp_path, {"paddle_tpu/jit/fusion2.py": """
+        import time
+        import jax
+
+        def build_region(model_step):
+            def run(params, x):
+                t = time.time()
+                return model_step(params, x) * t
+            return jax.jit(run)
+    """}, ["trace-wall-clock"])
+    hits = rep.for_rule("trace-wall-clock")
+    assert hits and any("time.time" in f.message for f in hits)

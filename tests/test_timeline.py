@@ -407,3 +407,28 @@ def test_chain_profiler_bounded_pairs():
         p.disarm()
     assert len(p._pairs) <= 4
     assert p.dropped_pairs > 0
+
+
+def test_export_stamped_and_byte_deterministic(tmp_path):
+    """ISSUE 13 satellite: the artifact carries ``schema_version`` +
+    run metadata in the bench one-line-JSON convention, and two exports
+    over the SAME capture are byte-identical files (the fusion pass's
+    trust anchor — no wall clock, no dict-order nondeterminism)."""
+    from paddle_tpu.observability.profiling import run_metadata
+    from paddle_tpu.observability.runtime import telemetry
+    telemetry.enable()
+    chain_profiler.reset()
+    chain_profiler.arm()
+    _decode_tail_workload(n=10)
+    chain_profiler.disarm()
+    d1 = chain_profiler.export(path=str(tmp_path / "a.json"), top_n=5,
+                               workload="decode_tail")
+    d2 = chain_profiler.export(path=str(tmp_path / "b.json"), top_n=5,
+                               workload="decode_tail")
+    assert (tmp_path / "a.json").read_bytes() == \
+        (tmp_path / "b.json").read_bytes()
+    assert d1["schema_version"] == d1["version"] == 1
+    assert d1["meta"] == run_metadata()
+    assert set(d1["meta"]) == {"python", "host_platform",
+                               "jax_platforms"}
+    assert d1 == d2
