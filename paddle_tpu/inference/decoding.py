@@ -360,7 +360,8 @@ class ContinuousBatchingEngine:
                  check_invariants: bool = True, unified: bool = True,
                  step_tokens: Optional[int] = None,
                  speculative: bool = False, spec_k: int = 4,
-                 drafter=None, fused_tail: bool = False):
+                 drafter=None, fused_tail: bool = False,
+                 mesh=None, mp_axis: str = "mp"):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -388,6 +389,46 @@ class ContinuousBatchingEngine:
                 mcfg.num_hidden_layers, pool, page_size,
                 mcfg.num_key_value_heads, mcfg.head_dim, dtype=mcfg.dtype)
             self.cache = None
+        # multi-chip TP serving (ROADMAP item 3): the weights are
+        # Megatron-sharded and the paged pool head-sharded over the
+        # mesh's mp axis — GQA groups mapped to chips. The unified
+        # step's row metadata is shape-stable, so sharding is a LAYOUT
+        # property of the arrays (device_put placements), not a new
+        # program: the same single compiled step serves any degree and
+        # O(1)-recompile behavior is untouched.
+        self._mp_axis = mp_axis
+        if mesh is not None and mp_axis not in mesh.shape:
+            raise ValueError(
+                f"serving mesh has no {mp_axis!r} axis (axes: "
+                f"{tuple(mesh.shape)}) — build it with "
+                "parallel.mesh.serving_mesh(...) or pass mp_axis naming "
+                "the TP axis")
+        chips = int(mesh.shape[mp_axis]) if mesh is not None else 1
+        # a DEGREE-1 mesh is kept too: it carries no sharding but pins
+        # the replica's device affinity — a replica resized down to one
+        # chip must live on ITS surviving chip, not the process default
+        # device another replica's mesh occupies
+        self._mesh = mesh
+        if self._mesh is not None:
+            if chips > 1 and not unified:
+                raise ValueError(
+                    "multi-chip serving shards the unified ragged step; "
+                    "construct with unified=True")
+            if (mcfg.num_key_value_heads % chips
+                    or mcfg.num_attention_heads % chips):
+                raise ValueError(
+                    f"TP degree {chips} must divide num_attention_heads="
+                    f"{mcfg.num_attention_heads} and num_key_value_heads="
+                    f"{mcfg.num_key_value_heads} (whole GQA groups per "
+                    "chip — pick a degree via mesh.surviving_mp_degree)")
+            self.mgr.shard_heads(self._mesh, mp_axis)
+        # one-slot param-placement cache: the caller keeps passing the
+        # SAME host/replicated params object to step(); the engine
+        # shards it onto ITS mesh once (each replica owns its own mesh
+        # after an elastic resize, so placement must be per-engine). The
+        # original params are held strongly so a recycled id() can never
+        # alias a dead pytree.
+        self._placed_params: Tuple = (None, None)
         # the conservation audit is O(pool) host work per step; on by
         # default (it anchors the shared-ownership model, and speculative
         # draft growth/rollback is the first path that returns pages
@@ -562,6 +603,27 @@ class ContinuousBatchingEngine:
         """Per-request new-token budget (submit() override or config)."""
         return (req.max_new_tokens if req.max_new_tokens is not None
                 else self.config.max_new_tokens)
+
+    @property
+    def num_chips(self) -> int:
+        """TP chips this engine is sharded over (1 = single-chip)."""
+        return self.mgr.mesh_chips
+
+    @property
+    def mesh(self):
+        """The serving TP mesh (None when single-chip)."""
+        return self._mesh
+
+    def _place_params(self, params):
+        """Shard the caller's params onto this engine's mesh (cached by
+        object identity — the serving loop passes one params object
+        forever; a fresh object, e.g. after a weight swap, re-places)."""
+        if self._placed_params[0] is params:
+            return self._placed_params[1]
+        placed = self._L.shard_params_tp(params, self._mesh,
+                                         self.model_config)
+        self._placed_params = (params, placed)
+        return placed
 
     @property
     def num_free_slots(self) -> int:
@@ -900,6 +962,8 @@ class ContinuousBatchingEngine:
         (bucketed prefill waves + per-shape decode chunk). Speculative
         mode folds draft verification into the same single dispatch
         (``_step_spec``)."""
+        if self._mesh is not None:
+            params = self._place_params(params)
         if self._speculative:
             n = self._step_spec(params)
         elif self._unified:
@@ -1024,6 +1088,7 @@ class ContinuousBatchingEngine:
         mcfg = self.model_config
         cfg = self.config
         n_rows = self.num_slots
+        mesh, mp_axis = self._mesh, self._mp_axis
         if self._fused_tail:
             # the fused decode-tail twin: SAME compute graph (the
             # builder receives the model step + sampler as injected
@@ -1035,7 +1100,7 @@ class ContinuousBatchingEngine:
                            last_idx, k_pages, v_pages, bt):
                 return L.ragged_step(params, ids, token_row, positions,
                                      kv_lens, last_idx, k_pages, v_pages,
-                                     bt, mcfg)
+                                     bt, mcfg, mesh=mesh, mp_axis=mp_axis)
 
             def sample_fn(logits, key):
                 return _sample(logits, key, cfg)
@@ -1054,7 +1119,7 @@ class ContinuousBatchingEngine:
                 ids_eff = jnp.where(uc_k, jnp.take(tok, row_c), ids_k)
                 logits, kp, vp = L.ragged_step(
                     params, ids_eff, tr_k, pos_k, kvl_k, li_k, kp, vp,
-                    bt, mcfg)
+                    bt, mcfg, mesh=mesh, mp_axis=mp_axis)
                 key, sub = jax.random.split(key)
                 nxt = _sample(logits, sub, cfg)            # (R,)
                 # emit the INPUT carry: step outputs chain across steps
@@ -1218,7 +1283,7 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.unified_step",
                 (self.num_slots, self.chunk, self._step_tokens,
-                 self._table_width, self._fused_tail)
+                 self._table_width, self._fused_tail, self.num_chips)
                 + self._unified_flags)
             self._unified_step = self._build_unified_step()
         # armed-only continuous-profiling taps: the plan -> dispatch ->
@@ -1340,6 +1405,7 @@ class ContinuousBatchingEngine:
         recompile anything."""
         L = self._L
         mcfg = self.model_config
+        mesh, mp_axis = self._mesh, self._mp_axis
         if self._fused_tail:
             # fused decode tail, spec flavour: the same single ragged
             # dispatch plus the verify epilogue IN-PROGRAM — the
@@ -1351,7 +1417,7 @@ class ContinuousBatchingEngine:
                            cand_idx, k_pages, v_pages, bt):
                 return L.ragged_step(params, ids, token_row, positions,
                                      kv_lens, cand_idx, k_pages, v_pages,
-                                     bt, mcfg)
+                                     bt, mcfg, mesh=mesh, mp_axis=mp_axis)
 
             return _fusion.build_fused_spec_step(model_step, self.spec_k,
                                                  self.num_slots)
@@ -1360,7 +1426,7 @@ class ContinuousBatchingEngine:
                 k_pages, v_pages, bt):
             logits, kp, vp = L.ragged_step(
                 params, ids, token_row, positions, kv_lens, cand_idx,
-                k_pages, v_pages, bt, mcfg)
+                k_pages, v_pages, bt, mcfg, mesh=mesh, mp_axis=mp_axis)
             # greedy-only by construction (__init__ rejects do_sample):
             # the in-program argmax keeps the fence at (slots*(k+1),)
             # int32 instead of shipping full (C, V) logits to the host
@@ -1571,7 +1637,8 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.spec_step",
                 (self.num_slots, self._spec_tokens, self.spec_k,
-                 self._table_width, self._fused_tail) + self._spec_flags)
+                 self._table_width, self._fused_tail, self.num_chips)
+                + self._spec_flags)
             self._spec_step = self._build_spec_step()
         armed_chain = _chain_armed[0]
         tc0 = time.perf_counter_ns() if armed_chain else 0

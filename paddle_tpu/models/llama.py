@@ -301,6 +301,53 @@ def stacked_param_specs(config: LlamaConfig) -> Dict[str, P]:
     }
 
 
+def serving_param_specs(config: LlamaConfig) -> Dict[str, P]:
+    """Megatron TP specs for the SERVING path: ``mp`` only (serving
+    replicas have no dp/pp/sharding state — one replica = one TP mesh).
+    Attention projections are column-parallel (head-output dim over
+    ``mp``, whole heads per chip so the head-sharded paged KV pool lines
+    up), ``wo``/``w_down`` row-parallel (XLA inserts the all-reduce),
+    and ``embed``/``lm_head``/norms replicate so the packed-token gather
+    and the per-row logits stay chip-local and bitwise identical to the
+    single-chip program."""
+    col, row = P(None, None, "mp"), P(None, "mp", None)
+    return {
+        "embed": P(), "lm_head": P(), "ln_f": P(),
+        "ln1": P(None, None), "ln2": P(None, None),
+        "wq": col, "wk": col, "wv": col,
+        "w_gate": col, "w_up": col,
+        "wo": row, "w_down": row,
+    }
+
+
+def shard_params_tp(params: Dict[str, Any], mesh: Mesh,
+                    config: LlamaConfig) -> Dict[str, Any]:
+    """Place a stacked-param dict onto a serving TP mesh
+    (``serving_param_specs``). Weight-only-quantized leaves
+    (``{"q", "scale"}`` from ``quantization.quantize_stacked_params``)
+    shard ``q`` like the dense weight and ``scale`` (L, out) along the
+    output dim for column-parallel weights (row-parallel scales
+    replicate — their out dim is unsharded)."""
+    specs = serving_param_specs(config)
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        spec = specs.get(k, P())
+        if isinstance(v, dict):           # weight-only int8: {"q","scale"}
+            # scale is (..., out): it shards along out exactly when the
+            # dense weight is column-parallel (row-parallel/replicated
+            # weights keep their out dim whole -> replicated scale)
+            out_axis = spec[-1] if len(spec) == 3 else None
+            scale_spec = P(*([None] * (v["scale"].ndim - 1) + [out_axis]))
+            out[k] = {
+                "q": jax.device_put(v["q"], NamedSharding(mesh, spec)),
+                "scale": jax.device_put(
+                    v["scale"], NamedSharding(mesh, scale_spec)),
+            }
+        else:
+            out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
 def _rms(x, w, eps):
     # fused Pallas rms_norm on TPU (ops/rms_norm.py), XLA ref path elsewhere
     return rms_norm_array(x, w, eps)
@@ -1152,7 +1199,8 @@ def prefill_paged_suffix(params, ids, seq_lens, start_pos, k_pages, v_pages,
 
 
 def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
-                k_pages, v_pages, block_tables, config: LlamaConfig):
+                k_pages, v_pages, block_tables, config: LlamaConfig,
+                mesh: Optional[Mesh] = None, mp_axis: str = "mp"):
     """One forward over a RAGGED packed token batch — the unified model
     step behind the engine's single-dispatch serving loop.
 
@@ -1180,6 +1228,16 @@ def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
                may point anywhere; callers mask the resulting logits.
     k_pages/v_pages: (L, P, page, nkv, d); block_tables: (R, max_pages)
     Returns (logits (C, V), k_pages', v_pages').
+
+    Multi-chip TP (``mesh`` given, mp degree > 1): weights are placed by
+    ``shard_params_tp`` and the paged pools head-sharded over ``mp_axis``
+    (``PagedKVCacheManager.shard_heads``) — on the XLA path GSPMD
+    partitions every einsum/gather from those layouts alone (attention
+    is head-parallel, ``wo``/``w_down`` become partial-sum all-reduces),
+    so the traced program here is UNCHANGED and the mesh is only
+    forwarded to the attention dispatcher for the Pallas kernel, which
+    cannot be auto-partitioned and runs under ``shard_map`` with each
+    chip's GQA group slice instead.
     """
     from ..ops import paged_attention as pa
     t = ids.shape[0]
@@ -1224,7 +1282,8 @@ def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
         vp = vp.at[phys + l * pool_p, page_off].set(v[0].astype(vp.dtype))
         attn = pa.ragged_paged_attention(
             q[0], kp, vp, block_tables + l * pool_p, token_row, pos_c,
-            kv_lens, scale=1.0 / math.sqrt(d))             # (T, nh, d)
+            kv_lens, scale=1.0 / math.sqrt(d),
+            mesh=mesh, mp_axis=mp_axis)                    # (T, nh, d)
         xo = xc + _mm_prefill(attn.reshape(1, t, -1),
                               lp["wo"]).astype(xc.dtype)
         xn2 = _rms(xo, lp["ln2"], config.rms_norm_eps)

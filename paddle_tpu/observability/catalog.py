@@ -52,6 +52,10 @@ METRICS = {
     "paddle_fusion_admitted_total": ("counter", ("region",)),
     "paddle_fusion_skipped_total": ("counter", ("reason",)),
     "paddle_fusion_active": ("gauge", ("region",)),
+    # -- elastic mesh resize (serving/elastic.py) ---------------------------
+    "paddle_mesh_chips": ("gauge", ("replica",)),
+    "paddle_mesh_resizes_total": ("counter", ("replica",)),
+    "paddle_mesh_chip_faults_total": ("counter", ("replica", "kind")),
     # -- fleet router (serving/router.py) ----------------------------------
     "paddle_router_requests_total": ("counter", ("replica", "outcome")),
     "paddle_router_replica_state": ("gauge", ("replica",)),
@@ -91,6 +95,8 @@ EVENT_KINDS = {
     # fleet router
     "replica_ejected", "replica_recovered", "replica_draining",
     "replica_drained", "failover",
+    # elastic mesh resize (chip-level fault -> re-shard -> rejoin)
+    "chip_lost", "mesh_resized",
     # prefix cache
     "cache_hit", "cache_evict",
     # speculative decoding (draft rejection -> per-row paged rollback)

@@ -65,6 +65,7 @@ class FlightRecorder:
         self._slo_monitor = None
         self._router = None
         self._signals = None
+        self._elastic = None
         self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
         self.dumps = 0
 
@@ -111,6 +112,15 @@ class FlightRecorder:
         travel with the events and spans (``FleetRouter.__init__`` wires
         this; a later fleet replaces the earlier one)."""
         self._router = router
+
+    def attach_elastic(self, controller) -> None:
+        """Elastic resize controller: its ``timeline_snapshot()`` — the
+        chip-loss → checkpoint → re-shard → rejoin state machine per
+        resize, with the checkpointed flight state — lands in
+        ``elastic.json`` of every bundle, so a chip-loss postmortem
+        embeds the resize timeline (``ElasticServingController.__init__``
+        wires this; a later controller replaces the earlier one)."""
+        self._elastic = controller
 
     def attach_signals(self, bus) -> None:
         """Sensor plane: the SignalBus's ``history_snapshot()`` — metric
@@ -235,6 +245,16 @@ class FlightRecorder:
                 tz = {"error": repr(e)}
             members["timelines.json"] = json.dumps(
                 tz, default=str, indent=1).encode()
+        if self._elastic is not None:
+            # the resize state machine (chip losses, per-phase timeline,
+            # checkpointed flight state) — a torn controller must not
+            # lose the bundle
+            try:
+                el = self._elastic.timeline_snapshot()
+            except Exception as e:
+                el = {"error": repr(e)}
+            members["elastic.json"] = json.dumps(
+                el, default=str, indent=1).encode()
         if self._signals is not None:
             # the sensor plane's bounded window: series, signal trends
             # and anomalies leading up to this dump (a torn bus must not

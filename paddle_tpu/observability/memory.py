@@ -233,7 +233,7 @@ class _Pool:
     __slots__ = ("label", "page_bytes", "usable_pages", "num_pages",
                  "page_size", "pool_bytes", "verdict", "split", "held",
                  "tails", "meta", "cache_stats", "observes", "refcounted",
-                 "ref")
+                 "ref", "chips")
 
     def __init__(self, label: str):
         self.label = label
@@ -244,6 +244,7 @@ class _Pool:
         self.page_size = 0
         self.pool_bytes = 0
         self.refcounted = False
+        self.chips = 1                      # TP mesh degree (head-sharded)
         self.verdict: Dict[str, Any] = {}
         self.split: Dict[str, int] = {}     # class -> pages (last observe)
         self.held: Dict[Any, int] = {}      # rid -> pages (last observe)
@@ -408,6 +409,7 @@ class MemoryLedger:
                 pool.num_pages != int(mgr.num_pages)
                 or pool.page_size != int(mgr.page_size)
                 or pool.page_bytes != int(mgr.page_nbytes)
+                or pool.chips != (int(getattr(mgr, "mesh_chips", 1)) or 1)
                 or (pool.ref is not None and pool.ref() is not mgr)):
             # recycled id(): a DIFFERENT manager landed on a dead one's
             # address — a stale entry's cached capacity would turn the
@@ -428,6 +430,11 @@ class MemoryLedger:
             except TypeError:       # non-weakref-able manager: skip it
                 pool.ref = None
             pool.refcounted = hasattr(mgr, "num_live_pages")
+            # TP-sharded pools split every page's bytes evenly across
+            # the mesh (head-sharded: whole GQA groups per chip), so
+            # per-chip HBM cost = class bytes / chips — the capacity
+            # answer an elastic resize changes
+            pool.chips = int(getattr(mgr, "mesh_chips", 1)) or 1
             pool.num_pages = int(mgr.num_pages)
             pool.page_size = int(mgr.page_size)
             pool.usable_pages = int(mgr.usable_pages)
@@ -704,6 +711,11 @@ class MemoryLedger:
                     "pages": dict(p.split),
                     "bytes": {cls: pages * pb
                               for cls, pages in p.split.items()},
+                    # the per-chip view of a head-sharded pool: every
+                    # page's bytes split evenly across the TP mesh
+                    "chips": p.chips,
+                    "bytes_per_chip": {cls: pages * pb // p.chips
+                                       for cls, pages in p.split.items()},
                     "requests": requests,
                     "cache": dict(p.cache_stats)
                     if p.cache_stats is not None else None,

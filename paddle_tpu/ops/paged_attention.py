@@ -346,18 +346,66 @@ def ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, token_row,
-                           positions, kv_lens, scale: Optional[float] = None):
+                           positions, kv_lens, scale: Optional[float] = None,
+                           mesh=None, mp_axis: str = "mp"):
     """Dispatcher: Pallas ragged kernel on TPU (FLAGS_use_pallas_kernels),
     XLA gather/mask fallback elsewhere — selected automatically, same
-    contract either way (see ragged_paged_attention_array)."""
+    contract either way (see ragged_paged_attention_array).
+
+    ``mesh`` (a serving TP mesh with ``mp_axis`` degree > 1) only
+    matters on the Pallas path: ``pallas_call`` cannot be partitioned by
+    GSPMD, so the kernel runs under ``shard_map`` — each chip holds its
+    GQA group slice of ``q``/``k_pages``/``v_pages`` (head-sharded
+    pool), the row metadata is replicated, and the per-chip kernels are
+    byte-identical to the single-chip kernel over their head slice
+    (attention has no cross-head reduction, so there is no collective
+    here at all). The XLA path ignores ``mesh``: GSPMD partitions the
+    gather/einsum graph from the operand shardings alone."""
     from ._common import use_pallas
     if use_pallas():
+        # not a traced-shape branch: Mesh.shape is the STATIC axis-degree
+        # mapping of a construction-time mesh (engine compile keys carry
+        # the chip count, so the specialisation is deliberate + counted)
+        # tpu-lint: disable=trace-shape-branch
+        if mesh is not None and mp_axis in mesh.shape \
+                and mesh.shape[mp_axis] > 1:
+            return _ragged_paged_attention_shard_mapped(
+                q, k_pages, v_pages, block_tables, token_row, positions,
+                kv_lens, scale, mesh, mp_axis)
         return ragged_paged_attention_pallas(
             q, k_pages, v_pages, block_tables, token_row, positions,
             kv_lens, scale)
     return ragged_paged_attention_array(
         q, k_pages, v_pages, block_tables, token_row, positions, kv_lens,
         scale)
+
+
+def _ragged_paged_attention_shard_mapped(q, k_pages, v_pages, block_tables,
+                                         token_row, positions, kv_lens,
+                                         scale, mesh, mp_axis: str,
+                                         interpret: bool = False):
+    """The Pallas ragged kernel over a head-sharded pool: shard_map over
+    ``mp_axis`` with whole GQA groups per chip. q: (T, nh, d) sharded on
+    heads; pools: (LP, page, nkv, d) sharded on kv heads; metadata
+    replicated; out (T, nh, d) sharded on heads. ``interpret`` runs the
+    kernel in Pallas interpret mode (the CPU parity test for this
+    multi-chip wrapper)."""
+    from jax.sharding import PartitionSpec as P
+    from ..core.compat import shard_map
+
+    def local(q_l, kp_l, vp_l, bt, tr, pos, kvl):
+        return ragged_paged_attention_pallas(
+            q_l, kp_l, vp_l, bt, tr, pos, kvl, scale, interpret=interpret)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, mp_axis, None),
+                  P(None, None, mp_axis, None),
+                  P(None, None, mp_axis, None),
+                  P(None, None), P(None), P(None), P(None)),
+        out_specs=P(None, mp_axis, None),
+        check_vma=False,
+    )(q, k_pages, v_pages, block_tables, token_row, positions, kv_lens)
 
 
 # ---------------------------------------------------------------------------
@@ -386,6 +434,10 @@ class PagedKVCacheManager:
         self._tables: dict = {}   # seq_id -> List[int]
         self._lens: dict = {}     # seq_id -> int
         self._page_nb: int = 0    # page_nbytes memo (geometry is fixed)
+        #: TP chips the pool is head-sharded over (1 = single-chip);
+        #: set by shard_heads — the memory ledger splits per-chip bytes
+        #: off it and the engine stamps it into its compile keys
+        self.mesh_chips: int = 1
 
     # -- allocation ---------------------------------------------------------
 
@@ -537,6 +589,29 @@ class PagedKVCacheManager:
                 f"page conservation violated: {len(free)} free + "
                 f"{len(owned_set)} owned = {total} != "
                 f"{self.usable_pages} usable")
+
+    # -- multi-chip layout (TP-sharded serving) ------------------------------
+
+    def shard_heads(self, mesh, mp_axis: str = "mp") -> None:
+        """Head-shard both page pools over the mesh's ``mp_axis``: whole
+        GQA (kv-head) groups per chip, so every page's bytes split
+        evenly across the TP mesh and attention stays head-local. Pure
+        LAYOUT — the allocator metadata (free list, tables, lens) is
+        host-side and chip-agnostic, which is what makes an elastic
+        resize a rebuild-and-replay rather than a data migration. The
+        kv-head axis must divide by the mesh degree (whole groups per
+        chip; a split group would split single heads across chips)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        chips = int(mesh.shape[mp_axis])
+        nkv = self.k_pages.shape[3]
+        if nkv % chips:
+            raise ValueError(
+                f"num_kv_heads={nkv} must divide by the TP degree "
+                f"{chips} (whole GQA groups per chip)")
+        ns = NamedSharding(mesh, P(None, None, None, mp_axis, None))
+        self.k_pages = jax.device_put(self.k_pages, ns)
+        self.v_pages = jax.device_put(self.v_pages, ns)
+        self.mesh_chips = chips
 
     # -- views for the op ---------------------------------------------------
 

@@ -83,3 +83,51 @@ def named_sharding(*spec) -> Optional[NamedSharding]:
 def current_axis_names() -> Tuple[str, ...]:
     m = get_global_mesh()
     return tuple(m.axis_names) if m is not None else ()
+
+
+# ---------------------------------------------------------------------------
+# Serving meshes (TP-sharded inference replicas + elastic resize)
+# ---------------------------------------------------------------------------
+
+def serving_mesh(num_chips: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A serving replica's TP mesh: ``num_chips`` devices on the ``mp``
+    axis, every other hybrid axis 1. One replica of the sharded
+    continuous-batching engine owns exactly one of these; the elastic
+    resize controller rebuilds it over the surviving devices after a
+    chip loss (``shrink_serving_mesh``)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_chips < 1 or num_chips > len(devs):
+        raise ValueError(
+            f"serving mesh needs 1..{len(devs)} chips, got {num_chips}")
+    return build_mesh({"mp": num_chips}, devices=devs[:num_chips])
+
+
+def surviving_mp_degree(num_chips_left: int, num_kv_heads: int) -> int:
+    """Largest TP degree usable after chip loss: the KV pool is
+    head-sharded (whole GQA groups per chip), so the degree must divide
+    ``num_kv_heads`` and fit the surviving chip count. Losing one chip
+    of an mp=4 / 4-kv-head replica therefore re-shards to mp=2, not
+    mp=3."""
+    for d in range(min(max(num_chips_left, 1), num_kv_heads), 0, -1):
+        if num_kv_heads % d == 0:
+            return d
+    return 1
+
+
+def shrink_serving_mesh(mesh: Mesh, dead_chip: int,
+                        num_kv_heads: int) -> Mesh:
+    """The surviving serving mesh after ``dead_chip`` (an index into the
+    mesh's flat device order) is lost: drop that device and rebuild at
+    the largest head-divisible TP degree the survivors support. An
+    out-of-range index raises — silently dropping nothing would report
+    a "completed" resize that still contains the dead chip."""
+    all_devs = mesh.devices.reshape(-1).tolist()
+    if not 0 <= int(dead_chip) < len(all_devs):
+        raise ValueError(
+            f"dead chip index {dead_chip} outside the mesh's "
+            f"{len(all_devs)} devices")
+    devs = [d for i, d in enumerate(all_devs) if i != int(dead_chip)]
+    if not devs:
+        raise ValueError("mesh has no surviving devices")
+    deg = surviving_mp_degree(len(devs), num_kv_heads)
+    return serving_mesh(deg, devices=devs)

@@ -22,6 +22,13 @@ event                asked by
                      watchdog would flag — then recovers)
 ``replica_slow``     ``FleetRouter.step`` per replica (each step sleeps
                      extra for a bounded window — a straggling replica)
+``chip_die``         ``ElasticServingController.step`` per replica (one
+                     chip of the replica's TP mesh dies: the replica is
+                     hard-ejected, its flights fail over, and it
+                     re-shards onto the surviving mesh)
+``chip_degraded``    ``ElasticServingController.step`` per replica (a
+                     chip must be retired but still answers: graceful
+                     drain → re-shard → undrain, no failovers)
 ===================  ======================================================
 
 Each scheduled fault fires exactly once (``fire`` consumes it), so a
@@ -37,6 +44,13 @@ fault with ``replica=None`` acts as a wildcard (consumed by the first
 replica that asks at its step), while a replica-scoped fault fires only
 for its replica. The one-shot consumption contract is unchanged, so a
 router chaos run replays byte-for-byte from the same schedule.
+
+Chip scoping: chip-level events additionally carry a ``chip`` index into
+the replica's TP mesh (``chip=None`` wildcards to whichever chip the
+consumer defaults to — chip 0). The elastic controller asks
+``fire_chip(event, step, replica=r)`` and receives the chip index, so a
+seeded chip storm (``seeded_chips``) deterministically names WHICH chip
+of WHICH replica dies at WHICH step.
 
 This module is also the only place allowed to write checkpoint bytes
 outside the atomic-write helper — it exists to corrupt them on purpose.
@@ -58,10 +72,13 @@ class Fault:
     """One scheduled fault: ``event`` fires when the runtime reaches
     ``step`` (for save events, the step being saved). ``replica``
     narrows a fleet fault to one replica id (None = unscoped: trainer
-    faults, or a wildcard consumed by the first replica that asks)."""
+    faults, or a wildcard consumed by the first replica that asks);
+    ``chip`` narrows a chip-level event to one chip of that replica's
+    TP mesh (None = the consumer's default chip)."""
     event: str
     step: int
     replica: Optional[int] = None
+    chip: Optional[int] = None
 
 
 @dataclass
@@ -121,12 +138,46 @@ class FaultInjector:
         faults.sort(key=lambda f: (f.step, f.event, f.replica))
         return cls(schedule=faults)
 
-    def fire(self, event: str, step: int,
-             replica: Optional[int] = None) -> bool:
-        """True (and consume) iff a fault for (event, step) is scheduled.
-        With ``replica`` given, replica-scoped faults must match it
-        exactly; unscoped faults act as a wildcard. A replica-scoped
-        fault never fires for an unscoped query."""
+    @classmethod
+    def seeded_chips(cls, seed: int, num_steps: int, num_replicas: int,
+                     num_chips: int,
+                     events: Sequence[str] = ("chip_die",
+                                              "chip_degraded"),
+                     n_faults: int = 1) -> "FaultInjector":
+        """A reproducible chip-scoped schedule for elastic-resize chaos
+        runs: same seed → same (event, step, replica, chip) quadruples.
+        Steps are 1-based like ``seeded_replicas`` (the controller
+        increments its counter before asking). At most one chip event
+        per replica is scheduled — a second loss would re-shard a
+        replica twice, which the acceptance suite exercises explicitly
+        rather than by accident."""
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        num_steps = max(num_steps, 1)
+        num_replicas = max(num_replicas, 1)
+        n_faults = min(n_faults, num_replicas)
+        faults: List[Fault] = []
+        used_replicas = set()
+        while len(faults) < n_faults:
+            f = Fault(events[int(rng.choice(len(events)))],
+                      int(rng.choice(num_steps)) + 1,
+                      replica=int(rng.choice(num_replicas)),
+                      chip=int(rng.choice(max(num_chips, 1))))
+            if f.replica in used_replicas:
+                continue
+            used_replicas.add(f.replica)
+            faults.append(f)
+        faults.sort(key=lambda f: (f.step, f.event, f.replica, f.chip))
+        return cls(schedule=faults)
+
+    def _match(self, event: str, step: int,
+               replica: Optional[int]) -> Optional[Fault]:
+        """One-shot schedule matching shared by :meth:`fire` and
+        :meth:`fire_chip`: (event, step) must equal exactly; a
+        replica-scoped fault must match the queried replica, an
+        unscoped fault acts as a wildcard, and a replica-scoped fault
+        never fires for an unscoped query. The matched fault is
+        consumed (removed from the schedule)."""
         for f in self.schedule:
             if f.event != event or f.step != int(step):
                 continue
@@ -134,13 +185,41 @@ class FaultInjector:
                                           or int(replica) != f.replica):
                 continue
             self.schedule.remove(f)
-            if replica is None and f.replica is None:
-                self.fired.append((event, int(step)))
-            else:
-                r = f.replica if f.replica is not None else int(replica)
-                self.fired.append((event, int(step), r))
-            return True
-        return False
+            return f
+        return None
+
+    def fire_chip(self, event: str, step: int,
+                  replica: Optional[int] = None,
+                  default_chip: int = 0) -> Optional[int]:
+        """Like :meth:`fire` for chip-level events, returning WHICH chip
+        the fault names (``default_chip`` for wildcard-chip faults) or
+        None when nothing is scheduled. Consumption/one-shot/replica-
+        wildcard semantics match :meth:`fire`; ``fired`` records the
+        full (event, step, replica, chip) quadruple."""
+        f = self._match(event, step, replica)
+        if f is None:
+            return None
+        chip = f.chip if f.chip is not None else int(default_chip)
+        r = f.replica if f.replica is not None else (
+            int(replica) if replica is not None else None)
+        self.fired.append((event, int(step), r, chip))
+        return chip
+
+    def fire(self, event: str, step: int,
+             replica: Optional[int] = None) -> bool:
+        """True (and consume) iff a fault for (event, step) is scheduled.
+        With ``replica`` given, replica-scoped faults must match it
+        exactly; unscoped faults act as a wildcard. A replica-scoped
+        fault never fires for an unscoped query."""
+        f = self._match(event, step, replica)
+        if f is None:
+            return False
+        if replica is None and f.replica is None:
+            self.fired.append((event, int(step)))
+        else:
+            r = f.replica if f.replica is not None else int(replica)
+            self.fired.append((event, int(step), r))
+        return True
 
     # -- corruption tools (deliberately non-atomic writes) ------------------
 

@@ -9,7 +9,8 @@ cancellation (:mod:`.scheduler`), per-request streaming token delivery
 text and profiler trace events (:mod:`.metrics`), and the fleet tier —
 a prefix-aware router over N engine replicas with circuit-breaker
 failure detection, graceful drain and mid-stream failover
-(:mod:`.router`, :mod:`.replica`, :mod:`.health`).
+(:mod:`.router`, :mod:`.replica`, :mod:`.health`), and elastic mesh
+resize for TP-sharded replicas that survive chip loss (:mod:`.elastic`).
 
 Quick start::
 
@@ -28,6 +29,9 @@ Quick start::
     print(sched.metrics.to_prometheus_text())
 """
 
+from .elastic import (  # noqa: F401
+    ElasticServingController, FlightSnapshot, ResizeRecord,
+)
 from .health import (  # noqa: F401
     HealthConfig, HealthTracker, ReplicaState,
 )
@@ -44,4 +48,5 @@ __all__ = [
     "ServingRequest", "ServingScheduler", "ServingError", "TokenStream",
     "HealthConfig", "HealthTracker", "ReplicaState", "ReplicaFault",
     "ReplicaHandle", "FleetRouter", "RouterConfig", "RouterRequest",
+    "ElasticServingController", "FlightSnapshot", "ResizeRecord",
 ]

@@ -570,11 +570,16 @@ class FleetRouter:
                 raise RuntimeError(
                     f"fleet loop exceeded max_steps={max_steps} with "
                     f"{self.pending} requests pending")
-            if (self.pending == before
-                    and not any(r.active for r in self.replicas.values())):
-                # nothing progressable this instant (backoff timers /
-                # breaker cooldowns pending): let the clock advance
-                self._sleep(self.config.failover_backoff_s / 4)
+            self._backoff_if_stalled(before)
+
+    def _backoff_if_stalled(self, pending_before: int) -> None:
+        """Nothing progressable this instant (backoff timers / breaker
+        cooldowns pending): let the clock advance. Shared by :meth:`run`
+        and the elastic controller's fleet loop so the stall heuristic
+        can never drift between the two."""
+        if (self.pending == pending_before and self.pending
+                and not any(r.active for r in self.replicas.values())):
+            self._sleep(self.config.failover_backoff_s / 4)
 
     # -- failure handling ---------------------------------------------------
 
@@ -771,11 +776,39 @@ class FleetRouter:
         r.draining = False
         r.drained_event_sent = False
 
+    def eject_replica(self, replica_id: int, reason: str) -> None:
+        """Operator/controller-initiated hard ejection: force the
+        breaker open and run the standard ejection path — flight-
+        recorder auto-dump, cancel + byte-identical mid-stream failover
+        of every live request to siblings (parking them when none is
+        routable, to be re-taken as replicas heal). The elastic resize
+        controller calls this when a replica's TP mesh loses a chip:
+        the torn mesh must stop serving NOW, exactly like a dead
+        engine."""
+        r = self.replicas[replica_id]
+        r.health.force_eject(reason)
+        self._eject(replica_id, r, reason)
+
+    def invalidate_index(self, replica_id: int,
+                         page_size: Optional[int] = None) -> None:
+        """Drop the router-side prefix index slice for one replica: a
+        replaced or mesh-resized replica starts with a COLD pool, so a
+        surviving index entry would route affinity traffic to prefixes
+        the new pool no longer holds (a stale hit costs a miss, but a
+        systematic one defeats the affinity win). Called by
+        :meth:`replace_replica` and the elastic resize controller."""
+        ps = (page_size if page_size is not None
+              else self.replicas[replica_id].engine.page_size)
+        self._index[replica_id] = RadixTree(ps)
+
     def replace_replica(self, handle: ReplicaHandle) -> None:
         """Swap a fresh :class:`ReplicaHandle` (same id, new engine) into
         the fleet — the recovery path for a replica whose scheduler
-        degraded or whose process died for real. The router-side prefix
-        index for that id resets (the new engine's cache is cold)."""
+        degraded, whose process died for real, or whose TP mesh resized
+        under it. The router-side prefix index for that id resets (the
+        new engine's cache is cold) and the reused id's
+        ``paddle_serving_r<id>`` metrics namespace re-registers
+        idempotently (the registry sink replaces; regression-tested)."""
         rid = handle.replica_id
         if rid not in self.replicas:
             raise KeyError(f"no replica {rid} in the fleet")
@@ -787,7 +820,7 @@ class FleetRouter:
                 f"replica {rid} still owns {len(live)} live requests; "
                 "drain or eject it first")
         self.replicas[rid] = handle
-        self._index[rid] = RadixTree(handle.engine.page_size)
+        self.invalidate_index(rid, page_size=handle.engine.page_size)
         self._probe.pop(rid, None)
 
     # -- observability ------------------------------------------------------

@@ -699,3 +699,92 @@ def test_diagserver_fleet_view():
         router.step(params)
         clock.advance(0.05)
     assert srv.health() == "breached"
+
+
+def _fresh_handle(rid, clock, max_new=4, num_slots=2, chunk=2, seed=3,
+                  page_size=4, speculative=False, sched_kw=None,
+                  health_kw=None):
+    """A replacement ReplicaHandle reusing ``rid`` (the
+    ``replace_replica`` recovery path: fresh engine, same id — its
+    ``paddle_serving_r<rid>`` namespace re-registers)."""
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    sched_kw = dict(sched_kw or {})
+    sched_kw.setdefault("max_step_retries", 1)
+    sched_kw.setdefault("retry_backoff_s", 0.01)
+    eng = ContinuousBatchingEngine(
+        cfg, GenerationConfig(max_new_tokens=max_new, seed=seed),
+        num_slots=num_slots, page_size=page_size, max_seq_len=32,
+        chunk=chunk, speculative=speculative)
+    return ReplicaHandle(rid, eng, config=SchedulerConfig(**sched_kw),
+                         health_config=HealthConfig(**(health_kw or {})),
+                         clock=clock, sleep=clock.sleep)
+
+
+def test_replace_replica_reused_id_metrics_idempotent():
+    """Satellite (ISSUE 14): a reused replica id re-registers the
+    ``paddle_serving_r<id>`` metrics namespace — the registry sink must
+    REPLACE (never raise on the re-declared families), the scrape must
+    carry exactly one family section per name, and its values must come
+    from the NEW sink. Two full replace cycles, speculation + SLO
+    monitors attached, prove the whole per-replica telemetry surface is
+    idempotent under id reuse."""
+    cfg, params, router, replicas, clock = _fleet(
+        n=2, speculative=True, health_kw={"eject_after": 1})
+    for r in replicas:
+        r.make_slo_monitor()
+    h = [router.submit(np.arange(1, 6, dtype=np.int32))
+         for _ in range(3)]
+    _drive(router, clock, params)
+    assert all(q.state == RequestState.DONE for q in h)
+    old_submitted = get_registry().snapshot()[
+        "paddle_serving_r0"]["counters"]["requests_submitted_total"]
+    assert old_submitted > 0
+    for _cycle in range(2):      # two replace cycles: reuse of a reuse
+        router.replicas[0].kill()
+        router.eject_replica(0, "test: chip torn")
+        fresh = _fresh_handle(0, clock, speculative=True,
+                              health_kw={"eject_after": 1})
+        router.replace_replica(fresh)       # must not raise
+        fresh.make_slo_monitor()            # SLO families re-register too
+    text = get_registry().prometheus_text()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("paddle_serving_r0_requests_submitted_total")]
+    # exactly one sample line for the family, and it reads the NEW
+    # (empty) sink — not the retired one that served the storm
+    assert lines == ["paddle_serving_r0_requests_submitted_total 0"], lines
+    # the replaced replica serves again and its counters land in /metrics
+    h2 = router.submit(np.arange(1, 6, dtype=np.int32))
+    _drive(router, clock, params)
+    assert h2.state == RequestState.DONE
+    text = get_registry().prometheus_text()
+    assert sum(ln.startswith("paddle_serving_r0_requests_submitted_total")
+               for ln in text.splitlines()) == 1
+
+
+def test_replace_replica_invalidates_affinity_index():
+    """Satellite (ISSUE 14): the router-side radix affinity index for a
+    replaced (or mesh-resized) replica must drop — a replacement engine's
+    pool is COLD, so surviving synthetic page entries would route
+    affinity traffic to prefixes the new pool no longer holds."""
+    cfg, params, router, replicas, clock = _fleet(
+        n=2, health_kw={"eject_after": 1})
+    shared = np.arange(1, 13, dtype=np.int32)      # 3 full 4-token blocks
+    h = [router.submit(shared) for _ in range(2)]
+    _drive(router, clock, params)
+    assert all(q.state == RequestState.DONE for q in h)
+    warm = [rid for rid in router.replicas
+            if router._overlap_tokens(rid, shared) > 0]
+    assert warm, "storm should have warmed at least one index slice"
+    victim = warm[0]
+    assert router.statusz()["index_nodes"][str(victim)] > 0
+    router.replicas[victim].kill()
+    router.eject_replica(victim, "test: resize")
+    router.replace_replica(_fresh_handle(victim, clock,
+                                         health_kw={"eject_after": 1}))
+    assert router._overlap_tokens(victim, shared) == 0
+    assert router.statusz()["index_nodes"][str(victim)] == 0
+    # the public invalidation hook the elastic resize controller uses
+    other = [rid for rid in router.replicas if rid != victim]
+    for rid in other:
+        router.invalidate_index(rid)
+        assert router._overlap_tokens(rid, shared) == 0
