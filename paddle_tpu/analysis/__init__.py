@@ -1,8 +1,8 @@
 """tpu-lint: AST-based invariant analyzer for the paddle_tpu tree.
 
 One parse per file, pluggable visitor rules, line suppressions and a
-checked-in baseline (see :mod:`.engine`). Four rule families protect the
-stack's hard-won guarantees:
+checked-in baseline (see :mod:`.engine`). Seven rule families protect
+the stack's hard-won guarantees:
 
 * **trace purity / recompile hazards** (:mod:`.purity`) — a call graph
   from every ``jax.jit``/``pallas_call`` root; wall-clock reads, host
@@ -15,11 +15,18 @@ stack's hard-won guarantees:
   ``observability/catalog.py``, both directions;
 * **layering/encapsulation** (:mod:`.layering`) — declarative import and
   private-access contracts (subsuming the five retired regex lints) plus
-  subsystem dependency direction.
+  subsystem dependency direction;
+* **resource flow / dtype flow / cache-key completeness**
+  (:mod:`.dataflow`, tpu-lint v2) — interprocedural dataflow over a
+  per-function CFG (exception edges included): paged acquisitions must
+  release on every path, traced bf16/int8 chains must not silently
+  promote, and every trace-time flag read must be derivable from the
+  guarding compile-cache key.
 
 CLI::
 
-    python -m paddle_tpu.analysis [--format text|json] [--rules a,b]
+    python -m paddle_tpu.analysis [--format text|json|sarif]
+                                  [--rules a,b] [--changed-only [REF]]
                                   [--write-baseline]
 
 exits 1 on any unbaselined finding or stale baseline entry. Tests use
@@ -33,6 +40,7 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from .contracts import CONTRACT_RULES
+from .dataflow import DATAFLOW_RULES
 from .engine import (AnalysisEngine, Baseline, Finding, Project,  # noqa: F401
                      Report, SourceModule)
 from .layering import LAYERING_RULES
@@ -45,7 +53,8 @@ BASELINE_PATH = Path(__file__).resolve().parent / "baseline.txt"
 
 
 def default_rules():
-    return (*PURITY_RULES, *LOCK_RULES, *CONTRACT_RULES, *LAYERING_RULES)
+    return (*PURITY_RULES, *LOCK_RULES, *CONTRACT_RULES, *LAYERING_RULES,
+            *DATAFLOW_RULES)
 
 
 def run_repo(root: Optional[Path] = None,

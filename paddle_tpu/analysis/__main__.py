@@ -1,25 +1,143 @@
-"""CLI: ``python -m paddle_tpu.analysis [--format json|text] ...``.
+"""CLI: ``python -m paddle_tpu.analysis [--format json|text|sarif] ...``.
 
 Exit code 0 when the tree is clean against the baseline; 1 when any
 unbaselined finding or stale baseline entry exists. ``--write-baseline``
 regenerates the checked-in baseline deterministically (sorted by
-fingerprint; existing justifications are preserved)."""
+fingerprint; existing justifications are preserved).
+
+``--changed-only [REF]`` scopes a run to the files ``git diff
+--name-only REF`` names plus their reverse-dependency closure (computed
+from a lightweight import scan, so a pre-commit run parses dozens of
+files instead of the whole tree). Scoped semantics: findings outside the
+closure are dropped, whole-tree-evidence findings (``unused:*`` catalog
+rows) are skipped, and the stale-baseline check is disabled — the full
+run remains the PR gate; this mode is the fast inner loop.
+"""
 
 from __future__ import annotations
 
 import argparse
+import re
+import subprocess
 import sys
 from pathlib import Path
+from typing import Optional, Sequence, Set
 
 from . import BASELINE_PATH, REPO_ROOT, default_rules, run_repo
-from .engine import Baseline
+from .engine import Baseline, Project, Report
+
+_ABS_IMPORT_RE = re.compile(r"^\s*(?:from|import)\s+([A-Za-z_][\w.]*)",
+                            re.MULTILINE)
+_FROM_IMPORT_RE = re.compile(r"^\s*from\s+([A-Za-z_][\w.]*)\s+import"
+                             r"\s+([^\n#]+)", re.MULTILINE)
+_REL_IMPORT_RE = re.compile(r"^\s*from\s+(\.+)([\w.]*)\s+import\s+([^\n#]+)",
+                            re.MULTILINE)
+
+
+def _imported_names(names: str):
+    """Identifiers from an import-name list (``a, b as c, (d,``)."""
+    for name in names.split(","):
+        name = name.strip().strip("()").split(" ")[0].strip()
+        if name.isidentifier():
+            yield name
+
+#: files some rules need regardless of the diff (contract tables)
+_ALWAYS_PARSE = ("paddle_tpu/observability/catalog.py",
+                 "paddle_tpu/serving/metrics.py")
+
+
+def _modname(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[:-len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def changed_closure(root: Path, roots: Sequence[str],
+                    ref: str) -> Optional[Set[str]]:
+    """Repo-relative paths of files changed vs ``ref`` plus every file
+    that (transitively) imports one of them. Returns None when git is
+    unusable (caller falls back to a full run). The import scan is a
+    line regex, not a parse — the whole point is a sub-second
+    pre-commit loop."""
+    try:
+        # --relative keys the paths to cwd=root (ls-files already is),
+        # not the git toplevel — they must match mod_of when --root sits
+        # below the toplevel
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", ref, "--"],
+            cwd=root, capture_output=True, text=True, check=True).stdout
+        # brand-new files are the primary pre-commit target and never
+        # appear in a diff against REF until staged
+        out += subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, check=True).stdout
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            OSError) as e:
+        print(f"--changed-only: git diff vs {ref!r} failed ({e}); "
+              "falling back to a full run", file=sys.stderr)
+        return None
+    changed = {line.strip() for line in out.splitlines()
+               if line.strip().endswith(".py")}
+    imports: dict = {}
+    mod_of: dict = {}
+    for sub in roots:
+        base = root / sub
+        if not base.exists():
+            continue
+        for p in base.rglob("*.py"):
+            rel = p.relative_to(root).as_posix()
+            mod_of[rel] = _modname(rel)
+            try:
+                text = p.read_text()
+            except (OSError, UnicodeDecodeError):
+                text = ""
+            imps = set(_ABS_IMPORT_RE.findall(text))
+            for base_mod, names in _FROM_IMPORT_RE.findall(text):
+                # ``from paddle_tpu.core import offload``: the
+                # dependency may be the SUBMODULE — record the dotted
+                # candidates too (name-not-a-module extras match no
+                # file and are harmless)
+                for name in _imported_names(names):
+                    imps.add(base_mod + "." + name)
+            # one leading dot = the containing package: for a plain
+            # module that drops the module's own name, but a package
+            # __init__'s modname IS the package already (_modname
+            # stripped the /__init__), so nothing is dropped
+            parts = _modname(rel).split(".")
+            pkg = parts if rel.endswith("/__init__.py") else parts[:-1]
+            for dots, tail, names in _REL_IMPORT_RE.findall(text):
+                base_parts = pkg[:len(pkg) - (len(dots) - 1)]
+                base_mod = ".".join(base_parts + ([tail] if tail else []))
+                imps.add(base_mod)
+                if not tail:
+                    # ``from . import format as fmt``: the dependency is
+                    # the submodule itself, which the package name alone
+                    # misses (``pkg.format`` changing must pull this
+                    # file into the closure)
+                    for name in _imported_names(names):
+                        imps.add(base_mod + "." + name)
+            imports[rel] = imps
+    closure = {rel for rel in changed if rel in mod_of}
+    queue = list(closure)
+    while queue:
+        rel = queue.pop()
+        mod = mod_of[rel]
+        for other, imps in imports.items():
+            if other in closure:
+                continue
+            if any(i == mod or i.startswith(mod + ".") for i in imps):
+                closure.add(other)
+                queue.append(other)
+    return closure
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="tpu-lint: AST-based invariant analyzer")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+        description="tpu-lint: AST + dataflow invariant analyzer")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--root", type=Path, default=REPO_ROOT,
                     help="repo root to analyze (default: this checkout)")
     ap.add_argument("--baseline", type=Path, default=BASELINE_PATH,
@@ -28,6 +146,12 @@ def main(argv=None) -> int:
                     help="report every finding, grandfathered or not")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="REF",
+                    help="scope to files changed vs REF (default HEAD) "
+                         "plus their reverse-dependency closure — the "
+                         "sub-second pre-commit mode; the full run "
+                         "stays the PR gate")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline to cover current findings "
                          "(sorted, deterministic; keeps justifications)")
@@ -50,10 +174,45 @@ def main(argv=None) -> int:
         rules = [r for r in rules if r.id in wanted]
 
     baseline_path = None if args.no_baseline else args.baseline
-    report = run_repo(root=args.root, rules=rules,
-                      baseline_path=baseline_path)
+    roots = ("paddle_tpu", "tests", "benchmarks")
+
+    only: Optional[Set[str]] = None
+    scope: Set[str] = set()
+    if args.changed_only is not None:
+        closure = changed_closure(args.root, roots, args.changed_only)
+        if closure is not None:
+            scope = set(closure)            # findings reported from here
+            only = set(closure)             # parsed: scope + contract tables
+            for rel in _ALWAYS_PARSE:
+                if (args.root / rel).exists():
+                    only.add(rel)
+            print(f"--changed-only {args.changed_only}: "
+                  f"{len(closure)} file(s) in the dependency closure",
+                  file=sys.stderr)
+
+    if only is None:
+        report = run_repo(root=args.root, rules=rules,
+                          baseline_path=baseline_path)
+    else:
+        project = Project(args.root, roots=roots, only=only)
+        baseline = (Baseline.load(baseline_path)
+                    if baseline_path is not None else Baseline())
+        from .engine import AnalysisEngine
+        full = AnalysisEngine(rules, baseline).run(project)
+        kept = [f for f in full.findings
+                if f.file in scope
+                and not f.symbol.startswith("unused:")]
+        # scoped run: no stale-baseline verdict (absence proves nothing
+        # when most of the tree was never parsed)
+        report = Report(kept, baseline, full.elapsed_s, full.files,
+                        ran_rules=set())
 
     if args.write_baseline:
+        if only is not None:
+            print("--write-baseline is incompatible with --changed-only "
+                  "(a scoped run must not rewrite whole-tree "
+                  "grandfathering)", file=sys.stderr)
+            return 2
         old = Baseline.load(args.baseline)
         ran = {r.id for r in rules}
         # keep entries owned by rules that did NOT run (a --rules subset
@@ -71,8 +230,12 @@ def main(argv=None) -> int:
               f"({len(entries)} entries)")
         return 0
 
-    print(report.to_json() if args.format == "json"
-          else report.to_text())
+    if args.format == "json":
+        print(report.to_json())
+    elif args.format == "sarif":
+        print(report.to_sarif(rules))
+    else:
+        print(report.to_text())
     return report.exit_code
 
 

@@ -338,52 +338,56 @@ class ProjectIndex:
                     best = fi
         return best
 
-    def _callees(self, fi: FunctionInfo) -> List[FunctionInfo]:
-        mi = self.by_rel[fi.module.rel]
-        out: List[FunctionInfo] = []
-        for node in fi.own_nodes():
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name):
-                child_qual = f"{fi.qualname}.<locals>.{f.id}"
-                child = next((c for c in mi.functions
-                              if c.qualname == child_qual), None)
-                if child is not None:
-                    out.append(child)
-                    continue
-                target = fi.scope.get(f.id) or mi.top_level.get(f.id)
-                if target is not None:
-                    out.append(target)
-                    continue
-                imp = mi.from_imports.get(f.id)
-                if imp is not None:
-                    other = self.mods.get(imp[0])
-                    if other is not None:
-                        t = other.top_level.get(imp[1])
-                        if t is not None:
-                            out.append(t)
-            elif isinstance(f, ast.Attribute):
-                d = dotted(f)
-                if d is None:
-                    continue
-                parts = d.split(".")
-                if parts[0] == "self" and len(parts) == 2 \
-                        and fi.class_name is not None:
-                    m = mi.classes.get(fi.class_name, {}).get(parts[1])
-                    if m is not None:
-                        out.append(m)
-                    continue
-                # module-attribute call through an import alias
-                alias = parts[0]
+    def resolve_call(self, fi: FunctionInfo,
+                     node: ast.Call) -> Optional[FunctionInfo]:
+        """Conservative single-call resolution — THE per-node convention
+        shared by the call-graph edges and the dataflow rules (same-scope
+        locals, self methods, from-imports, module-attribute calls
+        through import aliases); ``None`` at resolution gaps."""
+        mi = self.by_rel.get(fi.module.rel)
+        if mi is None:
+            return None
+        f = node.func
+        if isinstance(f, ast.Name):
+            child_qual = f"{fi.qualname}.<locals>.{f.id}"
+            child = next((c for c in mi.functions
+                          if c.qualname == child_qual), None)
+            if child is not None:
+                return child
+            target = fi.scope.get(f.id) or mi.top_level.get(f.id)
+            if target is not None:
+                return target
+            imp = mi.from_imports.get(f.id)
+            if imp is not None:
+                other = self.mods.get(imp[0])
+                if other is not None:
+                    return other.top_level.get(imp[1])
+            return None
+        if isinstance(f, ast.Attribute):
+            d = dotted(f)
+            if d is None:
+                return None
+            parts = d.split(".")
+            if parts[0] == "self" and len(parts) == 2 \
+                    and fi.class_name is not None:
+                return mi.classes.get(fi.class_name, {}).get(parts[1])
+            # module-attribute call through an import alias
+            if len(parts) == 2:
                 target_mod = None
-                if alias in mi.import_aliases and len(parts) == 2:
-                    target_mod = self.mods.get(mi.import_aliases[alias])
-                elif alias in mi.from_imports and len(parts) == 2:
-                    src, orig = mi.from_imports[alias]
+                if parts[0] in mi.import_aliases:
+                    target_mod = self.mods.get(mi.import_aliases[parts[0]])
+                elif parts[0] in mi.from_imports:
+                    src, orig = mi.from_imports[parts[0]]
                     target_mod = self.mods.get(f"{src}.{orig}")
                 if target_mod is not None:
-                    t = target_mod.top_level.get(parts[-1])
-                    if t is not None:
-                        out.append(t)
+                    return target_mod.top_level.get(parts[-1])
+        return None
+
+    def _callees(self, fi: FunctionInfo) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Call):
+                t = self.resolve_call(fi, node)
+                if t is not None:
+                    out.append(t)
         return out

@@ -1,0 +1,1298 @@
+"""tpu-lint v2: interprocedural dataflow over a real CFG.
+
+PR 8's rule families are per-file AST pattern matches; the invariants
+this module protects are *path* properties no pattern can see:
+
+* every paged acquisition must reach a release on EVERY path out of the
+  acquiring function — exception edges included (the safety net the
+  int8-page work multiplies the blast radius of);
+* dtype facts flow through traced code, so silent f32 promotion in a
+  bf16/int8 chain, a dequant that never meets its scale, or a
+  mixed-dtype contraction are provable, not guessable;
+* every trace-time external input (``flag_value``, ``os.environ``)
+  reachable from a cached-compile body must be derivable from that
+  cache's key expression — the generalisation of the PR 8 stale-program
+  defect (FLAGS_serving_a8w8_prefill) into a standing rule.
+
+Three layers:
+
+1. **CFG** (:func:`build_cfg`) — one basic-block-per-statement control
+   flow graph per function: branches, loops (back edges), try/except/
+   finally (handler edges, duplicated finally instances per
+   continuation), with-blocks, early returns, break/continue, and
+   conservative *exception edges* from any statement that contains a
+   call/raise/assert to the innermost matching handler chain (or the
+   function's exceptional exit).
+2. **Worklist solver** (:func:`solve_forward`) — generic forward
+   abstract interpretation to fixpoint; transfer functions return a
+   (normal, exceptional) out-state pair so exception edges carry the
+   state *at the raise point*, which is what makes leak-on-exception
+   findings real.
+3. **Interprocedural summaries** (:class:`Summaries`) — layered on the
+   existing :class:`~paddle_tpu.analysis.callgraph.ProjectIndex` call
+   graph: per-function "releases pages", "flags read (transitively)"
+   and "return dtype" facts, computed cycle-safely and used as
+   call-site transfer functions. Resolution gaps are CONSERVATIVE in
+   the no-false-positive direction: an unresolvable call neither
+   releases, nor reads a flag, nor has a known dtype.
+
+The three rule families (``page-leak``, ``dtype-flow``, ``cache-key``)
+live at the bottom of this file and register in ``AnalysisEngine``
+beside purity/locks/contracts/layering. Same contract as PR 8:
+deterministic findings with line-number-free fingerprints,
+``# tpu-lint: disable=`` suppressions, baselined-with-justification
+entries, and the <5 s whole-package wall budget.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import FunctionInfo, ProjectIndex, dotted
+from .engine import Finding, Project
+
+# ---------------------------------------------------------------------------
+# Control-flow graph
+# ---------------------------------------------------------------------------
+
+class Block:
+    """One CFG node. ``stmt`` is the owning AST statement (or the test
+    expression for branch headers; None for synthetic entry/exit/join
+    nodes). ``succ`` are normal-flow successors, ``esucc`` the targets
+    an in-statement exception transfers to."""
+
+    __slots__ = ("bid", "stmt", "kind", "succ", "esucc")
+
+    def __init__(self, bid: int, stmt=None, kind: str = "stmt"):
+        self.bid = bid
+        self.stmt = stmt
+        self.kind = kind            # stmt | test | entry | exit | exc | join
+        self.succ: List["Block"] = []
+        self.esucc: List["Block"] = []
+
+    def __repr__(self):             # pragma: no cover - debugging aid
+        ln = getattr(self.stmt, "lineno", "-")
+        return f"<B{self.bid} {self.kind}@{ln}>"
+
+
+class _Level:
+    """One enclosing try-level for exception routing."""
+
+    __slots__ = ("outer", "handler_entries", "catch_all", "finalbody",
+                 "cfg", "_exc_entry", "_ret_entry")
+
+    def __init__(self, cfg, outer, handler_entries=(), catch_all=False,
+                 finalbody=None):
+        self.cfg = cfg
+        self.outer = outer
+        self.handler_entries = list(handler_entries)
+        self.catch_all = catch_all
+        self.finalbody = finalbody          # list[stmt] or None
+        self._exc_entry = None              # memoized finally instances
+        self._ret_entry = None
+
+    # -- duplicated finally instances ---------------------------------------
+
+    def exc_entry(self) -> Block:
+        """Entry of this level's finally instance on the EXCEPTION path
+        (tail re-raises: continues routing at the outer level)."""
+        if self._exc_entry is None:
+            entry = self.cfg._join_block()
+            self._exc_entry = entry
+            tail = self.cfg._build_seq(self.finalbody, [entry], self.outer)
+            for cont in self.cfg._exc_targets(self.outer):
+                for b in tail:
+                    b.succ.append(cont)
+        return self._exc_entry
+
+    def ret_entry(self) -> Block:
+        """Entry of this level's finally instance on the RETURN path
+        (tail continues returning through outer finallys to EXIT)."""
+        if self._ret_entry is None:
+            entry = self.cfg._join_block()
+            self._ret_entry = entry
+            tail = self.cfg._build_seq(self.finalbody, [entry], self.outer)
+            cont = self.cfg._ret_continuation(self.outer)
+            for b in tail:
+                b.succ.append(cont)
+        return self._ret_entry
+
+
+#: handler types treated as catching EVERYTHING (propagation stops)
+_CATCH_ALL = {"Exception", "BaseException"}
+
+#: statements that can transfer control exceptionally (conservative: a
+#: contained call/raise/assert; attribute/key errors are deliberately out
+#: of scope to keep exception edges meaningful rather than total)
+def _can_raise(stmt) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            return True
+    return False
+
+
+class FunctionCFG:
+    """CFG of one function body. Public surface: ``entry``, ``exit``
+    (normal return), ``exc_exit`` (exception propagates out),
+    ``blocks``."""
+
+    def __init__(self, fn_node):
+        self.fn_node = fn_node
+        self.blocks: List[Block] = []
+        self.entry = self._block(None, "entry")
+        self.exit = self._block(None, "exit")
+        self.exc_exit = self._block(None, "exc")
+        self._loop_stack: List[Tuple[Block, Block, "_Level"]] = []
+        body = fn_node.body if isinstance(fn_node.body, list) \
+            else [ast.Return(value=fn_node.body)]
+        tail = self._build_seq(body, [self.entry], None)
+        for b in tail:
+            b.succ.append(self.exit)
+
+    # -- low-level helpers ---------------------------------------------------
+
+    def _block(self, stmt, kind="stmt") -> Block:
+        b = Block(len(self.blocks), stmt, kind)
+        self.blocks.append(b)
+        return b
+
+    def _join_block(self) -> Block:
+        return self._block(None, "join")
+
+    def _exc_targets(self, level: Optional[_Level]) -> List[Block]:
+        """Where an exception continuing past a finally goes next:
+        every enclosing handler chain until a catch-all, else onward
+        through the next finally instance, else exc_exit — the same
+        routing :meth:`_route_exc` applies at a raise site. Walking only
+        the finallys here (the original bug) skipped enclosing except
+        handlers, so ``try: try: ... finally: ... except: release()``
+        minted page-leak false positives."""
+        targets: List[Block] = []
+        while level is not None:
+            targets.extend(level.handler_entries)
+            if level.catch_all:
+                return targets
+            if level.finalbody:
+                targets.append(level.exc_entry())
+                return targets
+            level = level.outer
+        targets.append(self.exc_exit)
+        return targets
+
+    def _ret_continuation(self, level: Optional[_Level]) -> Block:
+        while level is not None:
+            if level.finalbody:
+                return level.ret_entry()
+            level = level.outer
+        return self.exit
+
+    def _jump_entry(self, level: Optional[_Level],
+                    stop_level: Optional[_Level], target: Block) -> Block:
+        """Where a break/continue at ``level`` lands first: every
+        finalbody between the jump and the loop's own ``stop_level``
+        (exclusive) runs, innermost first, before control reaches
+        ``target`` (the loop's after/header block). Jumping straight to
+        ``target`` (the original bug) made releases inside those
+        finallys invisible to page-leak on break/continue paths."""
+        chain: List[_Level] = []
+        lv = level
+        while lv is not None and lv is not stop_level:
+            if lv.finalbody:
+                chain.append(lv)
+            lv = lv.outer
+        for lv in reversed(chain):          # wire outermost-first so each
+            entry = self._join_block()      # inner tail continues outward
+            tail = self._build_seq(lv.finalbody, [entry], lv.outer)
+            for b in tail:
+                b.succ.append(target)
+            target = entry
+        return target
+
+    def _route_exc(self, block: Block, level: Optional[_Level]) -> None:
+        """Exception edges from ``block`` — one routing walk
+        (:meth:`_exc_targets`) shared with finally-tail continuation so
+        the two can never diverge."""
+        block.esucc.extend(self._exc_targets(level))
+
+    # -- recursive construction ----------------------------------------------
+
+    def _build_seq(self, stmts, frontier: List[Block],
+                   level: Optional[_Level]) -> List[Block]:
+        """Wire ``stmts`` after ``frontier``; returns the new frontier
+        (blocks whose normal successor is whatever comes next)."""
+        for stmt in stmts or ():
+            if not frontier:
+                break                       # unreachable code after return
+            frontier = self._build_stmt(stmt, frontier, level)
+        return frontier
+
+    def _build_stmt(self, stmt, frontier, level) -> List[Block]:
+        if isinstance(stmt, ast.If):
+            test = self._block(stmt.test, "test")
+            self._connect(frontier, test)
+            if _can_raise(stmt.test):
+                self._route_exc(test, level)
+            t_tail = self._build_seq(stmt.body, [test], level)
+            e_tail = self._build_seq(stmt.orelse, [test], level) \
+                if stmt.orelse else [test]
+            return t_tail + e_tail
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            header = self._block(
+                stmt.test if isinstance(stmt, ast.While) else stmt.iter,
+                "test")
+            self._connect(frontier, header)
+            if _can_raise_expr(header.stmt):
+                self._route_exc(header, level)
+            after = self._join_block()
+            self._loop_stack.append((header, after, level))
+            body_tail = self._build_seq(stmt.body, [header], level)
+            for b in body_tail:
+                b.succ.append(header)       # back edge
+            self._loop_stack.pop()
+            else_tail = self._build_seq(stmt.orelse, [header], level) \
+                if stmt.orelse else [header]
+            self._connect(else_tail, after)
+            return [after]
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            header = self._block(stmt, "stmt")  # __enter__ calls can raise
+            self._connect(frontier, header)
+            if _can_raise(stmt):
+                self._route_exc(header, level)
+            return self._build_seq(stmt.body, [header], level)
+
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, frontier, level)
+
+        if isinstance(stmt, ast.Return):
+            b = self._block(stmt, "stmt")
+            self._connect(frontier, b)
+            if _can_raise(stmt):
+                self._route_exc(b, level)
+            b.succ.append(self._ret_continuation(level))
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            b = self._block(stmt, "stmt")
+            self._connect(frontier, b)
+            self._route_exc(b, level)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            b = self._block(stmt, "stmt")
+            self._connect(frontier, b)
+            if self._loop_stack:
+                header, after, loop_level = self._loop_stack[-1]
+                b.succ.append(self._jump_entry(level, loop_level, after))
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            b = self._block(stmt, "stmt")
+            self._connect(frontier, b)
+            if self._loop_stack:
+                header, after, loop_level = self._loop_stack[-1]
+                b.succ.append(self._jump_entry(level, loop_level, header))
+            return []
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested definitions are separate graph nodes (the call
+            # graph owns them); the def statement itself cannot raise
+            b = self._block(stmt, "stmt")
+            self._connect(frontier, b)
+            return [b]
+
+        # simple statement
+        b = self._block(stmt, "stmt")
+        self._connect(frontier, b)
+        if _can_raise(stmt):
+            self._route_exc(b, level)
+        return [b]
+
+    def _build_try(self, stmt: ast.Try, frontier, level) -> List[Block]:
+        finalbody = stmt.finalbody or None
+        # exceptions raised INSIDE a handler (or the else block) skip
+        # this try's handlers but still run its finally
+        handler_level = _Level(self, level, finalbody=finalbody)
+        handler_entries: List[Block] = []
+        handler_tails: List[Block] = []
+        catch_all = False
+        for h in stmt.handlers:
+            entry = self._join_block()
+            handler_entries.append(entry)
+            if h.type is None:
+                catch_all = True
+            else:
+                names = [dotted(e) for e in
+                         (h.type.elts if isinstance(h.type, ast.Tuple)
+                          else [h.type])]
+                if any((n or "").split(".")[-1] in _CATCH_ALL
+                       for n in names):
+                    catch_all = True
+            handler_tails += self._build_seq(h.body, [entry],
+                                             handler_level)
+        body_level = _Level(self, level, handler_entries, catch_all,
+                            finalbody)
+        body_tail = self._build_seq(stmt.body, frontier, body_level)
+        else_tail = self._build_seq(stmt.orelse, body_tail,
+                                    handler_level) \
+            if stmt.orelse else body_tail
+        done = else_tail + handler_tails
+        if finalbody:
+            fin_entry = self._join_block()
+            self._connect(done, fin_entry)
+            return self._build_seq(finalbody, [fin_entry], level)
+        return done
+
+    @staticmethod
+    def _connect(frontier: List[Block], target: Block) -> None:
+        for b in frontier:
+            b.succ.append(target)
+
+
+def _can_raise_expr(expr) -> bool:
+    if expr is None:
+        return False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            return True
+    return False
+
+
+def build_cfg(fn_node) -> FunctionCFG:
+    """Public CFG constructor (memoize per node id if calling in bulk)."""
+    return FunctionCFG(fn_node)
+
+
+# ---------------------------------------------------------------------------
+# Worklist fixpoint solver
+# ---------------------------------------------------------------------------
+
+#: hard cap on solver iterations — the lattices used here are finite
+#: height so this never binds; it is a guard against a rule bug looping
+MAX_ITERATIONS = 200_000
+
+
+def solve_forward(cfg: FunctionCFG, analysis) -> Dict[int, object]:
+    """Forward abstract interpretation to fixpoint.
+
+    ``analysis`` provides ``initial()`` (entry state), ``join(a, b)``
+    (``a`` may be None = unreached) and ``transfer(state, block) ->
+    (normal_out, exc_out)``. Returns ``{block.bid: in_state}`` for every
+    reached block (exit/exc_exit in-states are the rule's verdict)."""
+    in_states: Dict[int, object] = {cfg.entry.bid: analysis.initial()}
+    work: List[Block] = [cfg.entry]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > MAX_ITERATIONS:          # pragma: no cover - guard
+            break
+        b = work.pop()
+        state = in_states.get(b.bid)
+        if state is None:
+            continue
+        n_out, e_out = analysis.transfer(state, b)
+        for succ, out in [(s, n_out) for s in b.succ] + \
+                         [(s, e_out) for s in b.esucc]:
+            joined = analysis.join(in_states.get(succ.bid), out)
+            if joined != in_states.get(succ.bid):
+                in_states[succ.bid] = joined
+                work.append(succ)
+    return in_states
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural summaries (layered on ProjectIndex)
+# ---------------------------------------------------------------------------
+
+_RELEASE_METHODS = {"free", "truncate_pages"}
+_FLAG_READERS = {"flag_value"}
+_ENV_READERS = {"os.environ.get", "os.getenv"}
+
+
+class Summaries:
+    """Cycle-safe per-function facts used as call-site transfer
+    functions. A resolution gap contributes NOTHING (conservative in the
+    direction that can only lose recall, never mint false positives)."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self._releases: Dict[int, bool] = {}
+        self._flags: Dict[int, FrozenSet[str]] = {}
+        self._ret_dtype: Dict[int, Optional[str]] = {}
+
+    # -- releases ------------------------------------------------------------
+
+    def releases(self, fi: FunctionInfo) -> bool:
+        """True when ``fi`` (transitively) calls ``.free()`` /
+        ``.truncate_pages()`` — a call to such a helper counts as a
+        release at the call site."""
+        return self._releases_walk(fi, set())[0]
+
+    def _releases_walk(self, fi: FunctionInfo,
+                       stack: Set[int]) -> Tuple[bool, bool]:
+        """Returns ``(releases, final)``. A cycle cut under-approximates
+        (False), so a False computed under one is PROVISIONAL — memoizing
+        it would poison later queries in the false-positive direction
+        (a mutually-recursive helper that does release would stay
+        "no-release" forever). True is always final (a release call is a
+        definite fact), and so is the walk ROOT's False: every node a
+        cut edge points back to is on the current stack, so the root's
+        traversal has accumulated the whole component's direct facts."""
+        key = id(fi.node)
+        if key in self._releases:
+            return self._releases[key], True
+        if key in stack:
+            return False, False             # cycle cut: provisional
+        is_root = not stack
+        stack.add(key)
+        out = False
+        final = True
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _RELEASE_METHODS:
+                out = True
+                break
+        if not out:
+            for callee in self.index._callees(fi):
+                v, f = self._releases_walk(callee, stack)
+                final = final and f
+                if v:
+                    out = True
+                    break
+        stack.discard(key)
+        if out or final or is_root:
+            self._releases[key] = out
+        return out, out or final or is_root
+
+    # -- flags read ----------------------------------------------------------
+
+    def flags_read(self, fi: FunctionInfo) -> FrozenSet[str]:
+        """Names of every ``flag_value("<literal>")`` (plus the token
+        ``os.environ`` for env reads) reachable from ``fi`` through
+        resolvable call edges."""
+        return self._flags_walk(fi, set())[0]
+
+    def _flags_walk(self, fi: FunctionInfo,
+                    stack: Set[int]) -> Tuple[FrozenSet[str], bool]:
+        """Returns ``(flags, final)`` — same taint discipline as
+        :meth:`_releases_walk`: a set accumulated under a cycle cut may
+        be missing the cycle's flags, so only clean results and the walk
+        root's (complete by the stack argument above) are memoized."""
+        key = id(fi.node)
+        if key in self._flags:
+            return self._flags[key], True
+        if key in stack:
+            return frozenset(), False       # cycle cut: provisional
+        is_root = not stack
+        stack.add(key)
+        out: Set[str] = set(direct_flag_reads(fi))
+        final = True
+        for callee in self.index._callees(fi):
+            v, f = self._flags_walk(callee, stack)
+            out |= v
+            final = final and f
+        stack.discard(key)
+        result = frozenset(out)
+        if final or is_root:
+            self._flags[key] = result
+        return result, final or is_root
+
+    # -- return dtype ---------------------------------------------------------
+
+    def return_dtype(self, fi: FunctionInfo) -> Optional[str]:
+        """The dtype every return statement of ``fi`` provably yields
+        (with parameters unknown), else None. Cycle-cut to None."""
+        key = id(fi.node)
+        if key in self._ret_dtype:
+            return self._ret_dtype[key]
+        self._ret_dtype[key] = None         # cycle cut
+        dts: Set[Optional[str]] = set()
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Return):
+                if node.value is None:
+                    dts.add(None)
+                else:
+                    dt, _, _ = _expr_dtype(node.value, {}, self, fi, None)
+                    dts.add(dt)
+        out = dts.pop() if len(dts) == 1 else None
+        self._ret_dtype[key] = out
+        return out
+
+
+def direct_flag_reads(fi: FunctionInfo) -> Set[str]:
+    """Literal flag/env reads in ``fi``'s own body."""
+    out: Set[str] = set()
+    for node in fi.own_nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        if d.split(".")[-1] in _FLAG_READERS and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.add(node.args[0].value)
+        elif d in _ENV_READERS:
+            out.add("os.environ")
+    return out
+
+
+def _shared(project: Project):
+    """One (Summaries, cfg-cache) pair per Project, shared by all three
+    rule families so the whole run stays inside the 5 s budget."""
+    state = getattr(project, "_dataflow_state", None)
+    if state is None:
+        state = (Summaries(project.index), {})
+        project._dataflow_state = state
+    return state
+
+
+def _cfg_for(project: Project, fi: FunctionInfo) -> FunctionCFG:
+    _, cache = _shared(project)
+    key = id(fi.node)
+    cfg = cache.get(key)
+    if cfg is None:
+        cfg = build_cfg(fi.node)
+        cache[key] = cfg
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Rule family 5: resource flow (page-leak)
+# ---------------------------------------------------------------------------
+
+_ACQUIRE_METHODS = {"allocate", "grow_to"}
+_ESCAPE_METHODS = {"append", "extend", "insert", "add", "setdefault",
+                   "update", "put"}
+
+
+class _LeakState:
+    """Immutable may-held state: frozenset of acquisition ids + the
+    variable bindings that let an escape discharge them."""
+
+    __slots__ = ("held", "binds")
+
+    def __init__(self, held: FrozenSet[int] = frozenset(),
+                 binds: FrozenSet[Tuple[str, int]] = frozenset()):
+        self.held = held
+        self.binds = binds
+
+    def __eq__(self, other):
+        return isinstance(other, _LeakState) and self.held == other.held \
+            and self.binds == other.binds
+
+    def __hash__(self):
+        return hash((self.held, self.binds))
+
+
+class _LeakAnalysis:
+    def __init__(self, acqs: Dict[int, Tuple[ast.Call, str, Optional[str]]]):
+        #: id(call) -> (call node, receiver dotted, bound var name|None)
+        self.acqs = acqs
+
+    def initial(self):
+        return _LeakState()
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        # both unions: held is MAY-held; binds union keeps every path's
+        # binding so an escape can discharge whichever acquisition the
+        # variable carries on the path actually taken (an acq held on a
+        # sibling path is not held there, so discharging it is harmless)
+        return _LeakState(a.held | b.held, a.binds | b.binds)
+
+    # -- transfer ------------------------------------------------------------
+
+    def transfer(self, state: _LeakState, block: Block):
+        stmt = block.stmt
+        if stmt is None:
+            return state, state
+        held, binds = set(state.held), set(state.binds)
+
+        # releases apply on BOTH edges (a release that raises has at
+        # least reached the pool; pool-internal errors are fatal anyway)
+        released = self._released_receivers(stmt)
+        if released is ALL_RECEIVERS:
+            held.clear()
+        elif released:
+            held = {a for a in held
+                    if self.acqs[a][1] not in released}
+        binds = {(v, a) for (v, a) in binds if a in held}
+        exc_state = _LeakState(frozenset(held), frozenset(binds))
+
+        # ownership transfer: the bound result is STORED beyond the
+        # frame (returned, yielded, or put into a container/attribute)
+        escaped = self._escaped_vars(stmt, {v for v, _ in binds})
+        if escaped:
+            gone = {a for (v, a) in binds if v in escaped}
+            held -= gone
+            binds = {(v, a) for (v, a) in binds if a in held}
+            exc_state = _LeakState(frozenset(held), frozenset(binds))
+
+        # acquisitions take effect on the NORMAL edge only (the raising
+        # acquisition never handed pages out); an acquisition sitting
+        # DIRECTLY in an escaping position (``return mgr.allocate(...)``,
+        # ``sink.append(mgr.allocate(...))``) transfers immediately
+        immediate = self._immediately_escaping_calls(stmt)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and id(node) in self.acqs \
+                    and id(node) not in immediate:
+                held.add(id(node))
+                var = self.acqs[id(node)][2]
+                if var is not None:
+                    binds = {(v, a) for (v, a) in binds if v != var}
+                    binds.add((var, id(node)))
+        return _LeakState(frozenset(held), frozenset(binds)), exc_state
+
+    def _immediately_escaping_calls(self, stmt) -> Set[int]:
+        subtrees = []
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and getattr(node, "value", None) is not None:
+                subtrees.append(node.value)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ESCAPE_METHODS:
+                subtrees.extend(node.args)
+                subtrees.extend(kw.value for kw in node.keywords)
+            elif isinstance(node, ast.Assign) \
+                    and any(isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in node.targets):
+                subtrees.append(node.value)
+        out: Set[int] = set()
+        for sub in subtrees:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Call) and id(n) in self.acqs:
+                    out.add(id(n))
+        return out
+
+    def _released_receivers(self, stmt):
+        """Receivers freed by this statement; ALL_RECEIVERS when a
+        resolved callee's summary says it releases (conservative: that
+        helper may free any pool handed to it)."""
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _RELEASE_METHODS:
+                recv = dotted(node.func.value)
+                if recv is not None:
+                    out.add(recv)
+            elif id(node) in self._releasing_calls:
+                return ALL_RECEIVERS
+        return out
+
+    def _escaped_vars(self, stmt, bound: Set[str]) -> Set[str]:
+        if not bound:
+            return set()
+        out: Set[str] = set()
+
+        def names_in(sub) -> Set[str]:
+            return {n.id for n in ast.walk(sub)
+                    if isinstance(n, ast.Name) and n.id in bound}
+
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and getattr(node, "value", None) is not None:
+                out |= names_in(node.value)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ESCAPE_METHODS:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    out |= names_in(arg)
+            elif isinstance(node, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    out |= names_in(node.value)
+        return out
+
+    _releasing_calls: FrozenSet[int] = frozenset()
+
+
+ALL_RECEIVERS = object()
+
+
+class PageLeakRule:
+    """Every ``allocate``/``grow_to`` acquisition in ``kvcache/`` +
+    ``inference/`` reaches ``free``/``truncate_pages``/ownership
+    transfer on ALL paths out of the acquiring function, exception
+    edges included."""
+
+    id = "page-leak"
+    protects = ("every paged acquisition (allocate/grow_to) in kvcache/"
+                "+inference/ reaches free/truncate_pages or an ownership"
+                " transfer on EVERY path out of the acquiring function "
+                "— exception edges included (the int8-page safety net)")
+    example = ("pages = self.mgr.allocate(rid, n)\n"
+               "self.cache.record(rid)   # raises -> pages leak\n"
+               "picked.append(pages)")
+
+    SCOPE = ("paddle_tpu/kvcache/", "paddle_tpu/inference/")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        index = project.index
+        summaries, _ = _shared(project)
+        for mod in project.iter_modules(self.SCOPE):
+            mi = index.by_rel[mod.rel]
+            for fi in mi.functions:
+                out.extend(self._check_function(project, mi, fi,
+                                                summaries))
+        return out
+
+    # -- per-function --------------------------------------------------------
+
+    def _check_function(self, project, mi, fi, summaries) -> List[Finding]:
+        local_pools = self._local_pools(fi)
+        acqs: Dict[int, Tuple[ast.Call, str, Optional[str]]] = {}
+        for node in fi.own_nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ACQUIRE_METHODS):
+                continue
+            recv = dotted(node.func.value)
+            if recv is None or recv == "self" or recv == "cls":
+                continue                    # the pool's own bookkeeping
+            if recv.split(".")[0] in local_pools:
+                continue                    # frame-local pool: dies here
+            acqs[id(node)] = (node, recv, None)
+        if not acqs:
+            return []
+        # bind acquisition results to their target variable
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and id(node.value) in acqs:
+                targets = [t.id for t in node.targets
+                           if isinstance(t, ast.Name)]
+                if targets:
+                    call, recv, _ = acqs[id(node.value)]
+                    acqs[id(node.value)] = (call, recv, targets[0])
+        analysis = _LeakAnalysis(acqs)
+        analysis._releasing_calls = self._releasing_calls(fi, summaries)
+        cfg = _cfg_for(project, fi)
+        states = solve_forward(cfg, analysis)
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for exit_block, via in ((cfg.exc_exit, "an exception path"),
+                                (cfg.exit, "a return path")):
+            st = states.get(exit_block.bid)
+            if st is None:
+                continue
+            for acq in sorted(st.held,
+                              key=lambda a: acqs[a][0].lineno):
+                if acq in seen:
+                    continue
+                seen.add(acq)
+                call, recv, _ = acqs[acq]
+                findings.append(Finding(
+                    fi.module.rel, call.lineno, self.id,
+                    f"pages acquired by {recv}.{call.func.attr}() in "
+                    f"'{fi.qualname}' can leave the function on {via} "
+                    "without free/truncate_pages or an ownership "
+                    "transfer — a leaked page never returns to the "
+                    "pool (exception edges count)",
+                    symbol=f"{fi.qualname}:{recv}.{call.func.attr}"))
+        return findings
+
+    @staticmethod
+    def _local_pools(fi) -> Set[str]:
+        """Names bound to a pool CONSTRUCTED in this frame — its pages
+        die with the object, so holding them is not a leak."""
+        out: Set[str] = set()
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                d = dotted(node.value.func) or ""
+                if d.split(".")[-1].endswith("Manager"):
+                    out |= {t.id for t in node.targets
+                            if isinstance(t, ast.Name)}
+        return out
+
+    def _releasing_calls(self, fi, summaries) -> FrozenSet[int]:
+        """Call nodes in ``fi`` that resolve to a helper whose summary
+        releases pages (the interprocedural call-site transfer)."""
+        index = summaries.index
+        mi = index.by_rel[fi.module.rel]
+        out: Set[int] = set()
+        for node in fi.own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _RELEASE_METHODS:
+                continue                    # direct release, handled inline
+            callee = index.resolve_call(fi, node)
+            if callee is not None and summaries.releases(callee):
+                out.add(id(node))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Rule family 6: dtype flow
+# ---------------------------------------------------------------------------
+
+_DTYPE_TAILS = {
+    "int8": "int8", "int16": "int16", "int32": "int32", "int64": "int64",
+    "uint8": "uint8", "bfloat16": "bfloat16", "float16": "float16",
+    "float32": "float32", "float64": "float64", "bool_": "bool",
+}
+_FLOATS = {"bfloat16": 16, "float16": 16, "float32": 32, "float64": 64}
+_INTS = {"int8", "int16", "int32", "int64", "uint8"}
+_CONTRACTIONS = {"einsum", "dot", "matmul", "tensordot", "dot_general"}
+_DTYPE_FACTORIES = {"zeros", "ones", "full", "empty", "arange", "asarray",
+                    "array", "zeros_like", "ones_like", "full_like",
+                    "normal", "uniform"}
+
+TOP = None          # unknown dtype
+WEAK = "weak"       # python scalar literal: weak-typed, never flags
+
+
+def _dtype_token(node) -> Optional[str]:
+    """jnp.float32 / np.int8 / "float32" -> canonical dtype name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_TAILS.get(node.value)
+    d = dotted(node)
+    if d is not None:
+        return _DTYPE_TAILS.get(d.split(".")[-1])
+    return None
+
+
+#: integer widths for promotion; equal-width signed/unsigned mixes
+#: (int8 x uint8 really promotes to int16) fall to TOP — an unknown
+#: dtype can only lose recall, a wrong one mints false findings
+_INT_RANK = {"int8": 8, "uint8": 8, "int16": 16, "int32": 32,
+             "int64": 64}
+
+
+def _promote(a: str, b: str) -> Optional[str]:
+    if a == b:
+        return a
+    if a in _FLOATS and b in _FLOATS:
+        return a if _FLOATS[a] >= _FLOATS[b] else b
+    if a in _FLOATS:
+        return a
+    if b in _FLOATS:
+        return b
+    ra, rb = _INT_RANK.get(a), _INT_RANK.get(b)
+    if ra is None or rb is None or ra == rb:
+        return TOP
+    return a if ra > rb else b
+
+
+def _is_narrowing_pair(a: str, b: str) -> bool:
+    """True when mixing ``a``/``b`` silently widens a narrow value
+    (bf16/f16/int8...) into f32/f64 — the promotion this family exists
+    to flag."""
+    wide = {"float32", "float64"}
+    narrow = set(_INTS) | {"bfloat16", "float16"}
+    return (a in wide and b in narrow) or (b in wide and a in narrow)
+
+
+class _DtypeInfo:
+    __slots__ = ("dt", "dequant", "explicit")
+
+    def __init__(self, dt=TOP, dequant=False, explicit=False):
+        self.dt = dt
+        self.dequant = dequant
+        self.explicit = explicit
+
+
+def _expr_dtype(node, env: Dict[str, Tuple[Optional[str], bool]],
+                summaries: Optional[Summaries], fi, sink: Optional[list]
+                ) -> Tuple[Optional[str], bool, bool]:
+    """(dtype, dequant-without-scale, explicit-cast) of ``node`` under
+    ``env``. ``sink`` collects (node, kind, detail) findings when given
+    (the post-fixpoint reporting pass); pass None while solving."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, (int, float, complex)) \
+                and not isinstance(node.value, bool):
+            return WEAK, False, False
+        return TOP, False, False
+    if isinstance(node, ast.Name):
+        dt, deq = env.get(node.id, (TOP, False))
+        return dt, deq, False
+    if isinstance(node, ast.Call):
+        return _call_dtype(node, env, summaries, fi, sink)
+    if isinstance(node, ast.BinOp):
+        l = _expr_dtype(node.left, env, summaries, fi, sink)
+        r = _expr_dtype(node.right, env, summaries, fi, sink)
+        if isinstance(node.op, ast.MatMult):
+            _contraction_check(node, [(node.left, l), (node.right, r)],
+                               False, sink, fi)
+        elif isinstance(node.op, (ast.Add, ast.Sub, ast.Div, ast.Mod,
+                                  ast.Pow)):
+            _promotion_check(node, (node.left, l), (node.right, r),
+                             sink, fi)
+        dts = [x[0] for x in (l, r) if x[0] not in (TOP, WEAK)]
+        dt = dts[0] if len(dts) == 1 else (
+            _promote(dts[0], dts[1]) if len(dts) == 2 else TOP)
+        dequant = (l[1] or r[1]) and not isinstance(node.op, ast.Mult)
+        return dt, dequant, False
+    if isinstance(node, ast.UnaryOp):
+        return _expr_dtype(node.operand, env, summaries, fi, sink)
+    if isinstance(node, (ast.IfExp,)):
+        b = _expr_dtype(node.body, env, summaries, fi, sink)
+        o = _expr_dtype(node.orelse, env, summaries, fi, sink)
+        if b[0] == o[0]:
+            return b[0], b[1] or o[1], False
+        return TOP, False, False
+    return TOP, False, False
+
+
+def _call_dtype(node: ast.Call, env, summaries, fi, sink):
+    func = node.func
+    d = dotted(func)
+    tail = d.split(".")[-1] if d else (
+        func.attr if isinstance(func, ast.Attribute) else None)
+
+    if isinstance(func, ast.Attribute) and func.attr == "astype" \
+            and node.args:
+        base = _expr_dtype(func.value, env, summaries, fi, sink)
+        dt = _dtype_token(node.args[0])
+        if dt is None:
+            return TOP, False, True         # .astype(x.dtype): explicit
+        dequant = base[0] in _INTS and dt in _FLOATS
+        return dt, dequant, True
+
+    if tail in _CONTRACTIONS:
+        operands = node.args[1:] if tail == "einsum" else node.args[:2]
+        infos = [(op, _expr_dtype(op, env, summaries, fi, sink))
+                 for op in operands]
+        has_pref = any(kw.arg == "preferred_element_type"
+                       for kw in node.keywords)
+        _contraction_check(node, infos, has_pref, sink, fi)
+        dts = [i[1][0] for i in infos if i[1][0] not in (TOP, WEAK)]
+        dt = dts[0] if dts and all(x == dts[0] for x in dts) else TOP
+        return dt, False, False
+
+    if tail in _DTYPE_FACTORIES:
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dt = _dtype_token(kw.value)
+                if dt is not None:
+                    return dt, False, True
+        for arg in node.args:
+            dt = _dtype_token(arg)
+            if dt is not None:
+                return dt, False, True
+        if tail in ("asarray", "array", "zeros_like", "ones_like",
+                    "full_like") and node.args:
+            return _expr_dtype(node.args[0], env, summaries, fi, sink)
+        return TOP, False, False
+
+    # dtype constructor call: jnp.float32(x)
+    dt = _dtype_token(func)
+    if dt is not None:
+        return dt, False, True
+
+    # interprocedural: resolved callee with a provable return dtype
+    if summaries is not None and fi is not None:
+        callee = summaries.index.resolve_call(fi, node)
+        if callee is not None:
+            rdt = summaries.return_dtype(callee)
+            if rdt is not None:
+                return rdt, False, False
+    return TOP, False, False
+
+
+def _contraction_check(node, infos, has_pref, sink, fi):
+    if sink is None or has_pref:
+        return
+    for op_node, (dt, dequant, _x) in infos:
+        if dequant:
+            sink.append((node, "dequant",
+                         "an int8-origin value dequantized without a "
+                         "scale multiply reaches this contraction"))
+            break
+    known = [(op_node, dt, expl) for op_node, (dt, _dq, expl) in infos
+             if dt not in (TOP, WEAK)]
+    if len(known) >= 2:
+        dts = {dt for _, dt, _ in known}
+        if len(dts) > 1 and not any(expl for _, _, expl in known):
+            a, b = sorted(dts)[:2]
+            sink.append((node, "mixed",
+                         f"mixed-dtype contraction ({a} x {b}) — the "
+                         "accumulator/output dtype is inherited, not "
+                         "chosen; cast explicitly or pass "
+                         "preferred_element_type"))
+
+
+def _promotion_check(node, left, right, sink, fi):
+    if sink is None:
+        return
+    (ln, (ldt, _ld, lex)), (rn, (rdt, _rd, rex)) = (left, right)
+    if ldt in (TOP, WEAK) or rdt in (TOP, WEAK) or lex or rex:
+        return
+    if _is_narrowing_pair(ldt, rdt):
+        sink.append((node, "promote",
+                     f"silent promotion ({ldt} {type(node.op).__name__}"
+                     f" {rdt}) widens a bf16/int8 chain to f32 — "
+                     "2x activation bytes unless this is explicit"))
+
+
+class _DtypeAnalysis:
+    def __init__(self, summaries, fi):
+        self.summaries = summaries
+        self.fi = fi
+
+    def initial(self):
+        return frozenset()                   # env as frozenset of items
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if a == b:
+            return a
+        da, db = dict(a), dict(b)
+        out = {}
+        for k in da.keys() & db.keys():
+            va, vb = da[k], db[k]
+            if va[0] == vb[0]:
+                out[k] = (va[0], va[1] and vb[1])
+        return frozenset(out.items())
+
+    def transfer(self, state, block, sink=None):
+        stmt = block.stmt
+        if stmt is None:
+            return state, state
+        env = dict(state)
+        if isinstance(stmt, ast.Assign):
+            val = _expr_dtype(stmt.value, env, self.summaries, self.fi,
+                              sink)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = (val[0], val[1])
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(t := stmt.target, ast.Name):
+                cur = env.get(t.id, (TOP, False))
+                synth = ast.BinOp(left=ast.Name(id=t.id, ctx=ast.Load()),
+                                  op=stmt.op, right=stmt.value)
+                ast.copy_location(synth, stmt)
+                ast.fix_missing_locations(synth)
+                env_l = dict(env)
+                env_l[t.id] = cur
+                val = _expr_dtype(synth, env_l, self.summaries, self.fi,
+                                  sink)
+                env[t.id] = (val[0], val[1])
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            v = stmt.value
+            if v is not None:
+                _expr_dtype(v, env, self.summaries, self.fi, sink)
+        elif isinstance(stmt, ast.expr):     # test blocks
+            _expr_dtype(stmt, env, self.summaries, self.fi, sink)
+        out = frozenset(env.items())
+        return out, out
+
+
+class DtypeFlowRule:
+    """Propagate a dtype lattice through functions reachable from
+    jit/pallas roots in ``ops/`` + ``models/``; flag silent f32
+    promotion in bf16/int8 chains, dequant-without-scale, and
+    mixed-dtype contractions."""
+
+    id = "dtype-flow"
+    protects = ("traced code in ops/+models/ never silently promotes a "
+                "bf16/int8 chain to f32, never contracts mixed dtypes "
+                "implicitly, and never feeds a dequantized int8 value "
+                "to a contraction without its scale — dtype is a "
+                "CHOICE, made with .astype/preferred_element_type")
+    example = ("scores = jnp.einsum('ij,jk->ik', x_bf16, w_f32)"
+               "  # mixed contraction")
+
+    SCOPE = ("paddle_tpu/ops/", "paddle_tpu/models/")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        summaries, _ = _shared(project)
+        seen_nodes: Set[int] = set()
+        for fi in project.index.traced_functions():
+            if not fi.module.rel.startswith(self.SCOPE):
+                continue
+            if not isinstance(fi.node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda)):
+                continue
+            analysis = _DtypeAnalysis(summaries, fi)
+            cfg = _cfg_for(project, fi)
+            states = solve_forward(cfg, analysis)
+            sink: List[Tuple[ast.AST, str, str]] = []
+            for block in cfg.blocks:
+                st = states.get(block.bid)
+                if st is not None and block.stmt is not None:
+                    analysis.transfer(st, block, sink=sink)
+            for node, kind, detail in sink:
+                if id(node) in seen_nodes:
+                    continue
+                seen_nodes.add(id(node))
+                out.append(Finding(
+                    fi.module.rel, node.lineno, self.id,
+                    f"{detail} (inside traced function "
+                    f"'{fi.qualname}')",
+                    symbol=f"{fi.qualname}:{kind}"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Rule family 7: cache-key completeness
+# ---------------------------------------------------------------------------
+
+class CacheKeyRule:
+    """Any trace-time external input (``flag_value``/``os.environ``)
+    read by a program a compile cache stores must be derivable from the
+    cache's key expression — generalizing PR 8's stale-program defect
+    (a flag flip silently keeps serving the old program; the runtime
+    RecompileDetector cannot even see it)."""
+
+    id = "cache-key"
+    protects = ("every trace-time external input (flag_value/os."
+                "environ) read by a cached-compile body is derivable "
+                "from that cache's key expression — a set_flags flip "
+                "RETRACES as a counted recompile instead of silently "
+                "serving the stale program (PR 8's defect, as a rule)")
+    example = ("key = (bucket,)                    # no flag in the key\n"
+               "self._compiled[key] = self._build()  # body reads "
+               "flag_value('f')")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        index = project.index
+        summaries, _ = _shared(project)
+        roots_by_fn = self._roots_by_enclosing(index)
+        for mod in project.iter_modules(("paddle_tpu/",)):
+            mi = index.by_rel[mod.rel]
+            for fi in mi.functions:
+                out.extend(self._check_function(index, summaries, mi, fi,
+                                                roots_by_fn))
+        return out
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _roots_by_enclosing(index) -> Dict[str, List[FunctionInfo]]:
+        """jit roots grouped by the qualname prefix of the function that
+        builds them (module-scoped)."""
+        out: Dict[str, List[FunctionInfo]] = {}
+        for root in index.traced_roots():
+            out.setdefault(root.module.rel, []).append(root)
+        return out
+
+    def _check_function(self, index, summaries, mi, fi, roots_by_fn
+                        ) -> List[Finding]:
+        stores = self._cache_stores(fi)
+        if not stores:
+            return []
+        out: List[Finding] = []
+        for assign, target_name in stores:
+            builder = index.resolve_call(fi, assign.value)
+            if builder is None:
+                continue                    # resolution gap: conservative
+            traced = self._traced_flags(index, summaries, builder,
+                                        roots_by_fn)
+            if not traced:
+                continue
+            key_flags = self._key_flags(index, summaries, mi, fi, assign)
+            for flag in sorted(traced - key_flags):
+                out.append(Finding(
+                    fi.module.rel, assign.lineno, self.id,
+                    f"compile cache '{target_name}' in '{fi.qualname}' "
+                    f"stores a traced program that reads "
+                    f"flag_value({flag!r}) but the cache key never "
+                    "derives from it — a set_flags flip keeps serving "
+                    "the stale program (key it like _prefill_flags, or "
+                    "baseline with the reason staleness is safe)",
+                    symbol=f"{fi.qualname}:{target_name}:{flag}"))
+        return out
+
+    def _cache_stores(self, fi) -> List[Tuple[ast.Assign, str]]:
+        """Assignments that store a BUILT program into cache state: a
+        subscript store (dict cache) or an attribute store that the same
+        function guards with an is-None/!=/not-in check (one-time
+        unguarded builds are trace-host-state's problem, not a cache)."""
+        guards: Set[str] = set()
+        for node in fi.own_nodes():
+            if isinstance(node, ast.Compare) and node.ops:
+                if isinstance(node.ops[0], (ast.NotIn, ast.In)):
+                    d = dotted(node.comparators[0])
+                    if d is not None:
+                        guards.add(d)
+                elif isinstance(node.ops[0], (ast.Is, ast.IsNot, ast.Eq,
+                                              ast.NotEq)):
+                    for side in (node.left, node.comparators[0]):
+                        d = dotted(side)
+                        if d is not None:
+                            guards.add(d)
+        out: List[Tuple[ast.Assign, str]] = []
+        for node in fi.own_nodes():
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if isinstance(t, ast.Subscript):
+                d = dotted(t.value)
+                if d is not None and d in guards:
+                    out.append((node, d))
+            elif isinstance(t, ast.Attribute):
+                d = dotted(t)
+                if d is not None and d in guards:
+                    out.append((node, d))
+        return out
+
+    def _traced_flags(self, index, summaries, builder, roots_by_fn
+                      ) -> FrozenSet[str]:
+        """Flags read under trace by the programs ``builder`` builds:
+        roots enclosed in the builder (or in builders it calls),
+        closed over the traced call graph."""
+        visited: Set[int] = set()
+        queue = [builder]
+        building: List[FunctionInfo] = []
+        while queue:
+            fn = queue.pop()
+            if id(fn.node) in visited or len(visited) > 200:
+                continue
+            visited.add(id(fn.node))
+            building.append(fn)
+            queue.extend(index._callees(fn))
+        roots: List[FunctionInfo] = []
+        for fn in building:
+            for root in roots_by_fn.get(fn.module.rel, ()):
+                if root.qualname.startswith(fn.qualname + ".<locals>") \
+                        or root.node is fn.node:
+                    roots.append(root)
+        flags: Set[str] = set()
+        for root in roots:
+            flags |= summaries.flags_read(root)
+        return frozenset(flags)
+
+    def _key_flags(self, index, summaries, mi, fi, assign
+                   ) -> FrozenSet[str]:
+        """Flags derivable from the cache's key side: literal reads in
+        the enclosing function plus the transitive reads of every
+        helper it calls OUTSIDE the builder statement itself
+        (e.g. ``_prefill_flags()`` in the key tuple or the freshness
+        compare)."""
+        skip = {id(n) for n in ast.walk(assign)}
+        flags: Set[str] = set(direct_flag_reads(fi))
+        for node in fi.own_nodes():
+            if not isinstance(node, ast.Call) or id(node) in skip:
+                continue
+            callee = index.resolve_call(fi, node)
+            if callee is not None:
+                flags |= summaries.flags_read(callee)
+        return frozenset(flags)
+
+
+DATAFLOW_RULES = (PageLeakRule(), DtypeFlowRule(), CacheKeyRule())

@@ -124,11 +124,18 @@ class SourceModule:
 
 
 class Project:
-    """All modules under ``root``'s configured sub-roots, parsed once."""
+    """All modules under ``root``'s configured sub-roots, parsed once.
 
-    def __init__(self, root: Path, roots: Sequence[str] = DEFAULT_ROOTS):
+    ``only`` (an optional set of repo-relative posix paths) restricts
+    parsing to those files — the ``--changed-only`` pre-commit mode,
+    where the caller has already computed the reverse-dependency
+    closure of a git diff."""
+
+    def __init__(self, root: Path, roots: Sequence[str] = DEFAULT_ROOTS,
+                 only: Optional[set] = None):
         self.root = Path(root)
         self.roots = tuple(roots)
+        self.only = only
         self.modules: List[SourceModule] = []
         self.parse_errors: List[Finding] = []
         self.parse_count = 0
@@ -138,6 +145,8 @@ class Project:
                 continue
             for p in sorted(base.rglob("*.py")):
                 rel = p.relative_to(self.root).as_posix()
+                if only is not None and rel not in only:
+                    continue
                 try:
                     mod = SourceModule(p, rel, p.read_text())
                 except SyntaxError as e:
@@ -262,6 +271,38 @@ class Report:
                          for f in self.findings],
             "stale_baseline": self.stale,
             "exit_code": self.exit_code,
+        }, indent=1, sort_keys=True)
+
+    def to_sarif(self, rules: Sequence = ()) -> str:
+        """SARIF 2.1.0 document for CI annotation (GitHub code
+        scanning et al.). New findings are ``error``, baselined ones
+        ``note``; the line-number-free fingerprint rides along as a
+        partial fingerprint so annotation dedup survives line drift."""
+        rule_meta = [{"id": r.id,
+                      "shortDescription": {"text": r.protects}}
+                     for r in rules]
+        results = []
+        for f in self.findings:
+            baselined = f.fingerprint in self.baseline.entries
+            results.append({
+                "ruleId": f.rule,
+                "level": "note" if baselined else "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": f.line}}}],
+                "partialFingerprints": {"tpuLint/v1": f.fingerprint},
+            })
+        return json.dumps({
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                        ".json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {"name": "tpu-lint",
+                                    "rules": rule_meta}},
+                "results": results,
+            }],
         }, indent=1, sort_keys=True)
 
     def to_text(self) -> str:

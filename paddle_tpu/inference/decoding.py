@@ -34,11 +34,18 @@ from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
 def _prefill_flags() -> Tuple:
     """Mutable host state the prefill/unified programs bake in at trace
     time (``llama._mm_prefill`` reads FLAGS_serving_a8w8_prefill to pick
-    the int8 prefill matmul). Every compile-cache key that guards such a
-    program includes this tuple, so a ``set_flags`` flip RETRACES — a
+    the int8 prefill matmul; the kernel-backend selectors in
+    ``ops/_common.use_pallas`` and ``ops/rms_norm._use_pallas_rms`` read
+    their flags the same way). Every compile-cache key that guards such
+    a program includes this tuple, so a ``set_flags`` flip RETRACES — a
     counted ``paddle_runtime_recompiles_total`` miss — instead of
-    silently keeping the stale program (tpu-lint: trace-host-state)."""
-    return (bool(flag_value("serving_a8w8_prefill")),)
+    silently keeping the stale program. The backend flags were the
+    cache-key rule's first triage catch (tpu-lint: trace-host-state +
+    cache-key): before PR 15 a ``use_pallas_*`` flip kept serving the
+    old backend's program forever."""
+    return (bool(flag_value("serving_a8w8_prefill")),
+            bool(flag_value("use_pallas_kernels")),
+            bool(flag_value("use_pallas_rms_norm")))
 
 
 @dataclass
@@ -682,6 +689,40 @@ class ContinuousBatchingEngine:
         unified path just queues the suffix tokens into the next ragged
         step."""
         picked = []                # (slot, req, pages_row, lp, n_cached)
+        recorded = []              # deferred stats-only cache accounting
+        try:
+            picked = self._admit_window(picked, recorded)
+            for req, r_lp, r_cached, r_shared, r_cow in recorded:
+                # stats-only lookup accounting (counters + cache_hit
+                # event), deferred until the WHOLE window lands so a
+                # mid-window raise can't count a hit for a request that
+                # gets rolled back and re-admitted next step
+                try:
+                    self.cache.record(req.rid, r_lp, r_cached, r_shared,
+                                      cow=r_cow is not None,
+                                      trace_id=req.trace_id)
+                except Exception:
+                    # a broken stats sink must not tear down an admitted
+                    # window (events.emit discipline): rolling back here
+                    # would re-admit and DOUBLE-count the hits already
+                    # recorded — undercounting once is the safe failure
+                    pass
+        except BaseException:
+            # admission is atomic across the whole window: requests are
+            # admitted only once every picked entry lands, so anything
+            # raising between an allocate and the return must free EVERY
+            # picked allocation and requeue the requests at the head in
+            # original order — rolling back only the current request
+            # would orphan earlier picks: their pages leak (never reach
+            # _slot_rid, so cancel/retire can't find them) and the
+            # requests silently vanish (tpu-lint page-leak)
+            for _, req, _, _, _ in reversed(picked):
+                self.mgr.free(req.rid)
+                self._queue.insert(0, req)
+            raise
+        return picked
+
+    def _admit_window(self, picked, recorded):
         for s in range(self.num_slots):
             if self._slot_rid[s] is not None or not self._queue:
                 continue
@@ -726,18 +767,20 @@ class ContinuousBatchingEngine:
                             f"pool only holds {self.mgr.usable_pages}; "
                             "enlarge num_pages")
                 break                    # pool full: wait for a completion
-            self._queue.pop(0)
             if self.cache is not None:
                 pages = self.mgr.allocate(req.rid, total, shared=shared)
-                if cow_src is not None:
-                    # the suffix's first write lands mid-page: append into
-                    # a private device-side copy, never the shared page
-                    self.mgr.copy_page(cow_src, pages[len(shared)])
-                self.cache.record(req.rid, lp, n_cached, len(shared),
-                                  cow=cow_src is not None,
-                                  trace_id=req.trace_id)
             else:
                 pages = self.mgr.allocate(req.rid, total)
+            # ownership transfers into ``picked`` IMMEDIATELY (the
+            # rollback in _admit_pick owns the pages from here); the
+            # pop comes after, so an allocate raise leaves the request
+            # queued with nothing to undo
+            picked.append((s, req, pages, lp, n_cached))
+            self._queue.pop(0)
+            if self.cache is not None and cow_src is not None:
+                # the suffix's first write lands mid-page: append into
+                # a private device-side copy, never the shared page
+                self.mgr.copy_page(cow_src, pages[len(shared)])
             self.mgr._lens[req.rid] = lp
             if memory_armed[0]:
                 # per-request HBM attribution: cached-vs-fresh page
@@ -745,7 +788,8 @@ class ContinuousBatchingEngine:
                 memory_ledger.note_request(
                     self.mgr, req.rid, prompt_len=lp,
                     cached_pages=len(shared), trace_id=req.trace_id)
-            picked.append((s, req, pages, lp, n_cached))
+            if self.cache is not None:
+                recorded.append((req, lp, n_cached, len(shared), cow_src))
         return picked
 
     def _admit(self, params):

@@ -450,7 +450,11 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
     out = jnp.einsum("bsd,dh->bsh", attn, gather_out(_dense(p["wo"])))
     if mp_axis is not None:
         out = lax.psum(out, mp_axis)
-    x = x + out
+    # int8-quantized weights dequantize to f32 (weight_dequantize): pin
+    # the residual carry dtype exactly like the serving scan paths do,
+    # or every layer silently widens the whole activation stream to f32
+    # (tpu-lint dtype-flow triage; no-op cast for dense bf16 weights)
+    x = x + out.astype(x.dtype)
 
     xn = _rms(x, p["ln2"], config.rms_norm_eps)
     g = jnp.einsum("bsh,hm->bsm", xn, gather_in(_dense(p["w_gate"])))
@@ -458,7 +462,7 @@ def _decoder_layer_manual(p, x, cos, sin, config: LlamaConfig, mp_axis,
     dn = jnp.einsum("bsm,mh->bsh", jax.nn.silu(g) * u, gather_out(_dense(p["w_down"])))
     if mp_axis is not None:
         dn = lax.psum(dn, mp_axis)
-    return x + dn
+    return x + dn.astype(x.dtype)
 
 
 #: fsdp-sharded dim of each stacked layer weight (leading dim is L)

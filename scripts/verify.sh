@@ -39,8 +39,10 @@ if [ "$only_sentinel" = "1" ]; then
     exit $?
 fi
 
-echo "== [1/8] tpu-lint (python -m paddle_tpu.analysis) =="
+echo "== [1/8] tpu-lint (python -m paddle_tpu.analysis; incl. dataflow: page-leak/dtype-flow/cache-key) =="
+s0=$SECONDS
 python -m paddle_tpu.analysis || exit $?
+echo "tpu-lint stage wall: $((SECONDS - s0))s (in-process budget 5s — regressions show here)"
 
 echo "== [2/8] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_obs_overhead.py || exit $?
