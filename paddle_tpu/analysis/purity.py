@@ -69,11 +69,22 @@ class TraceWallClockRule(TracedRuleBase):
         return out
 
 
+#: jax.random draw functions whose first argument is the PRNG key
+_JAX_DRAWS = {"uniform", "normal", "categorical", "bernoulli", "randint",
+              "truncated_normal", "gumbel", "exponential", "laplace",
+              "choice", "permutation", "bits", "gamma", "beta", "poisson"}
+
+#: key constructors: a draw keyed by an INLINE literal-seeded constructor
+#: is a constant, not a random variable
+_JAX_KEY_CTORS = {"PRNGKey", "key"}
+
+
 class TraceRandomRule(TracedRuleBase):
     id = "trace-random"
-    protects = ("traced code never uses Python/NumPy RNG — host RNG "
-                "draws once at trace time and replays the same value "
-                "forever; use jax.random with an explicit key")
+    protects = ("traced code never uses Python/NumPy RNG, and every "
+                "jax.random draw threads its key in from outside — a "
+                "host RNG call or a literal-seeded inline PRNGKey draws "
+                "once at trace time and replays the same value forever")
     example = "def kernel(x): return x * random.random()  # under jit"
 
     def run(self, project: Project) -> Iterable[Finding]:
@@ -86,19 +97,44 @@ class TraceRandomRule(TracedRuleBase):
                 d = dotted(node.func)
                 if d is None:
                     continue
-                if d.startswith("random.") and _is_stdlib_random(mi, d):
-                    hit = d
-                elif d.startswith(_RANDOM_PREFIXES[1:]):
-                    hit = d
+                if ((d.startswith("random.") and _is_stdlib_random(mi, d))
+                        or d.startswith(_RANDOM_PREFIXES[1:])):
+                    msg = (f"host RNG call {d}() inside traced function "
+                           f"'{fi.qualname}' — traces once, replays "
+                           "forever; use jax.random with a threaded key")
+                elif self._constant_keyed_jax_draw(d, node):
+                    # jax.random itself is keyed and traceable — the
+                    # hazard is ONLY a key built inline from a literal
+                    # seed: the "draw" is then one fixed constant baked
+                    # into the program, identical across rows and steps.
+                    # A threaded key (a Name, parameter, fold_in chain)
+                    # is the sanctioned pattern and is not flagged.
+                    msg = (f"constant-keyed draw {d}() inside traced "
+                           f"function '{fi.qualname}' — its inline "
+                           "literal-seeded PRNGKey makes it one fixed "
+                           "value baked into the program, identical "
+                           "across rows and steps; thread a per-call "
+                           "key in as an argument")
                 else:
                     continue
                 out.append(Finding(
-                    fi.module.rel, node.lineno, self.id,
-                    f"host RNG call {hit}() inside traced function "
-                    f"'{fi.qualname}' — traces once, replays forever; "
-                    "use jax.random with a threaded key",
-                    symbol=f"{fi.qualname}:{hit}"))
+                    fi.module.rel, node.lineno, self.id, msg,
+                    symbol=f"{fi.qualname}:{d}"))
         return out
+
+    @staticmethod
+    def _constant_keyed_jax_draw(d: str, node: ast.Call) -> bool:
+        parts = d.split(".")
+        if parts[-1] not in _JAX_DRAWS or "random" not in parts[:-1]:
+            return False
+        key = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "key"), None)
+        if not isinstance(key, ast.Call):
+            return False
+        kd = dotted(key.func)
+        if kd is None or kd.split(".")[-1] not in _JAX_KEY_CTORS:
+            return False
+        return all(isinstance(a, ast.Constant) for a in key.args)
 
 
 class TraceHostSyncRule(TracedRuleBase):

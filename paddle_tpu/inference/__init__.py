@@ -21,3 +21,9 @@ from .decoding import (  # noqa: F401
 from .speculative import (  # noqa: F401
     Drafter, DraftModel, NgramDrafter, SpeculationTelemetry,
 )
+from . import sampling  # noqa: F401
+from . import constrain  # noqa: F401
+from .sampling import SamplerConfig  # noqa: F401
+from .constrain import (  # noqa: F401
+    GrammarArena, TokenDFA, compile_regex, json_grammar, json_regex,
+)

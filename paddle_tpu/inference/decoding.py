@@ -29,6 +29,9 @@ from ..observability.profiling import chain_armed as _chain_armed
 from ..observability.profiling import note_chain as _note_chain
 from ..observability.runtime import recompiles
 from ..profiler.record import emit_span, emit_spans, make_span, spans_armed
+from . import constrain as _constrain
+from . import sampling as _sampling
+from .sampling import SamplerConfig
 
 
 def _prefill_flags() -> Tuple:
@@ -301,6 +304,10 @@ class _Request:
     done: bool = False
     max_new_tokens: Optional[int] = None  # None -> engine config default
     trace_id: str = ""                    # serving-layer trace correlation
+    sampler: Optional[SamplerConfig] = None   # None -> greedy row
+    grammar: Any = None                   # TokenDFA; None -> unconstrained
+    gstart: int = -1                      # arena GLOBAL start state
+    gstate_host: int = -1                 # host DFA mirror (LOCAL ids)
 
 
 class ContinuousBatchingEngine:
@@ -368,7 +375,8 @@ class ContinuousBatchingEngine:
                  step_tokens: Optional[int] = None,
                  speculative: bool = False, spec_k: int = 4,
                  drafter=None, fused_tail: bool = False,
-                 mesh=None, mp_axis: str = "mp"):
+                 mesh=None, mp_axis: str = "mp",
+                 grammar_states: int = 0):
         from ..models import llama as L
         from ..ops.paged_attention import PagedKVCacheManager
         self._L = L
@@ -455,6 +463,30 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros((num_slots,), np.int32)
         self._bt = np.zeros((num_slots, self._table_width), np.int32)
         self._rng = jax.random.key(self.config.seed)
+        # per-row sampling epilogue state (inference/sampling.py): the
+        # (seeds, temps, top_k, top_p) device arrays admission writes
+        # lazily, like the token carry. Defaults are greedy — a slot
+        # never inherits a retired request's temperature.
+        self._samp_dev = _sampling.init_row_state(num_slots)
+        # per-row grammar DFA state; -1 = unconstrained (mask is a no-op)
+        self._gstate_dev = jnp.full((num_slots,), -1, jnp.int32)
+        # the grammar arena is ALLOCATED AT CONSTRUCTION with a fixed
+        # shape — it is a program input, so sizing it lazily would
+        # change the compiled signature and recompile. grammar_states=0
+        # keeps a 1-row placeholder (constrained submit then raises with
+        # the sizing hint); size it for the grammars you will serve
+        # (json_grammar(max_depth=2) on a byte-ish vocab needs ~650).
+        self._arena = _constrain.GrammarArena(
+            mcfg.vocab_size, capacity_states=max(1, int(grammar_states)))
+        # sampling epilogue compiled LAZILY: until the first
+        # ``sampler=``/``grammar=`` submit the step programs trace the
+        # argmax-only twins (sampling.greedy_rows/spec_greedy_rows) —
+        # byte-identical greedy output at the pre-sampling compile
+        # cost. The first such submit flips this STICKY flag and drops
+        # the compiled programs: ONE counted recompile (the flag is in
+        # the recompile key), after which mixed greedy/sampled/
+        # constrained storms still run O(1) programs.
+        self._epilogue_on = False
         # legacy (unified=False) per-shape compile caches; the unified
         # path needs exactly ONE compiled step function
         self._compiled_prefill: Dict[Tuple, Callable] = {}
@@ -506,14 +538,12 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     "speculative decoding rides the unified ragged step; "
                     "construct with unified=True")
-            if self.config.do_sample:
-                raise ValueError(
-                    "speculative decoding is greedy-only: accept/reject "
-                    "compares drafts against the model's argmax, and "
-                    "committed tokens are byte-identical to "
-                    "non-speculative greedy decoding by construction. "
-                    "Sampling needs a rejection-sampling verifier "
-                    "(see README) — disable do_sample or speculative")
+            # sampling composes with speculation since the rejection-
+            # sampling verifier (sampling.spec_sample_rows) landed:
+            # greedy rows keep verify-by-argmax byte-identity, sampled
+            # rows accept draft j with prob p_target(d_j) and resample
+            # the residual — distribution-identical to the non-spec
+            # sampler (tests/test_sampling.py property test)
             from .speculative import NgramDrafter, SpeculationTelemetry
             self.drafter = drafter or NgramDrafter()
             self.spec = SpeculationTelemetry()
@@ -646,7 +676,19 @@ class ContinuousBatchingEngine:
         return len(self._queue)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               trace_id: str = "") -> int:
+               trace_id: str = "", sampler: Optional[SamplerConfig] = None,
+               grammar=None, grammar_prefix=None) -> int:
+        """Queue a request. ``sampler`` carries the per-request
+        temperature/top-k/top-p/seed (None follows the engine's
+        ``GenerationConfig``: a per-request sampler derived from it when
+        ``do_sample``, plain greedy otherwise); ``grammar`` is a
+        ``constrain.TokenDFA`` constraining every generated token;
+        ``grammar_prefix`` pre-advances the DFA through tokens this
+        request already generated elsewhere (the router's failover
+        resume, whose continuation prompt contains them). Both ride the
+        unified step's in-program epilogue, so a mixed
+        greedy/sampled/constrained batch stays ONE dispatch of ONE
+        compiled program."""
         budget = (max_new_tokens if max_new_tokens is not None
                   else self.config.max_new_tokens)
         prompt = np.asarray(prompt, np.int32)
@@ -657,11 +699,63 @@ class ContinuousBatchingEngine:
                 f"max_seq_len={self.max_seq_len}; raise max_seq_len or "
                 "truncate the prompt (silent page clamping would corrupt "
                 "the sequence's KV)")
+        if (sampler is not None or grammar is not None) \
+                and not self._unified:
+            # the legacy pipeline's epilogue is the engine-wide
+            # GenerationConfig sampler baked into three programs; the
+            # per-row runtime-parameter epilogue exists only in the
+            # unified step. A genuinely unsupported combo, so it stays
+            # a construction-time contract (README "Sampling &
+            # constrained decoding").
+            raise ValueError(
+                "per-request sampling / constrained decoding ride the "
+                "unified ragged step's in-program epilogue; construct "
+                "the engine with unified=True (legacy unified=False "
+                "supports only the engine-wide GenerationConfig sampler)")
         rid = self._next_rid
         self._next_rid += 1
+        if sampler is None and self.config.do_sample and self._unified:
+            # engine-wide do_sample maps onto the same per-request
+            # epilogue: one derived SamplerConfig per request, seeded
+            # from (config seed, rid) so streams are replayable
+            sampler = SamplerConfig(temperature=self.config.temperature,
+                                    top_k=self.config.top_k,
+                                    top_p=self.config.top_p)
+        if sampler is not None:
+            sampler = sampler.resolved(
+                self.config.seed * 1000003 + 7919 * rid)
+        if (sampler is not None or grammar is not None) \
+                and not self._epilogue_on:
+            # first sampled/constrained request: swap the argmax-only
+            # tail for the full in-program epilogue — ONE counted
+            # recompile (the flag is in the recompile key), sticky for
+            # the engine's lifetime
+            self._epilogue_on = True
+            self._unified_step = None
+            self._spec_step = None
+        gstart, ghost = -1, -1
+        if grammar is not None:
+            # ValueError on vocab mismatch / arena overflow — at submit,
+            # never mid-step
+            gstart = self._arena.register(grammar)
+            _sampling.set_grammar_states(self._arena.used)
+            ghost = grammar.start
+            for t in (grammar_prefix if grammar_prefix is not None
+                      else ()):
+                ghost = grammar.advance(ghost, int(t))
+                if ghost == _constrain.ILLEGAL:
+                    raise ValueError(
+                        f"grammar_prefix token {int(t)} is illegal in "
+                        f"grammar {grammar.pattern!r} — the resumed "
+                        "stream cannot have produced it")
+            _sampling.note_request("constrained")
+        elif sampler is not None and sampler.temperature > 0:
+            _sampling.note_request("sampled")
         self._queue.append(_Request(rid, prompt,
                                     max_new_tokens=max_new_tokens,
-                                    trace_id=trace_id))
+                                    trace_id=trace_id, sampler=sampler,
+                                    grammar=grammar, gstart=gstart,
+                                    gstate_host=ghost))
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -980,10 +1074,30 @@ class ContinuousBatchingEngine:
         keeps decoding (caller may advance its position mirror)."""
         rid = self._slot_rid[s]
         req = self._live[rid]
+        mode = ("constrained" if req.grammar is not None else
+                "sampled" if (req.sampler is not None
+                              and req.sampler.temperature > 0) else None)
         for t in tokens:
-            req.tokens.append(int(t))
+            t = int(t)
+            if req.grammar is not None:
+                # host DFA mirror: the audit half of constrained
+                # decoding (the mask is the mechanism). Device and host
+                # walk the same table, so a disagreement means real
+                # corruption — count it, emit the event, keep serving.
+                if req.grammar.legal(req.gstate_host, t):
+                    req.gstate_host = req.grammar.advance(
+                        req.gstate_host, t)
+                else:
+                    _sampling.note_violation()
+                    emit_event("constraint_violation", request_id=rid,
+                               trace_id=req.trace_id, token=t,
+                               state=int(req.gstate_host),
+                               pattern=req.grammar.pattern)
+            if mode is not None:
+                _sampling.note_tokens(mode, 1)
+            req.tokens.append(t)
             if self.token_callback is not None:
-                self.token_callback(rid, int(t))
+                self.token_callback(rid, t)
                 if self._slot_rid[s] != rid:
                     return False   # callback cancelled this request
             if self._complete(req):
@@ -1101,6 +1215,27 @@ class ContinuousBatchingEngine:
 
     # -- unified ragged step (the default serving path) ----------------------
 
+    def _set_row_sampler(self, s: int, req: "_Request") -> None:
+        """Write one admitted request's sampler/grammar parameters into
+        the per-row device arrays (lazy ``.at[s].set``, same discipline
+        as the token carry). ALWAYS runs — a greedy request resets the
+        slot, so reuse never inherits a retired row's temperature or a
+        stale grammar state."""
+        self._samp_dev = _sampling.set_row(self._samp_dev, s, req.sampler)
+        g = -1
+        if req.grammar is not None:
+            # arena rows are the grammar block rebased by its offset:
+            # global = (gstart - local start) + local host-mirror state
+            g = req.gstart - req.grammar.start + req.gstate_host
+        self._gstate_dev = self._gstate_dev.at[s].set(jnp.int32(g))
+
+    def _epilogue_active(self) -> bool:
+        """Any live request exercising the sampling epilogue (sampled or
+        constrained rows) — gates the armed ``cbe.sample_epilogue``
+        profiling tap."""
+        return any(r.sampler is not None or r.grammar is not None
+                   for r in self._live.values())
+
     def enable_fused_tail(self) -> "ContinuousBatchingEngine":
         """Install the profile-guided decode-tail megaregion (the
         fusion pass's ``decode_tail`` region). Idempotent. Enabled
@@ -1130,57 +1265,79 @@ class ContinuousBatchingEngine:
         admission timing never recompile anything."""
         L = self._L
         mcfg = self.model_config
-        cfg = self.config
         n_rows = self.num_slots
         mesh, mp_axis = self._mesh, self._mp_axis
+        # lazy epilogue: until the first sampler/grammar submit the
+        # program traces the argmax-only tail and no grammar mask —
+        # the pre-sampling compute graph at the pre-sampling compile
+        # cost (greedy output is byte-identical either way)
+        epilogue = self._epilogue_on
+        tail = _sampling.sample_rows if epilogue else _sampling.greedy_rows
         if self._fused_tail:
             # the fused decode-tail twin: SAME compute graph (the
-            # builder receives the model step + sampler as injected
-            # callables) fed from the packed plan — byte-identical
-            # emitted tokens, one compile, two plan uploads
+            # builder receives the model step + sampling epilogue as
+            # injected callables) fed from the packed plan —
+            # byte-identical emitted tokens, one compile, two plan
+            # uploads
             from ..jit import fusion as _fusion
 
             def model_step(params, ids, token_row, positions, kv_lens,
-                           last_idx, k_pages, v_pages, bt):
-                return L.ragged_step(params, ids, token_row, positions,
-                                     kv_lens, last_idx, k_pages, v_pages,
-                                     bt, mcfg, mesh=mesh, mp_axis=mp_axis)
+                           last_idx, k_pages, v_pages, bt, gst, gtable):
+                hook = (lambda lg: _constrain.mask_logits(
+                    lg.astype(jnp.float32), gst, gtable)) \
+                    if epilogue else None
+                return L.ragged_step(
+                    params, ids, token_row, positions, kv_lens, last_idx,
+                    k_pages, v_pages, bt, mcfg, mesh=mesh,
+                    mp_axis=mp_axis, logits_epilogue=hook)
 
-            def sample_fn(logits, key):
-                return _sample(logits, key, cfg)
-
-            return _fusion.build_fused_unified_step(model_step, sample_fn,
-                                                    n_rows)
+            return _fusion.build_fused_unified_step(
+                model_step, tail, n_rows)
 
         def run(params, ids, use_carry, token_row, positions, kv_lens,
-                last_idx, sample_mask, tok, k_pages, v_pages, bt, key):
+                last_idx, sample_mask, tok, gstate, samp, gtable,
+                k_pages, v_pages, bt):
             def micro(carry, xs):
-                tok, kp, vp, key = carry
+                tok, gst, kp, vp = carry
                 ids_k, uc_k, tr_k, pos_k, kvl_k, li_k, sm_k = xs
                 row_c = jnp.clip(tr_k, 0, n_rows - 1)
                 # decode slots take the row's carry token (last sample);
                 # prefill slots take the host-fed prompt tokens
                 ids_eff = jnp.where(uc_k, jnp.take(tok, row_c), ids_k)
+                # the grammar mask rides the model's logits-epilogue
+                # hook: applied BEFORE the sampling epilogue so
+                # constrained rows renormalize over legal tokens only
+                # (an exact no-op for unconstrained rows — greedy
+                # byte-identity)
+                hook = (lambda lg: _constrain.mask_logits(
+                    lg.astype(jnp.float32), gst, gtable)) \
+                    if epilogue else None
                 logits, kp, vp = L.ragged_step(
                     params, ids_eff, tr_k, pos_k, kvl_k, li_k, kp, vp,
-                    bt, mcfg, mesh=mesh, mp_axis=mp_axis)
-                key, sub = jax.random.split(key)
-                nxt = _sample(logits, sub, cfg)            # (R,)
+                    bt, mcfg, mesh=mesh, mp_axis=mp_axis,
+                    logits_epilogue=hook)
+                # the in-program sampling epilogue (sampling.sample_rows):
+                # per-row temperature/top-k/top-p + counter-based PRNG
+                # keyed on the token's sequence position (= this round's
+                # kv_len), greedy rows bit-exact argmax. No key threads
+                # through the carry — the position IS the counter.
+                nxt, ngst = tail(logits, kvl_k, samp, gst, gtable)
                 # emit the INPUT carry: step outputs chain across steps
                 # and a finished prefill's first sample arrives with the
                 # row's first decode round (same contract as the legacy
                 # decode chunk)
                 emit = tok
                 tok = jnp.where(sm_k, nxt, tok)
-                return (tok, kp, vp, key), emit
+                gst = jnp.where(sm_k, ngst, gst)
+                return (tok, gst, kp, vp), emit
 
-            (tok, k_pages, v_pages, _), toks = jax.lax.scan(
-                micro, (tok, k_pages, v_pages, key),
+            (tok, gstate, k_pages, v_pages), toks = jax.lax.scan(
+                micro, (tok, gstate, k_pages, v_pages),
                 (ids, use_carry, token_row, positions, kv_lens, last_idx,
                  sample_mask))
-            return toks, tok, k_pages, v_pages             # toks (K, R)
+            return toks, tok, gstate, k_pages, v_pages     # toks (K, R)
 
-        return jax.jit(run, donate_argnums=(9, 10))
+        return jax.jit(run, donate_argnums=(12, 13))
 
     def _plan_step(self):
         """Host-side layout of one unified step: simulate ``chunk``
@@ -1311,6 +1468,7 @@ class ContinuousBatchingEngine:
             # cold rows just start at 0 — one code path for all three
             # legacy programs
             self._pend[s] = np.asarray(req.prompt[nc:], np.int32)
+            self._set_row_sampler(s, req)
         if not self._live:
             if self._check_invariants:
                 self.mgr.check_conservation()
@@ -1327,7 +1485,8 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.unified_step",
                 (self.num_slots, self.chunk, self._step_tokens,
-                 self._table_width, self._fused_tail, self.num_chips)
+                 self._table_width, self._fused_tail, self.num_chips,
+                 self._epilogue_on)
                 + self._unified_flags)
             self._unified_step = self._build_unified_step()
         # armed-only continuous-profiling taps: the plan -> dispatch ->
@@ -1347,22 +1506,23 @@ class ContinuousBatchingEngine:
         # tokens that actually run through prefill THIS step (cancelled
         # mid-prefill requests never inflate the skip-ratio math)
         self._prefill_tokens += sum(fed)
-        self._rng, sub = jax.random.split(self._rng)
         if fresh:
             c0 = time.perf_counter()   # dispatch-only window, like legacy
         t0_ns = time.perf_counter_ns() if spans_armed() else 0
         if self._fused_tail:
-            toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
-                self._unified_step(
-                    params, jnp.asarray(plan_tt), jnp.asarray(plan_tr),
-                    self._tok_dev, self.mgr.k_pages, self.mgr.v_pages,
-                    jnp.asarray(self._bt), sub)
+            (toks, self._tok_dev, self._gstate_dev, self.mgr.k_pages,
+             self.mgr.v_pages) = self._unified_step(
+                params, jnp.asarray(plan_tt), jnp.asarray(plan_tr),
+                self._tok_dev, self._gstate_dev, self._samp_dev,
+                self._arena.device_table(), self.mgr.k_pages,
+                self.mgr.v_pages, jnp.asarray(self._bt))
         else:
-            toks, self._tok_dev, self.mgr.k_pages, self.mgr.v_pages = \
-                self._unified_step(
-                    params, *(jnp.asarray(a) for a in plan),
-                    self._tok_dev, self.mgr.k_pages, self.mgr.v_pages,
-                    jnp.asarray(self._bt), sub)
+            (toks, self._tok_dev, self._gstate_dev, self.mgr.k_pages,
+             self.mgr.v_pages) = self._unified_step(
+                params, *(jnp.asarray(a) for a in plan),
+                self._tok_dev, self._gstate_dev, self._samp_dev,
+                self._arena.device_table(), self.mgr.k_pages,
+                self.mgr.v_pages, jnp.asarray(self._bt))
         if fresh:
             jax.block_until_ready(toks)
             recompiles.observe_compile("cbe.unified_step",
@@ -1375,6 +1535,11 @@ class ContinuousBatchingEngine:
                             dur_ns=tc1 - tc0)
             else:
                 _note_chain(op_name="cbe.unified_step", dur_ns=tc1 - tc0)
+            if self._epilogue_active():
+                # the sampling epilogue runs inside the dispatch above;
+                # this zero-duration tap makes it visible to the fusion
+                # pass's chain mining (REGIONS["sampling_epilogue"])
+                _note_chain(op_name="cbe.sample_epilogue", dur_ns=0)
             tc0 = tc1
         if t0_ns:
             # per-request phase bookkeeping over the dispatch window:
@@ -1450,11 +1615,16 @@ class ContinuousBatchingEngine:
         L = self._L
         mcfg = self.model_config
         mesh, mp_axis = self._mesh, self._mp_axis
+        n_rows, k1 = self.num_slots, self.spec_k + 1
+        # lazy epilogue, spec flavour: argmax + prefix-match verify
+        # until the first sampler/grammar submit (see _build_unified_step)
+        tail = (_sampling.spec_sample_rows if self._epilogue_on
+                else _sampling.spec_greedy_rows)
         if self._fused_tail:
             # fused decode tail, spec flavour: the same single ragged
-            # dispatch plus the verify epilogue IN-PROGRAM — the
-            # vectorized accepted-prefix count replaces the host's
-            # per-token compare loop (jit/fusion.py)
+            # dispatch plus the verify epilogue IN-PROGRAM — greedy rows
+            # the vectorized accepted-prefix count, sampled rows the
+            # rejection-sampling verifier (jit/fusion.py)
             from ..jit import fusion as _fusion
 
             def model_step(params, ids, token_row, positions, kv_lens,
@@ -1463,21 +1633,33 @@ class ContinuousBatchingEngine:
                                      kv_lens, cand_idx, k_pages, v_pages,
                                      bt, mcfg, mesh=mesh, mp_axis=mp_axis)
 
-            return _fusion.build_fused_spec_step(model_step, self.spec_k,
-                                                 self.num_slots)
+            return _fusion.build_fused_spec_step(
+                model_step, tail, self.spec_k, n_rows)
 
         def run(params, ids, token_row, positions, kv_lens, cand_idx,
+                drafts, draft_len, sampled, gstate, samp, gtable,
                 k_pages, v_pages, bt):
             logits, kp, vp = L.ragged_step(
                 params, ids, token_row, positions, kv_lens, cand_idx,
                 k_pages, v_pages, bt, mcfg, mesh=mesh, mp_axis=mp_axis)
-            # greedy-only by construction (__init__ rejects do_sample):
-            # the in-program argmax keeps the fence at (slots*(k+1),)
-            # int32 instead of shipping full (C, V) logits to the host
-            toks = jnp.argmax(logits.astype(jnp.float32), axis=-1)
-            return toks.astype(jnp.int32), kp, vp
+            # the speculative sampling epilogue (spec_sample_rows):
+            # greedy rows keep the per-candidate argmax + prefix-match
+            # verify (byte-identical to the pre-sampling program),
+            # sampled rows run lossless rejection sampling — the fence
+            # stays (slots, k+1) int32 + (slots,) accepted instead of
+            # shipping full (C, V) logits to the host
+            lg = logits.reshape(n_rows, k1, -1)
+            pos_base = jnp.take(positions,
+                                cand_idx.reshape(n_rows, k1)[:, 0])
+            toks, accepted, ngst = tail(
+                lg, drafts, draft_len, pos_base, samp, gstate, gtable)
+            # only rows that really committed a token advance their
+            # grammar state (a mid-prefill constrained row's candidate
+            # slot holds garbage)
+            gstate = jnp.where(sampled, ngst, gstate)
+            return toks, accepted, gstate, kp, vp
 
-        return jax.jit(run, donate_argnums=(6, 7))
+        return jax.jit(run, donate_argnums=(12, 13))
 
     def _plan_spec(self):
         """Host layout of one speculative round. Every decode row claims
@@ -1494,13 +1676,14 @@ class ContinuousBatchingEngine:
         ids = np.zeros((T,), np.int32)
         token_row = np.full((T,), -1, np.int32)
         positions = np.zeros((T,), np.int32)
-        # per-row padded drafts for the fused in-program verify (only
-        # the fused tail consumes them — the unfused path skips the
-        # allocation and fills entirely)
-        fused = self._fused_tail
-        drafts = (np.zeros((n_rows, max(self.spec_k, 1)), np.int32)
-                  if fused else None)
-        draft_len = np.zeros((n_rows,), np.int32) if fused else None
+        # per-row padded drafts for the in-program verify epilogue
+        # (both tails consume them since the rejection-sampling
+        # verifier moved the accept/reject in-program)
+        drafts = np.zeros((n_rows, max(self.spec_k, 1)), np.int32)
+        draft_len = np.zeros((n_rows,), np.int32)
+        # rows committing a token this round (spec spans + completed
+        # prefills): gates the in-program grammar-state advance
+        sampled = np.zeros((n_rows,), bool)
         kv_lens = np.zeros((n_rows,), np.int32)
         cand_idx = np.zeros((n_rows * k1,), np.int32)
         info: Dict[int, tuple] = {}
@@ -1518,8 +1701,16 @@ class ContinuousBatchingEngine:
             # committed history (prompt + delivered tokens; the last
             # delivered token IS the carry whose K/V this round writes)
             history = [int(t) for t in req.prompt] + req.tokens
-            draft = [int(t) for t in
-                     self.drafter.draft(history, self.spec_k)]
+            if req.grammar is not None:
+                # constrained rows NEVER draft: candidates past the
+                # carry would be verified against un-advanced grammar
+                # states (the mask covers candidate 0 only), so an
+                # accepted draft could smuggle an illegal token. One
+                # candidate per round keeps every emitted token legal.
+                draft = []
+            else:
+                draft = [int(t) for t in
+                         self.drafter.draft(history, self.spec_k)]
             pos0 = int(self._pos[s])
             # clamp the draft to (a) the remaining token budget: a
             # round commits at most accepted+1 <= len(draft)+1 tokens
@@ -1559,10 +1750,9 @@ class ContinuousBatchingEngine:
                     args={"request_id": rid, "slot": s,
                           "drafted": len(draft)}))
             spans[s] = (pos0, [history[-1]] + draft, draft)
-            if fused:
-                if draft:
-                    drafts[s, :len(draft)] = draft
-                draft_len[s] = len(draft)
+            if draft:
+                drafts[s, :len(draft)] = draft
+            draft_len[s] = len(draft)
         emit_spans(draft_spans)
         budget = T - sum(1 + len(d) for _, _, d in spans.values())
         cursor = 0
@@ -1576,6 +1766,7 @@ class ContinuousBatchingEngine:
                 kv_lens[s] = pos0 + n
                 cand_idx[s * k1:s * k1 + n] = cursor + np.arange(n)
                 info[s] = ("spec", pos0, draft)
+                sampled[s] = True
                 cursor += n
             else:                             # prefilling
                 rem = len(self._pend[s])
@@ -1592,42 +1783,37 @@ class ContinuousBatchingEngine:
                 self._pos[s] = pos0 + n
                 if n == rem:
                     # prompt complete: this round's last logits are the
-                    # row's first (greedy) sample
+                    # row's first sample
                     cand_idx[s * k1] = cursor + n - 1
                     info[s] = ("first_sample",)
+                    sampled[s] = True
                     self._pend[s] = None
                 else:
                     self._pend[s] = self._pend[s][n:]
                 cursor += n
         return ((ids, token_row, positions, kv_lens, cand_idx), info, fed,
-                drafts, draft_len)
+                drafts, draft_len, sampled)
 
-    def _verify_spec(self, toks, info, accepted=None):
-        """Host accept/reject over the dispatch's per-candidate greedy
-        tokens: commit the longest drafted prefix that matches the
-        model's own argmax chain plus the bonus token, roll the paged KV
-        back on rejection, deliver through the shared
-        ``_deliver_tokens`` contract (callbacks, budget/EOS retire,
-        reentrant cancel). With the fused tail the accepted-prefix
-        count arrives precomputed from the program (``accepted``);
-        committed tokens are identical either way."""
-        k1 = self.spec_k + 1
+    def _verify_spec(self, toks, info, accepted):
+        """Host commit over the dispatch's per-row verified tokens
+        (``toks (slots, k+1)``, ``accepted (slots,)`` — both computed
+        in-program by the verify/sampling epilogue, fused and unfused
+        alike): deliver the accepted drafted prefix plus the epilogue's
+        token at the first rejected lane (greedy: the model's own
+        argmax; sampled: the rejection-sampling residual draw / the
+        bonus draw), roll the paged KV back on rejection, deliver
+        through the shared ``_deliver_tokens`` contract (callbacks,
+        budget/EOS retire, reentrant cancel)."""
         for s in sorted(info):
             rid = self._slot_rid[s]
             if rid is None:
                 continue                    # retired by a reentrant cancel
             entry = info[s]
             if entry[0] == "first_sample":
-                self._deliver_tokens(s, [int(toks[s * k1])])
+                self._deliver_tokens(s, [int(toks[s, 0])])
                 continue
             _, pos0, draft = entry
-            g = [int(t) for t in toks[s * k1:s * k1 + len(draft) + 1]]
-            if accepted is not None:
-                a = int(accepted[s])
-            else:
-                a = 0
-                while a < len(draft) and draft[a] == g[a]:
-                    a += 1
+            a = min(int(accepted[s]), len(draft))
             committed = pos0 + a + 1        # carry + accepted drafts
             self.spec.note_verify(len(draft), a)
             if a < len(draft):
@@ -1650,7 +1836,7 @@ class ContinuousBatchingEngine:
             self._pos[s] = committed
             self.mgr._lens[rid] = committed
             self._deliver_tokens(
-                s, [int(t) for t in draft[:a]] + [g[a]])
+                s, [int(t) for t in draft[:a]] + [int(toks[s, a])])
 
     def _step_spec(self, params) -> int:
         """One speculative round: host-only admission, drafting + page
@@ -1667,6 +1853,7 @@ class ContinuousBatchingEngine:
             self._bt[s, :len(pages)] = pages
             self._pend[s] = np.asarray(req.prompt[nc:], np.int32)
             self._reserved[s] = len(pages)
+            self._set_row_sampler(s, req)
         if not self._live:
             if self._check_invariants:
                 self.mgr.check_conservation()
@@ -1681,12 +1868,13 @@ class ContinuousBatchingEngine:
             recompiles.record_miss(
                 "cbe.spec_step",
                 (self.num_slots, self._spec_tokens, self.spec_k,
-                 self._table_width, self._fused_tail, self.num_chips)
+                 self._table_width, self._fused_tail, self.num_chips,
+                 self._epilogue_on)
                 + self._spec_flags)
             self._spec_step = self._build_spec_step()
         armed_chain = _chain_armed[0]
         tc0 = time.perf_counter_ns() if armed_chain else 0
-        plan, info, fed, drafts, draft_len = self._plan_spec()
+        plan, info, fed, drafts, draft_len, sampled = self._plan_spec()
         if armed_chain:
             tc1 = time.perf_counter_ns()
             _note_chain(op_name="cbe.plan_step", dur_ns=tc1 - tc0)
@@ -1695,25 +1883,21 @@ class ContinuousBatchingEngine:
         if fresh:
             c0 = time.perf_counter()
         t0_ns = time.perf_counter_ns() if spans_armed() else 0
-        accepted = None
-        if self._fused_tail:
-            toks, accepted, self.mgr.k_pages, self.mgr.v_pages = \
-                self._spec_step(
-                    params, *(jnp.asarray(a) for a in plan),
-                    jnp.asarray(drafts), jnp.asarray(draft_len),
-                    self.mgr.k_pages, self.mgr.v_pages,
-                    jnp.asarray(self._bt))
-        else:
-            toks, self.mgr.k_pages, self.mgr.v_pages = self._spec_step(
-                params, *(jnp.asarray(a) for a in plan), self.mgr.k_pages,
-                self.mgr.v_pages, jnp.asarray(self._bt))
+        # fused and unfused spec programs share one signature since the
+        # verify/sampling epilogue moved in-program for both
+        (toks, accepted, self._gstate_dev, self.mgr.k_pages,
+         self.mgr.v_pages) = self._spec_step(
+            params, *(jnp.asarray(a) for a in plan),
+            jnp.asarray(drafts), jnp.asarray(draft_len),
+            jnp.asarray(sampled), self._gstate_dev, self._samp_dev,
+            self._arena.device_table(), self.mgr.k_pages,
+            self.mgr.v_pages, jnp.asarray(self._bt))
         if fresh:
             jax.block_until_ready(toks)
             recompiles.observe_compile("cbe.spec_step",
                                        time.perf_counter() - c0)
         toks = np.asarray(toks)                    # the one fence
-        if accepted is not None:
-            accepted = np.asarray(accepted)
+        accepted = np.asarray(accepted)
         if armed_chain:
             tc1 = time.perf_counter_ns()
             if self._fused_tail:
@@ -1721,6 +1905,8 @@ class ContinuousBatchingEngine:
                             dur_ns=tc1 - tc0)
             else:
                 _note_chain(op_name="cbe.spec_step", dur_ns=tc1 - tc0)
+            if self._epilogue_active():
+                _note_chain(op_name="cbe.sample_epilogue", dur_ns=0)
             tc0 = tc1
         if t0_ns:
             t1_ns = time.perf_counter_ns()

@@ -167,6 +167,18 @@ REGIONS: Dict[str, FusionRegion] = {
         doc="eager grad transform -> per-param optimizer update chain "
             "fused into ONE bit-exact jitted megaregion "
             "(FusedOptimizerStep)"),
+    "sampling_epilogue": FusionRegion(
+        name="sampling_epilogue",
+        signatures=(("cbe.unified_step", "cbe.sample_epilogue"),
+                    ("cbe.sample_epilogue", "cbe.decode_tail"),
+                    ("cbe.spec_step", "cbe.sample_epilogue")),
+        target="engine",
+        doc="the distribution-faithful sampling epilogue (grammar mask "
+            "-> temperature/top-k/top-p -> counter-keyed categorical / "
+            "rejection-sampling verify) fused into the same decode-tail "
+            "program as the ragged step — mixed greedy/sampled/"
+            "constrained rows in ONE dispatch "
+            "(ContinuousBatchingEngine.enable_fused_tail)"),
 }
 
 
@@ -662,16 +674,22 @@ def pack_plan(ids, use_carry, token_row, positions, kv_lens, last_idx,
 def build_fused_unified_step(model_step: Callable, sample_fn: Callable,
                              num_rows: int):
     """The fused decode-tail twin of the engine's unified ragged step:
-    same compute graph (``model_step`` per micro-round, the sampler
+    same compute graph (``model_step`` per micro-round, the sampling
     epilogue, the carry select) — byte-identical tokens by construction
     — fed from the packed plan of :func:`pack_plan`.
 
     ``model_step(params, ids, token_row, positions, kv_lens, last_idx,
-    k_pages, v_pages, bt) -> (logits, k_pages, v_pages)``;
-    ``sample_fn(logits, key) -> (rows,) int32``.
+    k_pages, v_pages, bt, gstate, gtable) -> (logits, k_pages,
+    v_pages)`` (the grammar state rides into the model's logits
+    epilogue hook so masking happens before the sampler);
+    ``sample_fn(logits, pos_next, samp, gstate, gtable) ->
+    ((rows,) int32 tokens, (rows,) int32 grammar states)`` — the
+    counter-based epilogue needs no key input, so no PRNG state
+    threads through the scan carry.
     """
 
-    def run(params, plan_tt, plan_tr, tok, k_pages, v_pages, bt, key):
+    def run(params, plan_tt, plan_tr, tok, gstate, samp, gtable,
+            k_pages, v_pages, bt):
         ids = plan_tt[0]
         use_carry = plan_tt[1].astype(bool)
         token_row = plan_tt[2]
@@ -681,53 +699,60 @@ def build_fused_unified_step(model_step: Callable, sample_fn: Callable,
         sample_mask = plan_tr[2].astype(bool)
 
         def micro(carry, xs):
-            tok, kp, vp, key = carry
+            tok, gst, kp, vp = carry
             ids_k, uc_k, tr_k, pos_k, kvl_k, li_k, sm_k = xs
             row_c = jnp.clip(tr_k, 0, num_rows - 1)
             ids_eff = jnp.where(uc_k, jnp.take(tok, row_c), ids_k)
             logits, kp, vp = model_step(params, ids_eff, tr_k, pos_k,
-                                        kvl_k, li_k, kp, vp, bt)
-            key, sub = jax.random.split(key)
-            nxt = sample_fn(logits, sub)
+                                        kvl_k, li_k, kp, vp, bt,
+                                        gst, gtable)
+            nxt, ngst = sample_fn(logits, kvl_k, samp, gst, gtable)
             emit = tok
             tok = jnp.where(sm_k, nxt, tok)
-            return (tok, kp, vp, key), emit
+            gst = jnp.where(sm_k, ngst, gst)
+            return (tok, gst, kp, vp), emit
 
-        (tok, k_pages, v_pages, _), toks = jax.lax.scan(
-            micro, (tok, k_pages, v_pages, key),
+        (tok, gstate, k_pages, v_pages), toks = jax.lax.scan(
+            micro, (tok, gstate, k_pages, v_pages),
             (ids, use_carry, token_row, positions, kv_lens, last_idx,
              sample_mask))
-        return toks, tok, k_pages, v_pages
+        return toks, tok, gstate, k_pages, v_pages
 
-    return jax.jit(run, donate_argnums=(4, 5))
+    return jax.jit(run, donate_argnums=(7, 8))
 
 
-def build_fused_spec_step(model_step: Callable, spec_k: int,
-                          num_rows: int):
+def build_fused_spec_step(model_step: Callable, spec_sample_fn: Callable,
+                          spec_k: int, num_rows: int):
     """The fused decode-tail twin of the speculative step: the same
     single ragged dispatch plus the **verify epilogue in-program** — a
-    vectorized accepted-prefix count per row replaces the host's
-    per-token compare loop. The candidate token vector (and therefore
-    every committed token) is byte-identical to the unfused program.
+    vectorized accepted-prefix count (greedy rows) / rejection-sampling
+    accept-and-residual-resample (sampled rows) per row replaces the
+    host's per-token compare loop. Greedy candidate tokens (and
+    therefore every greedy committed token) stay byte-identical to the
+    unfused program.
 
-    Extra inputs: ``drafts (rows, spec_k) int32`` (padded drafted ids)
-    and ``draft_len (rows,) int32``.
+    ``spec_sample_fn(logits (rows, k+1, V), drafts, draft_len,
+    pos_base, samp, gstate, gtable) -> (toks (rows, k+1), accepted
+    (rows,), gstate')``. ``sampled (rows,) bool`` gates which rows
+    really committed a token this round — only those advance their
+    grammar state (a mid-prefill constrained row must not advance on a
+    garbage candidate).
     """
     k1 = spec_k + 1
 
     def run(params, ids, token_row, positions, kv_lens, cand_idx,
-            drafts, draft_len, k_pages, v_pages, bt):
+            drafts, draft_len, sampled, gstate, samp, gtable,
+            k_pages, v_pages, bt):
         logits, kp, vp = model_step(params, ids, token_row, positions,
                                     kv_lens, cand_idx, k_pages, v_pages,
                                     bt)
-        toks = jnp.argmax(logits.astype(jnp.float32),
-                          axis=-1).astype(jnp.int32)
-        g = toks.reshape(num_rows, k1)
-        lane = jnp.arange(max(spec_k, 1), dtype=jnp.int32)[None, :spec_k]
-        valid = lane < draft_len[:, None]
-        match = (drafts == g[:, :spec_k]) & valid
-        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                           axis=1).astype(jnp.int32)
-        return toks, accepted, kp, vp
+        lg = logits.reshape(num_rows, k1, -1)
+        pos_base = jnp.take(positions,
+                            cand_idx.reshape(num_rows, k1)[:, 0])
+        toks, accepted, ngst = spec_sample_fn(lg, drafts, draft_len,
+                                              pos_base, samp, gstate,
+                                              gtable)
+        gstate = jnp.where(sampled, ngst, gstate)
+        return toks, accepted, gstate, kp, vp
 
-    return jax.jit(run, donate_argnums=(8, 9))
+    return jax.jit(run, donate_argnums=(12, 13))

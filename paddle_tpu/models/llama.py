@@ -1204,7 +1204,8 @@ def prefill_paged_suffix(params, ids, seq_lens, start_pos, k_pages, v_pages,
 
 def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
                 k_pages, v_pages, block_tables, config: LlamaConfig,
-                mesh: Optional[Mesh] = None, mp_axis: str = "mp"):
+                mesh: Optional[Mesh] = None, mp_axis: str = "mp",
+                logits_epilogue=None):
     """One forward over a RAGGED packed token batch — the unified model
     step behind the engine's single-dispatch serving loop.
 
@@ -1307,6 +1308,11 @@ def ragged_step(params, ids, token_row, positions, kv_lens, last_idx,
     # full (T, V) logits the bucketed prefill paid for
     h_last = jnp.take(x[0], last_idx.astype(jnp.int32), axis=0)
     logits = jnp.einsum("rh,hv->rv", h_last, _dense(params["lm_head"]))
+    if logits_epilogue is not None:
+        # in-program hook over the per-row logits (e.g. the grammar
+        # mask of inference.constrain — applied BEFORE any sampling
+        # epilogue so constrained rows renormalize over legal tokens)
+        logits = logits_epilogue(logits)
     return (logits, kp_flat.reshape(k_pages.shape),
             vp_flat.reshape(v_pages.shape))
 

@@ -66,6 +66,11 @@ METRICS = {
     "paddle_spec_accepted_tokens_total": ("counter", ("replica",)),
     "paddle_spec_rejected_tokens_total": ("counter", ("replica",)),
     "paddle_spec_acceptance_ratio": ("gauge", ("replica",)),
+    # -- sampling epilogue / constrained decoding (inference/sampling.py) --
+    "paddle_sampling_requests_total": ("counter", ("mode",)),
+    "paddle_sampling_tokens_total": ("counter", ("mode",)),
+    "paddle_sampling_violations_total": ("counter", ()),
+    "paddle_sampling_grammar_states": ("gauge", ()),
     # -- prefix cache (kvcache/cache.py) -----------------------------------
     "paddle_kvcache_hits_total": ("counter", ()),
     "paddle_kvcache_misses_total": ("counter", ()),
@@ -101,6 +106,10 @@ EVENT_KINDS = {
     "cache_hit", "cache_evict",
     # speculative decoding (draft rejection -> per-row paged rollback)
     "spec_rollback",
+    # constrained decoding: the host-side audit of the in-program grammar
+    # mask caught an illegal token (a bug tripwire — the device mask
+    # should make this impossible)
+    "constraint_violation",
     # profile-guided fusion pass (jit/fusion.py): a hot chain installed
     # as a fused megaregion / skipped with a structured reason (stale
     # artifact symbol-missing, schema-mismatch, no-region, ...)

@@ -80,11 +80,16 @@ class ReplicaHandle:
                on_token: Optional[Callable[[int], None]] = None,
                defer_s: Optional[float] = None,
                no_shed: bool = False,
-               trace_id: Optional[str] = None) -> ServingRequest:
+               trace_id: Optional[str] = None,
+               sampler: Any = None,
+               grammar: Any = None,
+               grammar_prefix: Any = None) -> ServingRequest:
         return self._scheduler.submit(
             prompt, priority=priority, deadline_ms=deadline_ms,
             max_new_tokens=max_new_tokens, on_token=on_token,
-            defer_s=defer_s, no_shed=no_shed, trace_id=trace_id)
+            defer_s=defer_s, no_shed=no_shed, trace_id=trace_id,
+            sampler=sampler, grammar=grammar,
+            grammar_prefix=grammar_prefix)
 
     def cancel(self, rid: int) -> bool:
         return self._scheduler.cancel(rid)

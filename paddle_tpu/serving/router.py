@@ -126,6 +126,13 @@ class RouterRequest:
     # minted here at router submit, handed to every replica dispatch
     # (failover resubmissions included) so the request assembles into a
     # single span tree across replicas
+    sampler: Any = None                # SamplerConfig with seed MATERIAL-
+    # IZED at router submit: a failover resubmission must replay the same
+    # per-request stream, so the seed cannot be re-derived from the
+    # sibling engine's row ids
+    grammar: Any = None                # TokenDFA constraint; the dispatch
+    # passes the streamed tokens as grammar_prefix so the sibling's DFA
+    # resumes mid-string
     _submit_ns: int = field(default=0, repr=False)
     _failover_ns: int = field(default=0, repr=False)  # ejection time of a
     # pending failover; the next dispatch emits the router.failover_gap
@@ -226,14 +233,20 @@ class FleetRouter:
     def submit(self, prompt, priority: int = 0,
                deadline_ms: Optional[float] = None,
                max_new_tokens: Optional[int] = None,
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> RouterRequest:
+               on_token: Optional[Callable[[int], None]] = None,
+               sampler: Any = None,
+               grammar: Any = None) -> RouterRequest:
         """Route a request into the fleet. Same contract as
         ``ServingScheduler.submit`` (priority classes, deadline,
         per-request budget, synchronous ``on_token``), plus fleet
         semantics: with no routable replica the request parks and is
         retried each router step until a replica heals or its deadline
-        lapses. The returned handle's ``.stream`` survives failovers."""
+        lapses. The returned handle's ``.stream`` survives failovers —
+        including sampled ones: an unseeded ``sampler`` gets its seed
+        materialized HERE (not per replica), so a failover resubmission
+        replays the identical stream on the sibling; a ``grammar``
+        constraint likewise survives because each dispatch pre-advances
+        the DFA through the already-streamed tokens."""
         prompt = np.asarray(prompt, np.int32)
         rid = self._next_rid
         self._next_rid += 1
@@ -256,12 +269,18 @@ class FleetRouter:
                 f"request of {total} total tokens needs "
                 f"{eng.mgr.pages_for(total)} KV pages but each replica "
                 f"pool only holds {eng.mgr.usable_pages}")
+        if sampler is not None:
+            # pin the seed at the fleet boundary: replica row ids differ
+            # across siblings, so any seed derived below this layer
+            # would break failover replay
+            sampler = sampler.resolved(rid * 1000003 + 7919)
         req = RouterRequest(
             rid=rid, prompt=prompt, priority=int(priority), budget=budget,
             stream=TokenStream(rid, on_token=on_token), submit_t=now,
             deadline_t=None if deadline_ms is None
             else now + deadline_ms / 1e3,
-            trace_id=new_trace_id("req"))
+            trace_id=new_trace_id("req"),
+            sampler=sampler, grammar=grammar)
         req._submit_ns = time.perf_counter_ns()
         # a fatal (non-Exception) router death closes consumer streams
         # via the producer-liveness poll instead of leaving them blocked
@@ -381,8 +400,10 @@ class FleetRouter:
         r = self.replicas[rid]
         streamed = req.stream.tokens
         # failover continuation: prompt grows by the already-streamed
-        # tokens, budget shrinks by the same count — greedy decode then
-        # resumes byte-identically on the sibling
+        # tokens, budget shrinks by the same count — decode then resumes
+        # byte-identically on the sibling (greedy trivially; sampled
+        # because the epilogue keys its PRNG by absolute token position
+        # from a seed pinned at router submit)
         prompt = (req.prompt if not streamed else
                   np.concatenate([req.prompt,
                                   np.asarray(streamed, np.int32)]))
@@ -401,7 +422,11 @@ class FleetRouter:
                               max_new_tokens=budget, on_token=_on_token,
                               defer_s=defer_s,
                               no_shed=req.redispatched,
-                              trace_id=req.trace_id)
+                              trace_id=req.trace_id,
+                              sampler=req.sampler, grammar=req.grammar,
+                              grammar_prefix=(list(streamed)
+                                              if req.grammar is not None
+                                              and streamed else None))
         if req._failover_ns:
             if spans_armed():
                 # the attributed failover segment: replica ejected ->

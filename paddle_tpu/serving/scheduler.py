@@ -131,6 +131,11 @@ class ServingRequest:
     last_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     trace_id: str = ""                    # minted at submit; follows the
+    sampler: Any = None                   # SamplerConfig (None = engine
+    grammar: Any = None                   # default); TokenDFA constraint
+    grammar_prefix: Any = None            # already-emitted tokens to
+    # pre-advance the grammar through (failover continuations: the
+    # streamed tokens became prompt, so the DFA must resume mid-string)
     _span: Any = field(default=None, repr=False)  # request across layers
     _submit_ns: int = field(default=0, repr=False)  # perf-clock twin of
     # submit_t (submit_t may come from an injected/fake scheduler clock;
@@ -203,7 +208,10 @@ class ServingScheduler:
                on_token: Optional[Callable[[int], None]] = None,
                defer_s: Optional[float] = None,
                no_shed: bool = False,
-               trace_id: Optional[str] = None) -> ServingRequest:
+               trace_id: Optional[str] = None,
+               sampler: Any = None,
+               grammar: Any = None,
+               grammar_prefix: Any = None) -> ServingRequest:
         """Queue a request. ``priority`` is a class (0 = most urgent, FIFO
         within a class); ``deadline_ms`` is the admission SLO relative to
         now — a request still queued past it is shed; ``max_new_tokens``
@@ -223,6 +231,10 @@ class ServingScheduler:
         router mints one id per router request and passes it through
         every dispatch, failover resubmissions included, so the whole
         path assembles into ONE span tree); None mints a fresh id.
+        ``sampler`` (a ``SamplerConfig``) and ``grammar`` (a
+        ``TokenDFA``) ride the handle into the engine's in-program
+        sampling epilogue; ``grammar_prefix`` pre-advances the grammar
+        through tokens already emitted before a failover continuation.
         Returns the request handle (its
         ``.stream`` is the consumption surface). The handle may come back
         already shed if the queue cap evicts it immediately.
@@ -265,7 +277,9 @@ class ServingScheduler:
             submit_t=now,
             deadline_t=None if deadline_ms is None
             else now + deadline_ms / 1e3,
-            trace_id=trace_id or new_trace_id("req"))
+            trace_id=trace_id or new_trace_id("req"),
+            sampler=sampler, grammar=grammar,
+            grammar_prefix=grammar_prefix)
         req._span = self.metrics.span("request",
                                       args={"request_id": rid},
                                       trace_id=req.trace_id)
@@ -684,7 +698,8 @@ class ServingScheduler:
             self._order.pop(0)
             req.engine_rid = self.engine.submit(
                 req.prompt, max_new_tokens=req.max_new_tokens,
-                trace_id=req.trace_id)
+                trace_id=req.trace_id, sampler=req.sampler,
+                grammar=req.grammar, grammar_prefix=req.grammar_prefix)
             req.state = RequestState.RUNNING
             self._by_engine_rid[req.engine_rid] = req
             if armed:

@@ -310,13 +310,27 @@ def test_spec_storm_recompiles_o1():
 # config surface + telemetry
 # ---------------------------------------------------------------------------
 
-def test_speculative_requires_unified_and_greedy():
+def test_speculative_requires_unified():
     with pytest.raises(ValueError, match="unified"):
         _engine(speculative=True, unified=False)
-    with pytest.raises(ValueError, match="greedy"):
-        ContinuousBatchingEngine(
-            CFG, GenerationConfig(max_new_tokens=4, do_sample=True),
-            num_slots=2, max_seq_len=64, speculative=True)
+
+
+def test_speculative_accepts_do_sample():
+    """The old hard rejection of do_sample+speculative is gone: the
+    rejection-sampling verifier makes sampled speculation lossless, so
+    construction succeeds and sampled requests complete."""
+    eng = ContinuousBatchingEngine(
+        CFG, GenerationConfig(max_new_tokens=4, do_sample=True, seed=3),
+        num_slots=2, page_size=16, max_seq_len=64, chunk=2,
+        speculative=True)
+    rids = [eng.submit(p) for p in _prompts(2)]
+    out, steps = {}, 0
+    while len(out) < 2:
+        eng.step(PARAMS)
+        out.update(eng.collect())
+        steps += 1
+        assert steps < 2000
+    assert all(len(out[r]) == 4 for r in rids)
 
 
 def test_spec_metrics_and_statusz():

@@ -143,6 +143,12 @@ _JIT_PREAMBLE = """
             return x * np.random.uniform()
         return jax.jit(run)
     """, "np.random.uniform"),
+    ("trace-random", _JIT_PREAMBLE + """
+    def build():
+        def run(x):
+            return x * jax.random.uniform(jax.random.PRNGKey(0), x.shape)
+        return jax.jit(run)
+    """, "jax.random.uniform"),
     ("trace-host-sync", _JIT_PREAMBLE + """
     def build():
         def run(x):
@@ -173,6 +179,36 @@ def test_purity_rule_catches_synthetic_violation(tmp_path, rule_id, src,
     hits = rep.for_rule(rule_id)
     assert hits, f"{rule_id} missed the planted violation"
     assert any(token in f.message for f in hits)
+
+
+def test_trace_random_sanctions_threaded_keys(tmp_path):
+    """The sampling epilogue's idiom — keys built from a traced seed
+    array and threaded into the draw — is the SANCTIONED pattern: only
+    an inline literal-seeded PRNGKey (a constant masquerading as a
+    draw) trips the refined trace-random rule."""
+    rep = _run(tmp_path, {"paddle_tpu/mod.py": _JIT_PREAMBLE + """
+    def build():
+        def run(seeds, pos, logits):
+            keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            keys = jax.vmap(jax.random.fold_in)(keys, pos)
+            tok = jax.vmap(jax.random.categorical)(keys, logits)
+            u = jax.random.uniform(keys[0], logits.shape[1:])
+            v = jax.random.uniform(key=keys[0])
+            return tok, u, v
+        return jax.jit(run)
+    """}, ["trace-random"])
+    assert not rep.findings, [f.text() for f in rep.findings]
+
+
+def test_trace_random_constant_key_via_keyword(tmp_path):
+    rep = _run(tmp_path, {"paddle_tpu/mod.py": _JIT_PREAMBLE + """
+    def build():
+        def run(x):
+            return jax.random.normal(key=jax.random.key(42), shape=x.shape)
+        return jax.jit(run)
+    """}, ["trace-random"])
+    hits = rep.for_rule("trace-random")
+    assert len(hits) == 1 and "constant-keyed" in hits[0].message
 
 
 _LOCKY = """
