@@ -47,6 +47,15 @@ def _copy_page_slab(k_pages, v_pages, src, dst):
             v_pages.at[:, dst].set(v_pages[:, src]))
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _write_page_slab(k_pages, v_pages, k_slab, v_slab, dst):
+    # migration import: scatter one host-provided page slab (every layer)
+    # into the donated pool arrays; the page id rides as a traced scalar
+    # so N imported pages reuse one compiled program
+    return (k_pages.at[:, dst].set(k_slab),
+            v_pages.at[:, dst].set(v_slab))
+
+
 class RefcountedKVCacheManager(PagedKVCacheManager):
     """See module docstring. Drop-in for ``PagedKVCacheManager`` — the
     exclusive-ownership surface (``allocate``/``extend``/``free``/
@@ -174,6 +183,50 @@ class RefcountedKVCacheManager(PagedKVCacheManager):
         (page ids ride as traced scalars, so this compiles once)."""
         self.k_pages, self.v_pages = _copy_page_slab(
             self.k_pages, self.v_pages, jnp.int32(src), jnp.int32(dst))
+
+    # -- page-granular export/import (DCN migration) -------------------------
+
+    def take_free_pages(self, n: int) -> List[int]:
+        """Reserve ``n`` pages off the free list WITHOUT binding them to a
+        sequence or bumping refcounts — the migration import's staging
+        step. The caller owns them transiently and must hand every one
+        back (``give_back_pages``) or into the radix tree
+        (``adopt_cached``); anything else breaks conservation, which is
+        exactly what makes partial-transfer rollback auditable."""
+        if n < 0:
+            raise ValueError(f"cannot take {n} pages")
+        if len(self._free) < n:
+            self._oom("import", n)
+            raise MemoryError(
+                f"KV pool exhausted: need {n} pages, "
+                f"{len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def give_back_pages(self, pages: Sequence[int]) -> None:
+        """Return staged pages (from ``take_free_pages``) to the free
+        list — the rollback half of an aborted import."""
+        for p in pages:
+            if p == 0 or p in self._refs or p in self._cached:
+                raise RuntimeError(
+                    f"page {p} is not a staged page (reserved/live/cached)")
+        self._free.extend(pages)
+
+    def export_page(self, page: int):
+        """Read one page's K and V slabs (every layer) off the device as
+        a ``(k_slab, v_slab)`` pair of host arrays — the wire format's
+        payload unit."""
+        import numpy as np
+        return (np.asarray(self.k_pages[:, page]),
+                np.asarray(self.v_pages[:, page]))
+
+    def write_page(self, page: int, k_slab, v_slab) -> None:
+        """Scatter a host-provided slab pair into ``page`` device-side
+        (jitted, donated; compiles once — page ids are traced)."""
+        self.k_pages, self.v_pages = _write_page_slab(
+            self.k_pages, self.v_pages,
+            jnp.asarray(k_slab, self.k_pages.dtype),
+            jnp.asarray(v_slab, self.v_pages.dtype),
+            jnp.int32(page))
 
     # -- accounting ----------------------------------------------------------
 

@@ -71,6 +71,12 @@ METRICS = {
     "paddle_sampling_tokens_total": ("counter", ("mode",)),
     "paddle_sampling_violations_total": ("counter", ()),
     "paddle_sampling_grammar_states": ("gauge", ()),
+    # -- multi-host serving / DCN page migration (serving/multihost.py) -----
+    "paddle_migration_bytes_total": ("counter", ()),
+    "paddle_migration_pages_total": ("counter", ()),
+    "paddle_migration_requests_total": ("counter", ("outcome",)),
+    "paddle_migration_seconds": ("histogram", ()),
+    "paddle_host_state": ("gauge", ("host",)),
     # -- prefix cache (kvcache/cache.py) -----------------------------------
     "paddle_kvcache_hits_total": ("counter", ()),
     "paddle_kvcache_misses_total": ("counter", ()),
@@ -102,6 +108,9 @@ EVENT_KINDS = {
     "replica_drained", "failover",
     # elastic mesh resize (chip-level fault -> re-shard -> rejoin)
     "chip_lost", "mesh_resized",
+    # multi-host serving: an engine PROCESS died / a live request's KV
+    # pages crossed a host boundary (graceful drain or loss recovery)
+    "host_lost", "page_migration",
     # prefix cache
     "cache_hit", "cache_evict",
     # speculative decoding (draft rejection -> per-row paged rollback)

@@ -66,6 +66,7 @@ class FlightRecorder:
         self._router = None
         self._signals = None
         self._elastic = None
+        self._multihost = None
         self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
         self.dumps = 0
 
@@ -121,6 +122,15 @@ class FlightRecorder:
         embeds the resize timeline (``ElasticServingController.__init__``
         wires this; a later controller replaces the earlier one)."""
         self._elastic = controller
+
+    def attach_multihost(self, router) -> None:
+        """Multi-host fleet: its ``multihost_snapshot()`` — per-host
+        endpoint health, transport stats and the page-migration timeline
+        (bytes/pages/latency per transfer) — lands in ``multihost.json``
+        of every bundle, so a ``host_lost_<id>`` auto-dump embeds the
+        migration record (``HostFleetRouter.__init__`` wires this; a
+        later fleet replaces the earlier one)."""
+        self._multihost = router
 
     def attach_signals(self, bus) -> None:
         """Sensor plane: the SignalBus's ``history_snapshot()`` — metric
@@ -255,6 +265,15 @@ class FlightRecorder:
                 el = {"error": repr(e)}
             members["elastic.json"] = json.dumps(
                 el, default=str, indent=1).encode()
+        if self._multihost is not None:
+            # the multi-host fleet view: endpoint health + the page-
+            # migration timeline (a torn fleet must not lose the bundle)
+            try:
+                mh = self._multihost.multihost_snapshot()
+            except Exception as e:
+                mh = {"error": repr(e)}
+            members["multihost.json"] = json.dumps(
+                mh, default=str, indent=1).encode()
         if self._signals is not None:
             # the sensor plane's bounded window: series, signal trends
             # and anomalies leading up to this dump (a torn bus must not

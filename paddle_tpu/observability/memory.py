@@ -88,6 +88,10 @@ MEM_CLASSES = ("weights", "kv_live", "kv_spec", "kv_cached", "kv_free",
 #: short-lived test engines cannot grow the process-global ledger forever
 MAX_POOLS = 16
 
+#: migration-timeline entries kept in memory (oldest dropped; the
+#: cumulative totals are unbounded counters and never lose bytes)
+MAX_MIGRATIONS = 64
+
 
 # ---------------------------------------------------------------------------
 # pure helpers (the ONE place these derivations live)
@@ -276,6 +280,12 @@ class MemoryLedger:
         self._last_reject_key = None
         self.audits = 0
         self.last_oom: Optional[Dict[str, Any]] = None
+        # cross-host page-migration books (fed by the multi-host router;
+        # NOT a MEM_CLASS — migrated bytes land in kv_* when the
+        # destination pool is observed, this tracks the TRANSFERS)
+        self._migration: Dict[str, int] = {
+            "bytes": 0, "pages": 0, "requests": 0}
+        self._migration_log: list = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -326,6 +336,36 @@ class MemoryLedger:
             self._c_rejects = None
             self.audits = 0
             self.last_oom = None
+            self._migration = {"bytes": 0, "pages": 0, "requests": 0}
+            self._migration_log = []
+
+    # -- cross-host migration accounting ------------------------------------
+
+    def note_migration(self, *, nbytes: int, pages: int, requests: int = 1,
+                       seconds: float = 0.0, src_host=None, dst_host=None,
+                       outcome: str = "ok") -> None:
+        """Account one request's KV-page transfer across a host boundary
+        (the multi-host router feeds this per migrated request): bump
+        the cumulative byte/page/request totals and append a bounded
+        timeline entry — the byte audit's answer to "how much KV
+        actually crossed DCN", next to the per-pool splits the
+        destination's next :meth:`observe` re-balances."""
+        with self._lock:
+            self._migration["bytes"] += int(nbytes)
+            self._migration["pages"] += int(pages)
+            self._migration["requests"] += int(requests)
+            self._migration_log.append({
+                "bytes": int(nbytes), "pages": int(pages),
+                "seconds": float(seconds), "src_host": src_host,
+                "dst_host": dst_host, "outcome": outcome})
+            del self._migration_log[:-MAX_MIGRATIONS]
+
+    def migration_snapshot(self) -> Dict[str, Any]:
+        """Cumulative migration totals + the bounded transfer timeline
+        (embedded in ``memory.json`` / ``/statusz``'s memory section)."""
+        with self._lock:
+            return {"totals": dict(self._migration),
+                    "recent": [dict(e) for e in self._migration_log]}
 
     # -- class accounting ---------------------------------------------------
 
@@ -728,6 +768,9 @@ class MemoryLedger:
                 "audits": self.audits,
                 "pools": pools,
                 "last_oom": self.last_oom,
+                "migration": {"totals": dict(self._migration),
+                              "recent": [dict(e)
+                                         for e in self._migration_log]},
             }
 
     def statusz(self) -> Dict[str, Any]:
@@ -747,6 +790,7 @@ class MemoryLedger:
                                     "requests": len(p.held)}
                           for p in self._pools.values()},
                 "last_oom": self.last_oom,
+                "migration": dict(self._migration),
             }
 
 

@@ -516,6 +516,15 @@ class PagedKVCacheManager:
         self._free.extend(reversed(self._tables.pop(seq_id)))
         self._lens.pop(seq_id)
 
+    def sequence_pages(self, seq_id) -> List[int]:
+        """The sequence's block table in token order (a copy — callers
+        such as cross-host page export must not alias pool metadata)."""
+        return list(self._tables.get(seq_id, ()))
+
+    def sequence_len(self, seq_id) -> int:
+        """Committed token length of a live sequence (0 if unknown)."""
+        return int(self._lens.get(seq_id, 0))
+
     # -- speculative tail growth / rollback ----------------------------------
 
     def grow_to(self, seq_id, n_tokens: int) -> List[int]:

@@ -29,6 +29,16 @@ event                asked by
 ``chip_degraded``    ``ElasticServingController.step`` per replica (a
                      chip must be retired but still answers: graceful
                      drain → re-shard → undrain, no failovers)
+``host_die``         ``HostFleetRouter.step`` per host (the engine
+                     PROCESS is killed: heartbeats stop, health walks
+                     SUSPECT → EJECTED, flights fail over from their
+                     snapshots)
+``host_stall``       ``HostFleetRouter.step`` per host (the process
+                     stops answering for a bounded window — missed
+                     heartbeats without death — then recovers)
+``link_slow``        ``HostFleetRouter.step`` per host (every transport
+                     call to that host gains ``delay_s`` of injected
+                     DCN latency for a bounded window)
 ===================  ======================================================
 
 Each scheduled fault fires exactly once (``fire`` consumes it), so a
@@ -51,6 +61,14 @@ consumer defaults to — chip 0). The elastic controller asks
 ``fire_chip(event, step, replica=r)`` and receives the chip index, so a
 seeded chip storm (``seeded_chips``) deterministically names WHICH chip
 of WHICH replica dies at WHICH step.
+
+Host scoping mirrors chip scoping one level up: a host-level event
+carries a ``host`` id (an engine PROCESS, not a chip) and — for
+``link_slow`` — a ``delay_s`` injected per-transport-call latency. The
+multi-host router asks ``fire_host(event, step, host=h)`` and receives
+the whole :class:`Fault` (it needs ``delay_s``); ``seeded_hosts``
+generates reproducible host storms with the same one-per-target rule as
+``seeded_chips``.
 
 This module is also the only place allowed to write checkpoint bytes
 outside the atomic-write helper — it exists to corrupt them on purpose.
@@ -79,6 +97,10 @@ class Fault:
     step: int
     replica: Optional[int] = None
     chip: Optional[int] = None
+    #: host (engine-process) id for host-level events; None = wildcard
+    host: Optional[int] = None
+    #: injected per-call transfer latency (seconds) for ``link_slow``
+    delay_s: Optional[float] = None
 
 
 @dataclass
@@ -169,6 +191,58 @@ class FaultInjector:
             faults.append(f)
         faults.sort(key=lambda f: (f.step, f.event, f.replica, f.chip))
         return cls(schedule=faults)
+
+    @classmethod
+    def seeded_hosts(cls, seed: int, num_steps: int, num_hosts: int,
+                     events: Sequence[str] = ("host_die", "host_stall",
+                                              "link_slow"),
+                     n_faults: int = 1,
+                     delay_s: float = 0.05) -> "FaultInjector":
+        """A reproducible host-scoped schedule for multi-host chaos
+        runs: same seed → same (event, step, host) triples, with
+        ``link_slow`` faults carrying ``delay_s`` of injected transfer
+        latency. Steps are 1-based like ``seeded_replicas``; at most
+        one event per host (a host that died AND stalls is one arc the
+        acceptance suite builds explicitly, not by collision)."""
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        num_steps = max(num_steps, 1)
+        num_hosts = max(num_hosts, 1)
+        n_faults = min(n_faults, num_hosts)
+        faults: List[Fault] = []
+        used_hosts = set()
+        while len(faults) < n_faults:
+            ev = events[int(rng.choice(len(events)))]
+            f = Fault(ev, int(rng.choice(num_steps)) + 1,
+                      host=int(rng.choice(num_hosts)),
+                      delay_s=(float(delay_s) if ev == "link_slow"
+                               else None))
+            if f.host in used_hosts:
+                continue
+            used_hosts.add(f.host)
+            faults.append(f)
+        faults.sort(key=lambda f: (f.step, f.event, f.host))
+        return cls(schedule=faults)
+
+    def fire_host(self, event: str, step: int,
+                  host: Optional[int] = None) -> Optional[Fault]:
+        """One-shot host-level match: returns (and consumes) the
+        scheduled :class:`Fault` — the caller reads ``delay_s`` off it —
+        or None. A host-scoped fault must match the queried host, an
+        unscoped one wildcards, a host-scoped fault never fires for an
+        unscoped query; ``fired`` records (event, step, host)."""
+        for f in self.schedule:
+            if f.event != event or f.step != int(step):
+                continue
+            if f.host is not None and (host is None
+                                       or int(host) != f.host):
+                continue
+            self.schedule.remove(f)
+            h = f.host if f.host is not None else (
+                int(host) if host is not None else None)
+            self.fired.append((event, int(step), h))
+            return f
+        return None
 
     def _match(self, event: str, step: int,
                replica: Optional[int]) -> Optional[Fault]:

@@ -36,12 +36,20 @@ from .health import (  # noqa: F401
     HealthConfig, HealthTracker, ReplicaState,
 )
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .multihost import (  # noqa: F401
+    HostEndpoint, HostFault, HostFleetRouter, HostHandle, HostServer,
+    LocalTransport, PipeTransport, RemoteRequest,
+)
 from .replica import ReplicaFault, ReplicaHandle  # noqa: F401
 from .router import FleetRouter, RouterConfig, RouterRequest  # noqa: F401
 from .scheduler import (  # noqa: F401
     RequestState, SchedulerConfig, ServingRequest, ServingScheduler,
 )
 from .stream import ServingError, TokenStream  # noqa: F401
+from .wire import (  # noqa: F401
+    WIRE_VERSION, WireError, decode_message, decode_pages, encode_message,
+    encode_pages,
+)
 
 __all__ = [
     "Histogram", "ServingMetrics", "RequestState", "SchedulerConfig",
@@ -49,4 +57,8 @@ __all__ = [
     "HealthConfig", "HealthTracker", "ReplicaState", "ReplicaFault",
     "ReplicaHandle", "FleetRouter", "RouterConfig", "RouterRequest",
     "ElasticServingController", "FlightSnapshot", "ResizeRecord",
+    "HostEndpoint", "HostFault", "HostFleetRouter", "HostHandle",
+    "HostServer", "LocalTransport", "PipeTransport", "RemoteRequest",
+    "WIRE_VERSION", "WireError", "encode_message", "decode_message",
+    "encode_pages", "decode_pages",
 ]

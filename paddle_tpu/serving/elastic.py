@@ -92,12 +92,24 @@ class FlightSnapshot:
     streamed: List[int]
     pages: int
     engine_rid: Optional[int] = None
+    #: the request's RESOLVED SamplerConfig fields (seed already pinned
+    #: by ``FleetRouter.submit``) — without them a migrated sampled
+    #: stream would resume under a different PRNG lane and diverge
+    sampler: Optional[Dict[str, Any]] = None
+    #: tokens the grammar's DFA already consumed (== streamed at
+    #: checkpoint time); the resume path fast-forwards the automaton
+    #: through these so the constraint continues mid-match
+    grammar_prefix: Optional[List[int]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {"router_rid": self.router_rid, "trace_id": self.trace_id,
                 "prompt_tokens": len(self.prompt),
                 "streamed_tokens": len(self.streamed),
-                "pages": self.pages, "engine_rid": self.engine_rid}
+                "pages": self.pages, "engine_rid": self.engine_rid,
+                "sampler": self.sampler,
+                "grammar_prefix_tokens": (len(self.grammar_prefix)
+                                          if self.grammar_prefix is not None
+                                          else None)}
 
 
 @dataclass
@@ -375,11 +387,21 @@ class ElasticServingController:
             pages = 0
             if erid is not None:
                 pages = len(eng.mgr._tables.get(erid, ()))
+            streamed = list(req.stream.tokens)
+            samp = None
+            if req.sampler is not None:
+                samp = {"temperature": req.sampler.temperature,
+                        "top_k": req.sampler.top_k,
+                        "top_p": req.sampler.top_p,
+                        "seed": req.sampler.seed}
             out.append(FlightSnapshot(
                 router_rid=req.rid, trace_id=req.trace_id,
                 prompt=[int(t) for t in req.prompt],
-                streamed=list(req.stream.tokens),
-                pages=pages, engine_rid=erid))
+                streamed=streamed,
+                pages=pages, engine_rid=erid,
+                sampler=samp,
+                grammar_prefix=(list(streamed)
+                                if req.grammar is not None else None)))
         return out
 
     def timeline_snapshot(self) -> Dict[str, Any]:

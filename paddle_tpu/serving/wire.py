@@ -1,0 +1,210 @@
+"""Versioned, checksummed wire format for cross-host serving traffic.
+
+Everything that crosses a host boundary — RPC commands, flight
+snapshots, page-granular KV payloads, compiled grammars — rides ONE
+self-describing frame so a single decoder guards every entry point:
+
+.. code-block:: text
+
+    offset  size  field
+    0       4     magic  b"PDLW"
+    4       2     version (u16 LE)   — WIRE_VERSION; skew is refused
+    6       2     reserved (u16 LE)  — zero; room for flags
+    8       4     header_len (u32 LE) — JSON header byte length
+    12      4     crc32 (u32 LE)     — over header + payload
+    16      H     header: UTF-8 JSON {kind, meta, arrays}
+    16+H    *     payload: the arrays' raw bytes, concatenated in order
+
+The header's ``arrays`` entry is a list of ``{name, dtype, shape,
+nbytes}`` records; the payload is each array's C-contiguous bytes in
+listed order. Integrity first: :func:`decode_message` verifies magic,
+version and CRC32 *before* any JSON is parsed or any bytes reach a KV
+pool, so a truncated or corrupted transfer dies at the boundary with a
+structured :class:`WireError` and the destination stays byte-conserved
+by construction.
+
+Only stdlib + numpy — both host processes decode without touching JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"PDLW"
+WIRE_VERSION = 1
+
+_PREAMBLE = struct.Struct("<4sHHII")   # magic, version, reserved, hlen, crc
+PREAMBLE_NBYTES = _PREAMBLE.size
+
+#: WireError codes, in the order the decoder checks them
+WIRE_ERROR_CODES = ("truncated", "bad_magic", "version_skew",
+                    "checksum_mismatch", "schema")
+
+
+class WireError(Exception):
+    """Structured decode failure. ``code`` is one of
+    :data:`WIRE_ERROR_CODES`; ``detail`` is the human-readable half."""
+
+    def __init__(self, code: str, detail: str = ""):
+        if code not in WIRE_ERROR_CODES:
+            raise ValueError(f"unknown wire error code {code!r}")
+        self.code = code
+        self.detail = detail
+        super().__init__(f"{code}: {detail}" if detail else code)
+
+    def as_dict(self) -> Dict[str, str]:
+        return {"error": "wire", "code": self.code, "detail": self.detail}
+
+
+# -- encode -----------------------------------------------------------------
+
+def encode_message(kind: str, meta: Optional[dict] = None,
+                   arrays: Optional[Dict[str, np.ndarray]] = None) -> bytes:
+    """Frame ``kind`` + JSON-safe ``meta`` + named numpy ``arrays`` into
+    one wire message (layout in the module docstring)."""
+    meta = meta or {}
+    arrays = arrays or {}
+    specs: List[dict] = []
+    chunks: List[bytes] = []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        # extension dtypes (bfloat16 via ml_dtypes) stringify as opaque
+        # void ('<V2'); their NAME round-trips bit-faithfully instead
+        dstr = a.dtype.str if a.dtype.kind != "V" else a.dtype.name
+        specs.append({"name": str(name), "dtype": dstr,
+                      "shape": list(a.shape), "nbytes": int(a.nbytes)})
+        chunks.append(a.tobytes())
+    header = json.dumps({"kind": kind, "meta": meta, "arrays": specs},
+                        separators=(",", ":")).encode("utf-8")
+    payload = b"".join(chunks)
+    crc = zlib.crc32(header + payload) & 0xFFFFFFFF
+    return (_PREAMBLE.pack(MAGIC, WIRE_VERSION, 0, len(header), crc)
+            + header + payload)
+
+
+def _dtype(dstr: str) -> np.dtype:
+    """Resolve a wire dtype string; extension names (``bfloat16``) need
+    ``ml_dtypes`` registered before numpy knows them."""
+    try:
+        return np.dtype(dstr)
+    except TypeError:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 et al.)
+        return np.dtype(dstr)
+
+
+# -- decode -----------------------------------------------------------------
+
+def decode_message(buf: bytes) -> Tuple[str, dict, Dict[str, np.ndarray]]:
+    """Verify and unpack one frame -> ``(kind, meta, arrays)``. Raises
+    :class:`WireError` (never a bare struct/json/numpy error); integrity
+    checks run before any content is interpreted."""
+    if len(buf) < PREAMBLE_NBYTES:
+        raise WireError("truncated",
+                        f"{len(buf)} bytes < {PREAMBLE_NBYTES} preamble")
+    magic, version, _reserved, hlen, crc = _PREAMBLE.unpack_from(buf)
+    if magic != MAGIC:
+        raise WireError("bad_magic", repr(magic))
+    if version != WIRE_VERSION:
+        raise WireError(
+            "version_skew",
+            f"peer speaks wire v{version}, this host v{WIRE_VERSION}")
+    body = buf[PREAMBLE_NBYTES:]
+    if len(body) < hlen:
+        raise WireError("truncated",
+                        f"header needs {hlen} bytes, {len(body)} left")
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise WireError("checksum_mismatch",
+                        f"crc32 over {len(body)} body bytes")
+    try:
+        header = json.loads(body[:hlen].decode("utf-8"))
+        kind = header["kind"]
+        meta = header["meta"]
+        specs = header["arrays"]
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise WireError("schema", f"bad header: {e}")
+    arrays: Dict[str, np.ndarray] = {}
+    off = hlen
+    for spec in specs:
+        try:
+            name, dstr = spec["name"], spec["dtype"]
+            shape, nbytes = tuple(spec["shape"]), int(spec["nbytes"])
+        except (TypeError, KeyError, ValueError) as e:
+            raise WireError("schema", f"bad array spec: {e}")
+        raw = body[off:off + nbytes]
+        if len(raw) < nbytes:
+            raise WireError("truncated",
+                            f"array {name!r} needs {nbytes} bytes, "
+                            f"{len(raw)} left")
+        try:
+            arrays[name] = np.frombuffer(raw, dtype=_dtype(dstr)
+                                         ).reshape(shape).copy()
+        except (TypeError, ValueError) as e:
+            raise WireError("schema", f"array {name!r}: {e}")
+        off += nbytes
+    return kind, meta, arrays
+
+
+# -- KV page payloads -------------------------------------------------------
+
+def encode_pages(kind: str, meta: dict,
+                 k_slabs: Sequence[np.ndarray],
+                 v_slabs: Sequence[np.ndarray]) -> bytes:
+    """Frame per-page K/V slab pairs (``pool.export_page`` output) as
+    ``k_slabs``/``v_slabs`` stacked arrays (bfloat16 travels by dtype
+    NAME — see :func:`encode_message`); ``meta['kv_dtype']`` records the
+    pool dtype so the importer can refuse a mismatched pool early."""
+    meta = dict(meta)
+    meta["n_pages"] = len(k_slabs)
+    arrays: Dict[str, np.ndarray] = {}
+    if k_slabs:
+        ks, vs = np.stack(k_slabs), np.stack(v_slabs)
+        meta.setdefault("kv_dtype", ks.dtype.str)
+        arrays = {"k_slabs": ks, "v_slabs": vs}
+    return encode_message(kind, meta, arrays)
+
+
+def decode_pages(meta: dict, arrays: Dict[str, np.ndarray]
+                 ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Unstack a pages frame back into per-page slab lists."""
+    n = int(meta.get("n_pages", 0))
+    if n == 0:
+        return [], []
+    try:
+        ks, vs = arrays["k_slabs"], arrays["v_slabs"]
+    except KeyError as e:
+        raise WireError("schema", f"pages frame missing {e}")
+    if ks.shape[0] != n or vs.shape[0] != n:
+        raise WireError("schema",
+                        f"n_pages={n} but slab stacks are "
+                        f"{ks.shape[0]}/{vs.shape[0]} deep")
+    return list(ks), list(vs)
+
+
+# -- compiled grammars ------------------------------------------------------
+
+def grammar_to_wire(dfa) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Split a ``TokenDFA`` into JSON meta + arrays for a frame."""
+    meta = {"start": int(dfa.start), "eos_token_id": int(dfa.eos_token_id),
+            "pattern": dfa.pattern, "fingerprint": dfa.fingerprint}
+    return meta, {"grammar_trans": np.asarray(dfa.trans, np.int32),
+                  "grammar_accepting": np.asarray(dfa.accepting, bool)}
+
+
+def grammar_from_wire(meta: dict, arrays: Dict[str, np.ndarray]):
+    """Rebuild the ``TokenDFA`` a peer framed with
+    :func:`grammar_to_wire` (lazy import keeps wire JAX-free)."""
+    from ..inference.constrain import TokenDFA
+    try:
+        return TokenDFA(trans=arrays["grammar_trans"],
+                        accepting=arrays["grammar_accepting"],
+                        start=int(meta["start"]),
+                        eos_token_id=int(meta["eos_token_id"]),
+                        pattern=meta.get("pattern", ""),
+                        fingerprint=meta.get("fingerprint", ""))
+    except KeyError as e:
+        raise WireError("schema", f"grammar frame missing {e}")

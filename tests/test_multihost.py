@@ -1,0 +1,522 @@
+"""Multi-host serving (ISSUE 17): wire-framed engine hosts behind a
+``HostFleetRouter`` — heartbeat health, DCN page migration, host-loss
+failover, host-scoped chaos, and the cross-process liveness guard.
+
+Local tests run both engine "processes" in-process over
+``LocalTransport`` (every frame still round-trips the wire encoder) on
+one fake clock, so chaos arcs are deterministic and byte-identity
+assertions are exact. Two tests spawn REAL engine processes over
+``PipeTransport`` and kill one mid-decode with an actual SIGKILL."""
+
+import json
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.sampling import SamplerConfig
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability import get_registry
+from paddle_tpu.observability.events import configure_event_log
+from paddle_tpu.observability.flight import flight_recorder
+from paddle_tpu.observability.memory import memory_ledger
+from paddle_tpu.resilience import Fault, FaultInjector
+from paddle_tpu.serving import (HealthConfig, HostEndpoint, HostFault,
+                                HostFleetRouter, HostHandle, HostServer,
+                                LocalTransport, PipeTransport, ReplicaState,
+                                RouterConfig, SchedulerConfig)
+from paddle_tpu.serving.multihost import llama_tiny_host
+
+CFG = L.llama_tiny(num_hidden_layers=2)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _local_fleet(n=2, max_new=8, health_kw=None, router_kw=None,
+                 injector=None, **fkw):
+    """N in-process hosts over LocalTransport, one fake clock."""
+    fkw.setdefault("max_new_tokens", max_new)
+    fkw.setdefault("max_seq_len", 48)
+    # the child scheduler enforces defer_s on ITS (real) clock while the
+    # router runs fake-clocked — zero the failover backoff so deferred
+    # resubmissions admit deterministically instead of racing wall time
+    router_kw = dict(router_kw or {})
+    router_kw.setdefault("failover_backoff_s", 0.0)
+    clock = FakeClock()
+    hosts, engines = [], []
+    for i in range(n):
+        eng, params = llama_tiny_host(**fkw)
+        engines.append(eng)
+        server = HostServer(eng, params, host_id=i,
+                            scheduler_config=SchedulerConfig(
+                                max_step_retries=1, retry_backoff_s=0.01))
+        ep = HostEndpoint(LocalTransport(server), clock=clock,
+                          sleep=clock.sleep)
+        hosts.append(HostHandle(
+            i, ep, health_config=HealthConfig(**(health_kw or {})),
+            clock=clock, sleep=clock.sleep))
+    router = HostFleetRouter(hosts,
+                             config=RouterConfig(**router_kw),
+                             clock=clock, sleep=clock.sleep,
+                             fault_injector=injector)
+    return router, clock, hosts, engines
+
+
+def _drive(router, clock, dt=0.05, max_steps=500):
+    steps = 0
+    while router.pending:
+        router.step(None)
+        clock.advance(dt)
+        steps += 1
+        assert steps < max_steps, router.statusz()
+    return steps
+
+
+def _prompt(seed=0, n=9):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref_tokens(prompt, max_new, **sub):
+    """Fault-free single-host reference stream."""
+    router, clock, _, _ = _local_fleet(n=1, max_new=max_new)
+    h = router.submit(prompt, max_new_tokens=max_new, **sub)
+    _drive(router, clock)
+    return list(h.stream.tokens)
+
+
+def _counter_total(name):
+    m = get_registry().get(name)
+    return 0.0 if m is None else m.total
+
+
+# ---------------------------------------------------------------------------
+# RPC lifecycle over the wire
+# ---------------------------------------------------------------------------
+
+def test_hello_facade_mirrors_engine_geometry():
+    router, clock, hosts, engines = _local_fleet(n=1)
+    f = hosts[0].engine
+    e = engines[0]
+    assert f.page_size == e.page_size
+    assert f.max_seq_len == e.max_seq_len
+    assert f.mgr.usable_pages == e.mgr.usable_pages
+    assert f.mgr.pages_for(13) == e.mgr.pages_for(13)
+    assert f.config.max_new_tokens == e.config.max_new_tokens
+    assert f.has_prefix_cache
+
+
+def test_submit_step_complete_over_the_wire():
+    router, clock, hosts, _ = _local_fleet(n=2, max_new=6)
+    h = router.submit(_prompt(), max_new_tokens=6)
+    steps = _drive(router, clock)
+    assert h.state == "done" and len(h.stream.tokens) == 6
+    assert h.stream.tokens == _ref_tokens(_prompt(), 6)
+    ep = hosts[h.replica_id].endpoint
+    assert ep.calls >= steps          # step heartbeats flowed as frames
+    assert ep.bytes_sent > 0 and ep.bytes_received > 0
+    st = hosts[h.replica_id].statusz()
+    assert st["host"]["host_id"] == h.replica_id
+    assert st["transport"]["alive"]
+
+
+def test_infeasible_request_is_a_caller_error():
+    router, clock, hosts, _ = _local_fleet(n=1)
+    with pytest.raises(ValueError):
+        router.submit(_prompt(n=9), max_new_tokens=10_000)
+    for h in hosts:
+        assert h.health.state == ReplicaState.HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# heartbeat health: missed beats walk SUSPECT -> EJECTED
+# ---------------------------------------------------------------------------
+
+def test_missed_heartbeats_suspect_then_ejected(tmp_path):
+    configure_event_log(str(tmp_path / "events.jsonl"))
+    try:
+        router, clock, hosts, _ = _local_fleet(
+            n=2, max_new=8, health_kw={"probe_cooldown_s": 1e9})
+        h = router.submit(_prompt(), max_new_tokens=8)
+        for _ in range(2):
+            router.step(None)
+            clock.advance(0.05)
+        victim = h.replica_id
+        hosts[victim].kill()
+        router.step(None)             # first missed beat
+        clock.advance(0.05)
+        assert hosts[victim].health.state == ReplicaState.SUSPECT
+        _drive(router, clock)         # two more misses eject + fail over
+        assert hosts[victim].health.state == ReplicaState.EJECTED
+        assert h.state == "done" and h.failovers == 1
+        # the dead host's gauge reads EJECTED, the survivor HEALTHY
+        # (read BEFORE the reference fleet below reuses host id 0)
+        g = get_registry().get("paddle_host_state")
+        assert g.value(host=str(victim)) == 2.0
+        assert g.value(host=str(1 - victim)) == 0.0
+        assert h.stream.tokens == _ref_tokens(_prompt(), 8)
+        # a dead process's affinity slice is dropped (cold on return)
+        assert len(router._index[victim]) == 0
+    finally:
+        configure_event_log(None)
+    events = [json.loads(l) for l in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    lost = [e for e in events if e["kind"] == "host_lost"]
+    assert lost and lost[0]["host"] == victim
+    assert lost[0]["process_dead"] and lost[0]["inflight"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live migration: pages move, continuation prefills only the tail
+# ---------------------------------------------------------------------------
+
+def test_live_migration_byte_identical_with_prefill_skip(tmp_path):
+    configure_event_log(str(tmp_path / "events.jsonl"))
+    memory_ledger.reset()
+    memory_ledger.arm()
+    try:
+        ref = _ref_tokens(_prompt(), 12)
+        router, clock, hosts, engines = _local_fleet(n=2, max_new=12)
+        h = router.submit(_prompt(), max_new_tokens=12)
+        for _ in range(4):
+            router.step(None)
+            clock.advance(0.05)
+        assert not h.done
+        src = h.replica_id
+        b0 = _counter_total("paddle_migration_bytes_total")
+        p0 = _counter_total("paddle_migration_pages_total")
+        c0 = _counter_total("paddle_kvcache_cached_tokens_total")
+        summary = router.migrate_host(src)
+        assert summary["dst"] != src and summary["requests"] == 1
+        assert summary["pages"] >= 1 and summary["failed"] == 0
+        assert h.replica_id == summary["dst"]     # continuation landed
+        _drive(router, clock)
+        assert list(h.stream.tokens) == ref
+        # the dst prefill HIT the imported pages instead of recomputing
+        served = _counter_total(
+            "paddle_kvcache_cached_tokens_total") - c0
+        ps = engines[0].page_size
+        assert served >= summary["pages"] * ps
+        # migration observability: counters, ledger timeline, event
+        assert _counter_total(
+            "paddle_migration_bytes_total") - b0 == summary["bytes"]
+        assert _counter_total(
+            "paddle_migration_pages_total") - p0 == summary["pages"]
+        mig = memory_ledger.migration_snapshot()
+        assert mig["totals"]["pages"] >= summary["pages"]
+        assert mig["recent"][-1]["src_host"] == src
+        assert mig["recent"][-1]["outcome"] == "ok"
+        snap = router.multihost_snapshot()
+        assert snap["migrations"][-1]["pages"] == summary["pages"]
+        assert router.statusz()["multihost"]["migrated_pages"] == \
+            summary["pages"]
+        for e in engines:
+            e.mgr.check_conservation()
+        assert engines[src].mgr.num_live_pages == 0   # src freed
+    finally:
+        memory_ledger.disarm()
+        memory_ledger.reset()
+        configure_event_log(None)
+    events = [json.loads(l) for l in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    pm = [e for e in events if e["kind"] == "page_migration"]
+    assert pm and pm[0]["src"] == src and pm[0]["outcome"] == "ok"
+    assert pm[0]["bytes"] > 0 and pm[0]["pages"] == summary["pages"]
+
+
+def test_migration_affinity_routes_same_prefix_to_dst():
+    router, clock, hosts, _ = _local_fleet(
+        n=2, max_new=8, router_kw={"load_band": 8})
+    h = router.submit(_prompt(), max_new_tokens=8)
+    for _ in range(3):
+        router.step(None)
+        clock.advance(0.05)
+    src = h.replica_id
+    dst = router.migrate_host(src)["dst"]
+    router.undrain(src)
+    aff0 = _counter_total("paddle_router_prefix_affinity_hits_total")
+    h2 = router.submit(np.concatenate([_prompt(), [3, 4]]).astype(np.int32),
+                       max_new_tokens=8)
+    assert h2.replica_id == dst       # the pages moved; so does traffic
+    assert _counter_total(
+        "paddle_router_prefix_affinity_hits_total") - aff0 >= 1
+    _drive(router, clock)
+
+
+def test_migration_failure_falls_back_to_recompute(monkeypatch):
+    ref = _ref_tokens(_prompt(), 10)
+    router, clock, hosts, engines = _local_fleet(n=2, max_new=10)
+    h = router.submit(_prompt(), max_new_tokens=10)
+    for _ in range(3):
+        router.step(None)
+        clock.advance(0.05)
+    src = h.replica_id
+    dst = 1 - src
+
+    def dying_import(tokens, ks, vs):
+        raise HostFault("DCN link dropped mid-transfer")
+
+    monkeypatch.setattr(hosts[dst], "import_prefix", dying_import)
+    f0 = _counter_total("paddle_migration_requests_total")
+    summary = router.migrate_host(src, dst)
+    assert summary["failed"] == 1 and summary["requests"] == 0
+    assert _counter_total("paddle_migration_requests_total") - f0 == 1
+    _drive(router, clock)
+    # recomputed, not lost — and still byte-identical
+    assert h.state == "done" and list(h.stream.tokens) == ref
+    for e in engines:
+        e.mgr.check_conservation()
+
+
+# ---------------------------------------------------------------------------
+# satellite: sampled + grammar-constrained kill replay
+# ---------------------------------------------------------------------------
+
+def _abc_grammar():
+    from paddle_tpu.inference.constrain import compile_regex
+    vocab = ["<eos>"] + list("abcde") + [
+        f"tok{i}" for i in range(6, CFG.vocab_size)]
+    # three forced pairs before the terminal 'e': every legal stream is
+    # 7 tokens + eos, so a mid-stream kill window always exists
+    return compile_regex("(ab|cd)(ab|cd)(ab|cd)e", vocab, eos_token_id=0)
+
+
+def test_sampled_grammar_request_survives_host_kill_byte_identical():
+    """Kill a host mid-stream under a SAMPLED, grammar-CONSTRAINED
+    request: the continuation must replay the identical stream (seed
+    pinned at the router, DFA resumed via grammar_prefix over the
+    wire), and a fresh fault-free fleet given the same pinned sampler
+    reproduces it byte-for-byte."""
+    g = _abc_grammar()
+
+    def fleet():
+        return _local_fleet(n=2, max_new=8, eos_token_id=0,
+                            grammar_states=g.n_states,
+                            health_kw={"probe_cooldown_s": 1e9})
+
+    router, clock, hosts, _ = fleet()
+    r1 = router.submit(_prompt(), max_new_tokens=8,
+                       sampler=SamplerConfig(temperature=0.8), grammar=g)
+    assert r1.sampler.seed is not None        # pinned at the fleet edge
+    steps = 0
+    while not r1.done:
+        router.step(None)
+        clock.advance(0.05)
+        if len(r1.stream.tokens) >= 2 and r1.failovers == 0 \
+                and hosts[r1.replica_id].endpoint.alive():
+            hosts[r1.replica_id].kill()       # mid-stream host loss
+        steps += 1
+        assert steps < 500
+    assert r1.failovers >= 1
+
+    router2, clock2, _, _ = fleet()
+    r2 = router2.submit(_prompt(), max_new_tokens=8,
+                        sampler=r1.sampler, grammar=g)
+    _drive(router2, clock2)
+    assert r1.stream.tokens == r2.stream.tokens
+    # every token grammar-legal end to end
+    st = g.start
+    for tok in r1.stream.tokens:
+        assert g.legal(st, tok)
+        st = g.advance(st, tok)
+
+
+# ---------------------------------------------------------------------------
+# host-scoped chaos
+# ---------------------------------------------------------------------------
+
+def test_link_slow_injects_latency_then_recovers():
+    inj = FaultInjector(schedule=[
+        Fault("link_slow", 2, host=0, delay_s=0.2)])
+    router, clock, hosts, _ = _local_fleet(n=2, max_new=6, injector=inj)
+    h = router.submit(_prompt(), max_new_tokens=6)
+    t0 = clock()
+    _drive(router, clock)
+    assert h.state == "done"
+    assert h.stream.tokens == _ref_tokens(_prompt(), 6)
+    assert inj.fired == [("link_slow", 2, 0)]
+    assert clock() - t0 > 0.2         # the injected latency was paid
+    assert hosts[0].health.state == ReplicaState.HEALTHY
+
+
+def test_host_stall_trips_breaker_then_heals():
+    inj = FaultInjector(schedule=[Fault("host_stall", 2, host=0)])
+    router, clock, hosts, _ = _local_fleet(
+        n=2, max_new=6, injector=inj,
+        health_kw={"eject_after": 99},        # stall outlives SUSPECT
+        router_kw={"stall_s": 0.2})
+    h = router.submit(_prompt(0), max_new_tokens=6)
+    h2 = router.submit(_prompt(5), max_new_tokens=6)
+    _drive(router, clock)
+    assert h.state == "done" and h2.state == "done"
+    assert ("host_stall", 2, 0) in inj.fired
+    assert hosts[0].health.state == ReplicaState.HEALTHY  # healed
+
+
+def test_seeded_hosts_schedule_is_deterministic_and_host_unique():
+    a = FaultInjector.seeded_hosts(7, num_steps=20, num_hosts=4,
+                                   n_faults=3)
+    b = FaultInjector.seeded_hosts(7, num_steps=20, num_hosts=4,
+                                   n_faults=3)
+    sa = [(f.event, f.step, f.host, f.delay_s) for f in a.schedule]
+    assert sa == [(f.event, f.step, f.host, f.delay_s)
+                  for f in b.schedule]
+    hosts_hit = [f.host for f in a.schedule]
+    assert len(set(hosts_hit)) == len(hosts_hit)      # <= 1 per host
+    for f in a.schedule:
+        assert f.event in ("host_die", "host_stall", "link_slow")
+        assert (f.delay_s is not None) == (f.event == "link_slow")
+        assert 1 <= f.step <= 20
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: storm, then prove nothing leaked
+# ---------------------------------------------------------------------------
+
+def test_host_die_storm_byte_identical_no_leaks_no_slo_breach(tmp_path):
+    """The ISSUE 17 acceptance arc on LocalTransport: a seeded host
+    death mid-decode, every request completing byte-identically to the
+    fault-free run, the fleet SLO un-breached (failover is remediation),
+    zero leaked pages and empty tables on the survivor."""
+    prompts = [_prompt(s, n=7 + s % 3) for s in range(3)]
+    refs = [_ref_tokens(p, 8) for p in prompts]
+
+    inj = FaultInjector(schedule=[Fault("host_die", 3, host=0)])
+    flight_recorder.arm(dump_dir=str(tmp_path))
+    br0 = _counter_total("paddle_slo_breaches_total")
+    try:
+        router, clock, hosts, engines = _local_fleet(
+            n=2, max_new=8, injector=inj,
+            health_kw={"probe_cooldown_s": 1e9})
+        monitor = router.make_slo_monitor(completion_target=0.99)
+        handles = [router.submit(p, max_new_tokens=8) for p in prompts]
+        _drive(router, clock)
+        assert inj.fired == [("host_die", 3, 0)]
+        for h, ref in zip(handles, refs):
+            assert h.state == "done", h
+            assert list(h.stream.tokens) == ref
+        # no SLO breach: failovers are remediation, not bad events
+        assert router.failed_total == 0 and router.shed_total == 0
+        assert _counter_total("paddle_slo_breaches_total") == br0
+        assert monitor.health() == "ok"
+        # post-storm: no unresolved work, nothing parked, no leaks on
+        # the survivor, and its tables are EMPTY
+        assert router.pending == 0 and router.parked == 0
+        survivor = engines[1]
+        survivor.mgr.check_conservation()
+        assert survivor.mgr.num_live_pages == 0
+        assert survivor.mgr._tables == {}
+        # host_lost auto-dump bundle embeds the multihost timeline
+        bundles = list(tmp_path.glob("paddle_debug_replica_ejected_0*"))
+        assert bundles, list(tmp_path.iterdir())
+        with tarfile.open(bundles[0]) as tf:
+            mh = json.loads(tf.extractfile("multihost.json").read())
+        assert str(0) in mh["hosts"] and "migrations" in mh
+        assert mh["hosts"]["0"]["health"]["state"] == "ejected"
+    finally:
+        flight_recorder.disarm()
+        flight_recorder.clear()
+
+
+# ---------------------------------------------------------------------------
+# cross-process liveness: consumers of a dead host terminate
+# ---------------------------------------------------------------------------
+
+def test_mirror_stream_closes_producer_dead_without_router():
+    """Satellite 6, LocalTransport edition: a consumer holding a dead
+    host's stream (no router to fail it over) terminates with a
+    structured ``producer_dead`` instead of hanging."""
+    eng, params = llama_tiny_host(max_new_tokens=6)
+    server = HostServer(eng, params, host_id=0)
+    ep = HostEndpoint(LocalTransport(server))
+    h = HostHandle(0, ep)
+    mirror = h.submit(_prompt(), max_new_tokens=6)
+    h.step(None)
+    h.kill()
+    mirror.stream._poll_s = 0.01
+    toks = []
+    while True:
+        tok = mirror.stream.get(timeout=2.0)
+        if tok is None:
+            break
+        toks.append(tok)
+    assert mirror.stream.finished
+    assert mirror.stream.error is not None
+    assert mirror.stream.error.code == "producer_dead"
+
+
+# ---------------------------------------------------------------------------
+# real processes
+# ---------------------------------------------------------------------------
+
+def _spawn_host(i, max_new=10):
+    tr = PipeTransport(factory_kwargs={"max_new_tokens": max_new,
+                                       "max_seq_len": 48}, host_id=i)
+    ep = HostEndpoint(tr, timeout_s=300.0)
+    return HostHandle(i, ep,
+                      health_config=HealthConfig(probe_cooldown_s=1e9))
+
+
+def test_two_processes_kill_one_mid_decode_byte_identical():
+    """THE acceptance run, for real: two engine processes, a SIGKILL
+    mid-decode, and the survivor finishes the stream byte-identically
+    to the fault-free run on the same fleet."""
+    hosts = [_spawn_host(i) for i in range(2)]
+    router = HostFleetRouter(hosts, config=RouterConfig())
+    try:
+        prompt = _prompt()
+        ref = router.submit(prompt, max_new_tokens=10)
+        while router.pending:
+            router.step(None)
+        ref_toks = list(ref.stream.tokens)
+        assert len(ref_toks) == 10
+
+        h = router.submit(prompt, max_new_tokens=10)
+        steps = 0
+        while not h.done:
+            router.step(None)
+            if len(h.stream.tokens) >= 3 and h.failovers == 0 and \
+                    hosts[h.replica_id].endpoint.alive():
+                hosts[h.replica_id].kill()    # real SIGKILL
+            steps += 1
+            assert steps < 1000
+        assert h.failovers == 1 and h.state == "done"
+        assert list(h.stream.tokens) == ref_toks
+        dead = [i for i in range(2)
+                if not hosts[i].endpoint.alive()]
+        assert len(dead) == 1
+    finally:
+        router.close()
+
+
+def test_real_process_death_closes_blocked_consumer():
+    """Satellite 6 against a REAL process: a consumer blocked on a
+    stream whose producing process got SIGKILLed terminates with
+    ``producer_dead`` via the endpoint liveness probe."""
+    h = _spawn_host(0, max_new=8)
+    try:
+        mirror = h.submit(_prompt(), max_new_tokens=8)
+        h.step(None)
+        h.kill()
+        mirror.stream._poll_s = 0.01
+        while True:
+            tok = mirror.stream.get(timeout=5.0)
+            if tok is None:
+                break
+        assert mirror.stream.finished
+        assert mirror.stream.error is not None
+        assert mirror.stream.error.code == "producer_dead"
+    finally:
+        h.close()

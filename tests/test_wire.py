@@ -1,0 +1,328 @@
+"""Wire format for cross-host serving traffic (ISSUE 17): framed
+round-trips, ordered integrity rejection (truncated / corrupted /
+version-skewed frames die at the boundary with the destination pool
+byte-conserved), page-granular KV export/import over the refcounted
+pool — COW pages, refcounted shared prefixes and speculative tails all
+included — and compiled-grammar frames."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.kvcache.cache import PrefixCache
+from paddle_tpu.kvcache.pool import RefcountedKVCacheManager
+from paddle_tpu.serving.wire import (MAGIC, PREAMBLE_NBYTES, WIRE_VERSION,
+                                     WireError, decode_message,
+                                     decode_pages, encode_message,
+                                     encode_pages, grammar_from_wire,
+                                     grammar_to_wire)
+
+
+def _mgr(num_pages=12, page_size=4):
+    # tiny device arrays: 1 layer, 1 kv head, dim 2 — metadata is the test
+    return RefcountedKVCacheManager(1, num_pages, page_size, 1, 2)
+
+
+def _pool_image(mgr):
+    """Byte image + free-list snapshot for conservation assertions."""
+    return (np.asarray(mgr.k_pages).tobytes(),
+            np.asarray(mgr.v_pages).tobytes(),
+            # the free LIST (not just its count) is the conservation
+            # point of the test  # tpu-lint: disable=private-kvcache
+            sorted(mgr._free), mgr.num_free_pages)
+
+
+def _fill_pages(mgr, pages, seed=0):
+    """Write recognisable per-page content so byte-equality is
+    meaningful."""
+    rng = np.random.RandomState(seed)
+    slabs = {}
+    for p in pages:
+        k = rng.standard_normal(
+            np.asarray(mgr.k_pages).shape[:1]
+            + np.asarray(mgr.k_pages).shape[2:]).astype(
+                np.asarray(mgr.k_pages).dtype)
+        v = rng.standard_normal(k.shape).astype(k.dtype)
+        mgr.write_page(p, k, v)
+        slabs[p] = (k, v)
+    return slabs
+
+
+# ---------------------------------------------------------------------------
+# frame round-trip
+# ---------------------------------------------------------------------------
+
+def test_message_roundtrip_meta_and_arrays():
+    arrays = {"a": np.arange(12, dtype=np.int32).reshape(3, 4),
+              "b": np.random.RandomState(0).standard_normal(
+                  (2, 5)).astype(np.float32),
+              "flags": np.array([True, False, True])}
+    meta = {"rid": 7, "nested": {"x": [1, 2, 3]}, "s": "héllo"}
+    buf = encode_message("submit", meta, arrays)
+    kind, m, arrs = decode_message(buf)
+    assert kind == "submit" and m == meta
+    assert set(arrs) == set(arrays)
+    for name in arrays:
+        np.testing.assert_array_equal(arrs[name], arrays[name])
+        assert arrs[name].dtype == arrays[name].dtype
+
+
+def test_bfloat16_travels_bit_faithfully():
+    import ml_dtypes
+    a = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    _, _, arrs = decode_message(encode_message("kv", {}, {"a": a}))
+    assert arrs["a"].dtype == a.dtype
+    assert arrs["a"].tobytes() == a.tobytes()
+
+
+def test_empty_frame_roundtrip():
+    kind, meta, arrays = decode_message(encode_message("heartbeat"))
+    assert kind == "heartbeat" and meta == {} and arrays == {}
+
+
+# ---------------------------------------------------------------------------
+# ordered integrity rejection
+# ---------------------------------------------------------------------------
+
+def test_truncated_preamble_rejected():
+    buf = encode_message("x", {"a": 1})
+    with pytest.raises(WireError) as ei:
+        decode_message(buf[:PREAMBLE_NBYTES - 1])
+    assert ei.value.code == "truncated"
+
+
+def test_truncated_body_rejected():
+    buf = encode_message("x", {"a": 1}, {"p": np.zeros(64, np.float32)})
+    with pytest.raises(WireError) as ei:
+        decode_message(buf[:-10])
+    # body CRC can't even be checked over missing header bytes: whichever
+    # fires first, the code is structural, never a JSON/numpy error
+    assert ei.value.code in ("truncated", "checksum_mismatch")
+
+
+def test_bad_magic_rejected():
+    buf = bytearray(encode_message("x"))
+    buf[:4] = b"EVIL"
+    with pytest.raises(WireError) as ei:
+        decode_message(bytes(buf))
+    assert ei.value.code == "bad_magic"
+
+
+def test_version_skew_refused_with_structured_error():
+    buf = bytearray(encode_message("x", {"a": 1}))
+    struct.pack_into("<H", buf, 4, WIRE_VERSION + 1)
+    with pytest.raises(WireError) as ei:
+        decode_message(bytes(buf))
+    err = ei.value
+    assert err.code == "version_skew"
+    assert str(WIRE_VERSION + 1) in err.detail
+    assert err.as_dict() == {"error": "wire", "code": "version_skew",
+                             "detail": err.detail}
+
+
+def test_corrupted_payload_rejected_by_crc():
+    buf = bytearray(encode_message(
+        "kv", {}, {"p": np.ones(32, np.float32)}))
+    buf[-3] ^= 0xFF
+    with pytest.raises(WireError) as ei:
+        decode_message(bytes(buf))
+    assert ei.value.code == "checksum_mismatch"
+
+
+def test_corrupted_header_rejected_before_json_parse():
+    buf = bytearray(encode_message("kv", {"deep": {"meta": [1, 2]}}))
+    buf[PREAMBLE_NBYTES + 2] ^= 0xFF      # inside the JSON header
+    with pytest.raises(WireError) as ei:
+        decode_message(bytes(buf))
+    assert ei.value.code == "checksum_mismatch"
+
+
+def test_magic_checked_before_version_before_crc():
+    """The decoder's check order is part of the contract (a foreign
+    protocol should read as bad_magic, not as a CRC accident)."""
+    buf = bytearray(encode_message("x"))
+    buf[:4] = b"EVIL"
+    struct.pack_into("<H", buf, 4, 99)
+    buf[-1] ^= 0xFF
+    with pytest.raises(WireError) as ei:
+        decode_message(bytes(buf))
+    assert ei.value.code == "bad_magic"
+
+
+def test_unknown_error_code_rejected():
+    with pytest.raises(ValueError):
+        WireError("not_a_code", "x")
+
+
+# ---------------------------------------------------------------------------
+# page payloads over the refcounted pool
+# ---------------------------------------------------------------------------
+
+def test_pages_roundtrip_cow_shared_and_spec_tail():
+    """Export the full zoo — a refcount-shared prefix, a COW-diverged
+    page, a speculative tail page — and import every slab byte-exactly
+    into a second pool."""
+    src = _mgr(num_pages=16, page_size=4)
+    base = src.allocate("a", 8)                   # 2 full pages
+    src.allocate("b", 8, shared=base)             # refcounted sharer
+    assert src.refcount(base[0]) == 2
+    cow_dst = src.take_free_pages(1)[0]
+    _fill_pages(src, base + [cow_dst], seed=1)
+    src.copy_page(base[1], cow_dst)               # COW divergence copy
+    spec = src.grow_to("a", 12)                   # speculative tail page
+    _fill_pages(src, spec, seed=2)
+
+    pages = base + [cow_dst] + spec
+    want = {p: src.export_page(p) for p in pages}
+    buf = encode_pages("migrate", {"rid": 1},
+                       *zip(*(want[p] for p in pages)))
+    kind, meta, arrays = decode_message(buf)
+    assert kind == "migrate" and meta["n_pages"] == len(pages)
+    ks, vs = decode_pages(meta, arrays)
+
+    dst = _mgr(num_pages=16, page_size=4)
+    staged = dst.take_free_pages(len(pages))
+    for p, k, v in zip(staged, ks, vs):
+        dst.write_page(p, k, v)
+    for p_src, p_dst in zip(pages, staged):
+        wk, wv = want[p_src]
+        gk, gv = dst.export_page(p_dst)
+        assert np.asarray(gk).tobytes() == np.asarray(wk).tobytes()
+        assert np.asarray(gv).tobytes() == np.asarray(wv).tobytes()
+    # COW copy really diverged from its parent on the destination too
+    k_parent = dst.export_page(staged[1])[0]
+    k_cow = dst.export_page(staged[2])[0]
+    assert np.asarray(k_parent).tobytes() != np.asarray(k_cow).tobytes()
+    dst.give_back_pages(staged)
+    dst.check_conservation()
+    src.free("b")
+    src.free("a")
+    src.give_back_pages([cow_dst])
+    src.check_conservation()
+
+
+def test_import_prefix_lands_in_cache_and_dedups():
+    src = _mgr(num_pages=16, page_size=4)
+    tokens = list(range(1, 13))                   # 3 full blocks
+    table = src.allocate("a", 12)
+    _fill_pages(src, table, seed=3)
+    slabs = [src.export_page(p) for p in table]
+    ks = [k for k, _ in slabs]
+    vs = [v for _, v in slabs]
+
+    dst = _mgr(num_pages=16, page_size=4)
+    cache = PrefixCache(dst)
+    free0 = dst.num_free_pages
+    out = cache.import_prefix(tokens, ks, vs)
+    assert out["imported_pages"] == 3 and out["skipped_pages"] == 0
+    assert dst.num_free_pages == free0 - 3
+    # a re-import of the same prefix is a no-op (cross-host affinity:
+    # the pages are already here)
+    out2 = cache.import_prefix(tokens, ks, vs)
+    assert out2["imported_pages"] == 0 and out2["skipped_pages"] == 3
+    assert dst.num_free_pages == free0 - 3
+    # the imported prefix is served like a locally-inserted one
+    shared, n_cached, cow = cache.lookup(tokens + [99])
+    assert n_cached == 12 and cow is None
+    for got, p_src in zip(shared, table):
+        gk, _gv = dst.export_page(got)
+        assert np.asarray(gk).tobytes() == \
+            np.asarray(src.export_page(p_src)[0]).tobytes()
+    dst.check_conservation()
+
+
+def test_rejected_frame_leaves_destination_byte_conserved():
+    """Truncation and corruption both die in the decoder — the import
+    path is never reached and the pool image does not move by one
+    byte."""
+    src = _mgr()
+    table = src.allocate("a", 8)
+    _fill_pages(src, table, seed=4)
+    slabs = [src.export_page(p) for p in table]
+    buf = encode_pages("migrate", {"tokens": list(range(8))},
+                       [k for k, _ in slabs], [v for _, v in slabs])
+
+    dst = _mgr()
+    cache = PrefixCache(dst)
+    before = _pool_image(dst)
+    for bad in (buf[:len(buf) // 2],
+                bytes(bytearray(buf[:-1]) + bytearray([buf[-1] ^ 0xFF]))):
+        with pytest.raises(WireError):
+            kind, meta, arrays = decode_message(bad)
+            cache.import_prefix(meta["tokens"], *decode_pages(meta, arrays))
+        assert _pool_image(dst) == before
+        dst.check_conservation()
+
+
+def test_partial_import_rolls_back_staged_pages(monkeypatch):
+    """A write that dies mid-import returns every staged page to the
+    free list and re-proves conservation — the destination ends exactly
+    where it started."""
+    src = _mgr()
+    table = src.allocate("a", 12)
+    _fill_pages(src, table, seed=5)
+    slabs = [src.export_page(p) for p in table]
+
+    dst = _mgr()
+    cache = PrefixCache(dst)
+    before = _pool_image(dst)
+    calls = {"n": 0}
+    real = dst.write_page
+
+    def dying_write(page, k, v):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("DCN transfer died mid-page")
+        real(page, k, v)
+
+    monkeypatch.setattr(dst, "write_page", dying_write)
+    with pytest.raises(RuntimeError, match="mid-page"):
+        cache.import_prefix(list(range(12)), [k for k, _ in slabs],
+                            [v for _, v in slabs])
+    assert _pool_image(dst)[2:] == before[2:]     # free list restored
+    assert len(cache.tree) == 0                   # nothing indexed
+    dst.check_conservation()
+
+
+def test_import_validates_geometry_before_touching_pool():
+    dst = _mgr(page_size=4)
+    cache = PrefixCache(dst)
+    free0 = dst.num_free_pages
+    bad = np.zeros((1, 8, 1, 2), np.float32)      # wrong page_size axis
+    with pytest.raises(ValueError):
+        cache.import_prefix(list(range(8)), [bad, bad], [bad, bad])
+    with pytest.raises(ValueError):               # tokens < blocks
+        ok = np.zeros((1, 4, 1, 2), np.float32)
+        cache.import_prefix([1, 2, 3], [ok], [ok])
+    assert dst.num_free_pages == free0
+    dst.check_conservation()
+
+
+# ---------------------------------------------------------------------------
+# grammar frames
+# ---------------------------------------------------------------------------
+
+def test_grammar_roundtrip_preserves_fingerprint_and_masks():
+    from paddle_tpu.inference.constrain import compile_regex
+    vocab = ["<eos>"] + list("abcde") + [f"t{i}" for i in range(6, 32)]
+    dfa = compile_regex("(ab|cd)*e", vocab, eos_token_id=0)
+    meta, arrays = grammar_to_wire(dfa)
+    buf = encode_message("submit", {"grammar": meta}, arrays)
+    _, m, arrs = decode_message(buf)
+    back = grammar_from_wire(m["grammar"], arrs)
+    assert back.fingerprint == dfa.fingerprint
+    assert back.start == dfa.start and back.pattern == dfa.pattern
+    np.testing.assert_array_equal(back.trans, dfa.trans)
+    np.testing.assert_array_equal(back.accepting, dfa.accepting)
+
+
+def test_grammar_frame_missing_array_is_schema_error():
+    from paddle_tpu.inference.constrain import compile_regex
+    vocab = ["<eos>"] + list("ab") + [f"t{i}" for i in range(3, 16)]
+    dfa = compile_regex("ab*", vocab, eos_token_id=0)
+    meta, arrays = grammar_to_wire(dfa)
+    arrays.pop("grammar_accepting")
+    with pytest.raises(WireError) as ei:
+        grammar_from_wire(meta, arrays)
+    assert ei.value.code == "schema"
