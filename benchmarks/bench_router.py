@@ -288,8 +288,16 @@ def _migration_scenario(prompts, max_new, num_slots, chunk, page_size,
     inj.schedule = [dataclasses.replace(f, step=kill_step + router._steps,
                                         host=1) for f in inj.schedule]
     router.injector = inj
+    # the measured arc runs with the telemetry federation ARMED: every
+    # heartbeat also pulls a wire-framed telemetry frame, so the JSON
+    # line carries the fleet's clock-reconcile error and heartbeat RTT
+    router.federation.arm()
     handles = [router.submit(p) for p in prompts]
     t0, marks, t_end, tok_end, mig = drive(handles, migrate=True, inj=inj)
+    fed_reconcile_ms = router.federation.reconcile_error_s() * 1e3
+    fed_rtt_p50_ms = \
+        router.federation.mirror(0).clock.rtt_quantile(0.5) / 1e6
+    router.federation.disarm()
     assert all(h.stream.finished for h in handles)
     assert inj.fired and mig is not None and mig["failed"] == 0
     (t_kill, tok_kill) = marks["kill"]
@@ -318,6 +326,8 @@ def _migration_scenario(prompts, max_new, num_slots, chunk, page_size,
         "migration_ms": round(mig["seconds"] * 1e3, 3),
         "host_loss_failovers": len(failed_over),
         "host_loss_recovery_ms_p50": round(_percentile(recovery_ms, 50), 3),
+        "federation_reconcile_error_ms": round(fed_reconcile_ms, 6),
+        "federation_rtt_p50_ms": round(fed_rtt_p50_ms, 6),
         "tokens_per_s_overall": rate(tok_end, t_end - t0),
         "tokens_per_s_before": rate(tok_kill, t_kill - t0),
         "tokens_per_s_during": rate(tok_rec - tok_kill, t_rec - t_kill),

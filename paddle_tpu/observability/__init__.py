@@ -69,6 +69,14 @@ needs (every future perf PR must be measurable):
 * :mod:`.server` — stdlib-only :class:`DiagServer` exposing
   ``/metrics``, ``/healthz``, ``/statusz``, ``/debugz``,
   ``/tracez``, ``/varz`` and ``/memz`` live.
+* :mod:`.federation` — fleet-wide telemetry federation: per-host
+  :class:`HostTelemetryMirror`\\ s inside a :class:`FederationHub`,
+  clock-offset estimation from heartbeat round-trips (:class:`ClockSync`
+  — offset from the RPC midpoint, EWMA-smoothed, RTT/2 error bound),
+  skew-corrected remote spans merged into the parent's trace trees, one
+  merged ``/metrics`` exposition under a ``host`` label, per-host +
+  fleet-aggregate ``/varz`` signals, and the ``host_telemetry.json``
+  bundle member that preserves a dead host's final telemetry.
 
 Quick start::
 
@@ -84,6 +92,10 @@ from .anomaly import (  # noqa: F401
     AnomalyMonitor, CusumDetector, RobustZScoreDetector, robust_zscore,
 )
 from .events import EventLog, configure_event_log, emit_event, event_log  # noqa: F401
+from .federation import (  # noqa: F401
+    ClockSync, FederationHub, HostTelemetryMirror, collect_telemetry,
+    federation_armed, merge_expositions,
+)
 from .flight import FlightRecorder, flight_recorder  # noqa: F401
 from .goodput import GoodputTracker, StragglerDetector  # noqa: F401
 from .memory import (  # noqa: F401
@@ -123,4 +135,6 @@ __all__ = [
     "SignalBus", "AnomalyMonitor", "RobustZScoreDetector",
     "CusumDetector", "robust_zscore", "CapacityPlan", "MemoryLedger",
     "memory_ledger", "plan_capacity", "pool_occupancy", "pytree_nbytes",
+    "ClockSync", "FederationHub", "HostTelemetryMirror",
+    "collect_telemetry", "federation_armed", "merge_expositions",
 ]

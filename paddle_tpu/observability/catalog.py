@@ -77,6 +77,14 @@ METRICS = {
     "paddle_migration_requests_total": ("counter", ("outcome",)),
     "paddle_migration_seconds": ("histogram", ()),
     "paddle_host_state": ("gauge", ("host",)),
+    "paddle_host_statusz_errors_total": ("counter", ("host",)),
+    "paddle_host_heartbeat_rtt_seconds": ("histogram", ("host",)),
+    # -- telemetry federation (observability/federation.py) ------------------
+    "paddle_federation_frames_total": ("counter", ("host",)),
+    "paddle_federation_spans_merged_total": ("counter", ("host",)),
+    "paddle_federation_clock_offset_seconds": ("gauge", ("host",)),
+    "paddle_federation_clock_error_bound_seconds": ("gauge", ("host",)),
+    "paddle_federation_stale_mirrors": ("gauge", ()),
     # -- prefix cache (kvcache/cache.py) -----------------------------------
     "paddle_kvcache_hits_total": ("counter", ()),
     "paddle_kvcache_misses_total": ("counter", ()),
@@ -152,6 +160,12 @@ SPANS = {
     # fleet router envelope + failover attribution (serving/router.py)
     "router.request": ("request_id", "outcome", "failovers"),
     "router.failover_gap": ("request_id", "to_replica", "attempt"),
+    # multi-host page migration (serving/multihost.py): the whole
+    # per-request drain and its nested DCN wire window (export ->
+    # import) — the timeline sweep's `migration` / `dcn_transfer`
+    # segments in cross-host trace trees
+    "router.migration": ("request_id", "src", "dst", "pages", "bytes"),
+    "router.dcn_transfer": ("request_id", "bytes", "pages"),
 }
 
 

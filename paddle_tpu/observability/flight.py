@@ -170,6 +170,12 @@ class FlightRecorder:
         with self._lock:
             self._metrics.append({"label": label, **payload})
 
+    def recent_events(self, n: int = 32) -> List[Dict[str, Any]]:
+        """Last ``n`` ring events, oldest first — the telemetry frame's
+        event tail (:func:`~.federation.collect_telemetry`)."""
+        with self._lock:
+            return list(self._events)[-n:]
+
     # -- dumping ------------------------------------------------------------
 
     def snapshot_status(self) -> Dict[str, Any]:
@@ -274,6 +280,18 @@ class FlightRecorder:
                 mh = {"error": repr(e)}
             members["multihost.json"] = json.dumps(
                 mh, default=str, indent=1).encode()
+            hub = getattr(self._multihost, "federation", None)
+            if hub is not None:
+                # every host's last-known telemetry mirror — for a
+                # host_lost bundle this is the dead host's final minutes,
+                # frozen at mark_lost (a torn hub must not lose the
+                # bundle)
+                try:
+                    tel = hub.snapshot()
+                except Exception as e:
+                    tel = {"error": repr(e)}
+                members["host_telemetry.json"] = json.dumps(
+                    tel, default=str, indent=1).encode()
         if self._signals is not None:
             # the sensor plane's bounded window: series, signal trends
             # and anomalies leading up to this dump (a torn bus must not

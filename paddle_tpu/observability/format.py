@@ -18,6 +18,21 @@ from ..core.histogram import DEFAULT_QUANTILES, Histogram
 #: metric types valid in exposition format TYPE lines
 VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 
+#: exposition comment-line prefixes (parsers outside this module key on
+#: these names instead of growing their own "# TYPE " literals)
+TYPE_PREFIX = "# TYPE "
+HELP_PREFIX = "# HELP "
+
+
+def type_line(family: str, kind: str) -> str:
+    """``# TYPE <family> <kind>`` — the one emitter for TYPE lines."""
+    return f"{TYPE_PREFIX}{family} {kind}"
+
+
+def help_line(family: str, text: str) -> str:
+    """``# HELP <family> <text>`` — the one emitter for HELP lines."""
+    return f"{HELP_PREFIX}{family} {text}"
+
 
 def escape_label_value(v: object) -> str:
     """Escape a label value per the exposition format spec."""

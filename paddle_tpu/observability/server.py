@@ -82,6 +82,7 @@ class DiagServer:
         self._statusz: Dict[str, Callable[[], object]] = {}
         self._health_fns: Dict[str, Callable[[], str]] = {}
         self._signals = None
+        self._federation = None
         if monitor is not None:
             self.add_health_source("slo", monitor.health)
             self.add_statusz("slo", monitor.states)
@@ -130,6 +131,17 @@ class DiagServer:
 
     def attach_kvcache(self, cache) -> None:
         self.add_statusz("kvcache", cache.statusz)
+
+    def attach_federation(self, hub) -> None:
+        """Telemetry federation (:class:`~.federation.FederationHub`):
+        /metrics becomes ONE merged exposition doc covering the parent
+        and every host mirror under a ``host`` label, and the fleet view
+        (mirror freshness, clock offsets, reconcile error) joins
+        /statusz. The per-host + fleet-aggregate signals reach /varz by
+        also calling ``hub.attach_fleet_signals(bus)`` on the attached
+        SignalBus."""
+        self._federation = hub
+        self.add_statusz("federation", hub.fleet_varz)
 
     # -- derived health -----------------------------------------------------
 
@@ -186,10 +198,15 @@ class DiagServer:
                     url = urlparse(self.path)
                     route = url.path.rstrip("/") or "/"
                     if route == "/metrics":
-                        # byte-identical to registry.prometheus_text()
-                        self._send(200,
-                                   server.registry.prometheus_text()
-                                   .encode("utf-8"),
+                        # byte-identical to registry.prometheus_text();
+                        # with a federation attached, one merged doc
+                        # covering every host under a `host` label
+                        if server._federation is not None:
+                            text = (server._federation
+                                    .federated_metrics_text())
+                        else:
+                            text = server.registry.prometheus_text()
+                        self._send(200, text.encode("utf-8"),
                                    ctype="text/plain; version=0.0.4; "
                                          "charset=utf-8")
                     elif route == "/healthz":

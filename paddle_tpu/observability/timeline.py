@@ -54,6 +54,8 @@ _EXACT_CATEGORY = {
     "engine.spec_draft": "spec_draft",
     "engine.spec_round": "spec_verify",
     "router.failover_gap": "failover",
+    "router.migration": "migration",
+    "router.dcn_transfer": "dcn_transfer",
 }
 
 #: namespaced span suffix (``<metrics namespace>.<suffix>``) -> category
@@ -64,8 +66,8 @@ _SUFFIX_CATEGORY = {
 
 #: every segment key attribution may produce (documented README order)
 SEGMENT_KEYS = ("queue_wait", "admission", "prefill", "decode",
-                "spec_draft", "spec_verify", "failover", "deliver",
-                "host")
+                "spec_draft", "spec_verify", "failover", "migration",
+                "dcn_transfer", "deliver", "host")
 
 
 def span_category(name: str) -> Optional[str]:
@@ -364,6 +366,26 @@ class SpanCollector:
         with self._lock:
             tr = self._traces.get(trace_id)
             return list(tr.spans) if tr is not None else []
+
+    def export_new(self, marks: Dict[str, int]) -> List[Any]:
+        """Incremental span export for telemetry federation: return
+        every span recorded since the caller's last call, where ``marks``
+        is the CALLER-owned per-trace watermark dict this method
+        advances in place (watermarks of evicted traces are pruned so
+        the dict stays bounded by the ring). Spans a trace dropped past
+        ``max_spans_per_trace`` — or whole traces evicted between calls
+        — are simply absent, the same losses a local reader sees."""
+        with self._lock:
+            out: List[Any] = []
+            for tid, tr in self._traces.items():
+                n = marks.get(tid, 0)
+                if len(tr.spans) > n:
+                    out.extend(tr.spans[n:])
+                    marks[tid] = len(tr.spans)
+            for tid in list(marks):
+                if tid not in self._traces:
+                    del marks[tid]
+            return out
 
     def tree(self, trace_id: str) -> List[Dict[str, Any]]:
         """The trace's span forest as nested dicts (normally one root)."""

@@ -59,16 +59,21 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..observability.events import emit_event
+from ..observability.federation import (FederationHub, collect_telemetry,
+                                        federation_armed)
 from ..observability.flight import flight_recorder
 from ..observability.memory import memory_armed, memory_ledger
 from ..observability.registry import get_registry
+from ..observability.timeline import timeline_armed
+from ..profiler.record import emit_span, spans_armed
 from .health import HealthConfig, HealthTracker
 from .metrics import ServingMetrics
 from .router import FleetRouter, RouterConfig
 from .scheduler import RequestState, SchedulerConfig, ServingScheduler
 from .stream import ServingError, TokenStream
 from .wire import (WireError, decode_message, decode_pages, encode_message,
-                   grammar_from_wire, grammar_to_wire)
+                   grammar_from_wire, grammar_to_wire, telemetry_from_wire,
+                   telemetry_to_wire)
 
 
 class HostFault(RuntimeError):
@@ -123,6 +128,8 @@ class HostServer:
             metrics=ServingMetrics(namespace=f"paddle_host_h{host_id}"))
         self._reqs: Dict[int, Any] = {}     # parent rid -> ServingRequest
         self._sent: Dict[int, int] = {}     # parent rid -> tokens reported
+        self._span_marks: Dict[str, int] = {}   # telemetry watermarks
+        self._telemetry_seq = 0
         self.shutdown_requested = False
 
     # -- framing ------------------------------------------------------------
@@ -279,6 +286,22 @@ class HostServer:
         out = self._scheduler.statusz()
         out["host_id"] = self.host_id
         return ({"statusz": out}, {})
+
+    def _cmd_telemetry(self, meta, arrays) -> Tuple[dict, dict]:
+        """One federation beat: build a versioned telemetry frame —
+        registry exposition, serving gauges, new completed spans since
+        the previous frame (``_span_marks`` watermarks), event tail,
+        memory class bytes. ``meta["arm"]`` arms the host-side span
+        collector on first contact, so a child process starts recording
+        the moment the parent federation wants spans."""
+        if meta.get("arm") and not timeline_armed[0]:
+            timeline_armed[0] = True
+        seq = self._telemetry_seq
+        self._telemetry_seq += 1
+        frame = collect_telemetry(
+            self.host_id, self._span_marks, seq,
+            gauges=self._scheduler.metrics.gauges)
+        return telemetry_to_wire(frame)
 
     def _cmd_shutdown(self, meta, arrays) -> Tuple[dict, dict]:
         self.shutdown_requested = True
@@ -646,6 +669,24 @@ class HostHandle:
         self._last: Dict[str, Any] = {"pending": 0, "active": 0,
                                       "inflight": 0, "queue_depth": 0,
                                       "degraded": False}
+        #: set by HostFleetRouter — the parent-side telemetry sink this
+        #: handle's heartbeat feeds while ``federation_armed``
+        self.federation: Optional[FederationHub] = None
+        self._statusz_cache: Dict[str, Any] = {}
+        self._statusz_last_success: Optional[float] = None
+        reg = get_registry()
+        self._c_statusz_err = reg.counter(
+            "paddle_host_statusz_errors_total",
+            "statusz endpoint round-trips that failed "
+            "(the host view is served from cache, marked stale)",
+            labels=("host",))
+        self._h_rtt = reg.histogram(
+            "paddle_host_heartbeat_rtt_seconds",
+            "telemetry-beat RPC round-trip time per host (the samples "
+            "the clock-offset estimator consumes)",
+            labels=("host",),
+            bounds=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 
     # -- request lifecycle --------------------------------------------------
 
@@ -731,7 +772,29 @@ class HostHandle:
         for k in ("pending", "active", "inflight", "queue_depth",
                   "degraded"):
             self._last[k] = meta.get(k, self._last[k])
+        if federation_armed[0] and self.federation is not None:
+            self._telemetry_beat()
         return int(meta.get("pending", 0))
+
+    def _telemetry_beat(self) -> None:
+        """Pull one telemetry frame after a successful heartbeat. The
+        round-trip is stamped with ``perf_counter_ns`` on both ends —
+        the same samples feed the RTT histogram AND the hub's clock
+        estimator. A failed beat marks the mirror stale; it is never
+        breaker food (the heartbeat proper owns health)."""
+        hub = self.federation
+        t0 = time.perf_counter_ns()
+        try:
+            meta, arrays = self.endpoint.call(
+                "telemetry", {"arm": timeline_armed[0]}, retries=0,
+                timeout_s=self.step_timeout_s)
+            t1 = time.perf_counter_ns()
+            frame = telemetry_from_wire(meta, arrays)
+        except (HostFault, ServingError, WireError) as e:
+            hub.mark_stale(self.replica_id, repr(e))
+            return
+        self._h_rtt.observe((t1 - t0) / 1e9, host=f"h{self.replica_id}")
+        hub.ingest(self.replica_id, frame, t0, t1)
 
     # -- page migration RPCs ------------------------------------------------
 
@@ -818,9 +881,21 @@ class HostHandle:
         try:
             meta, _ = self.endpoint.call("statusz", retries=0,
                                          timeout_s=2.0)
-            out["host"] = meta.get("statusz", {})
         except (HostFault, ServingError, WireError) as e:
-            out["host"] = {"unreachable": repr(e)}
+            # an unreachable endpoint must not look healthy: serve the
+            # last good view, visibly STALE, and count the failure
+            self._c_statusz_err.inc(host=f"h{self.replica_id}")
+            view = dict(self._statusz_cache)
+            view["stale"] = True
+            view["stale_error"] = repr(e)
+            view["last_success_t"] = self._statusz_last_success
+            out["host"] = view
+        else:
+            self._statusz_cache = dict(meta.get("statusz", {}))
+            self._statusz_last_success = self._clock()
+            view = dict(self._statusz_cache)
+            view["stale"] = False
+            out["host"] = view
         return out
 
     # -- chaos surface ------------------------------------------------------
@@ -885,6 +960,12 @@ class HostFleetRouter(FleetRouter):
             "host breaker state: 0 healthy / 1 suspect / 2 ejected / "
             "3 half-open / 4 draining / 5 drained",
             labels=("host",))
+        #: parent-side telemetry federation: every handle's heartbeat
+        #: feeds it while armed; bundles embed its snapshot
+        self.federation = FederationHub()
+        for h in self.replicas.values():
+            if isinstance(h, HostHandle):
+                h.federation = self.federation
         # host-loss bundles embed the migration timeline + host states
         flight_recorder.attach_multihost(self)
 
@@ -924,10 +1005,15 @@ class HostFleetRouter(FleetRouter):
                    inflight=len(live), process_dead=process_dead,
                    migrations=len(self._migration_log))
         if process_dead:
+            # freeze the dead host's telemetry mirror as its last-known
+            # state — the host_lost bundle embeds it (host_telemetry.json)
+            self.federation.mark_lost(rid)
             # the pages died with the process: a surviving affinity
             # slice would route same-prefix traffic at a cold (or
             # never-returning) host on re-admission
             self.invalidate_index(rid)
+        else:
+            self.federation.mark_stale(rid, reason)
         super()._eject(rid, r, reason)
 
     # -- live migration -----------------------------------------------------
@@ -970,6 +1056,8 @@ class HostFleetRouter(FleetRouter):
                    "seconds": 0.0}
         for req in live:
             t0 = self._clock()
+            trace = spans_armed()
+            mig_ns0 = time.perf_counter_ns() if trace else 0
             mirror = req.handle
             try:
                 tokens, ks, vs = r.export_flight(mirror)
@@ -978,6 +1066,7 @@ class HostFleetRouter(FleetRouter):
                 imported = (d.import_prefix(tokens, ks, vs) if ks
                             else {"imported_pages": 0, "skipped_pages": 0,
                                   "imported_bytes": 0, "evicted_pages": 0})
+                dcn_ns1 = time.perf_counter_ns() if trace else 0
                 # pages now live at dst: teach the affinity index, free
                 # the src copy, land the continuation where the KV is
                 self._index_insert(dst, tokens)
@@ -987,6 +1076,21 @@ class HostFleetRouter(FleetRouter):
                     pass
                 self._dispatch(req, dst, None)
                 dt = self._clock() - t0
+                if trace:
+                    # the DCN window (export -> import) nests inside the
+                    # whole-migration span, so the exclusive sweep grows
+                    # dcn_transfer + migration segments that still tile
+                    # the root request envelope
+                    emit_span("router.dcn_transfer", mig_ns0, dcn_ns1,
+                              trace_id=req.trace_id,
+                              args={"request_id": req.rid,
+                                    "bytes": nbytes, "pages": len(ks)})
+                    emit_span("router.migration", mig_ns0,
+                              time.perf_counter_ns(),
+                              trace_id=req.trace_id,
+                              args={"request_id": req.rid, "src": src,
+                                    "dst": dst, "pages": len(ks),
+                                    "bytes": nbytes})
                 self._c_mig_bytes.inc(nbytes)
                 self._c_mig_pages.inc(len(ks))
                 self._c_mig_reqs.inc(outcome="ok")

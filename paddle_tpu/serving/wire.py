@@ -185,6 +185,94 @@ def decode_pages(meta: dict, arrays: Dict[str, np.ndarray]
     return list(ks), list(vs)
 
 
+# -- telemetry frames -------------------------------------------------------
+
+#: telemetry frame schema version — independent of WIRE_VERSION so the
+#: envelope and the observability payload can evolve separately; skew is
+#: refused at :func:`telemetry_from_wire` with the same structured error
+TELEMETRY_VERSION = 1
+
+
+def telemetry_to_wire(frame: dict) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Split a telemetry frame (``observability.federation.
+    collect_telemetry`` output) into JSON meta + arrays: span timestamps
+    travel as int64 arrays, everything else rides the JSON header."""
+    spans = frame.get("spans") or []
+    meta = {
+        "telemetry_version": TELEMETRY_VERSION,
+        "telemetry": {k: v for k, v in frame.items() if k != "spans"},
+        "span_names": [s["name"] for s in spans],
+        "span_types": [s.get("event_type", "UserDefined") for s in spans],
+        "span_traces": [s.get("trace_id", "") for s in spans],
+        "span_args": [s.get("args") for s in spans],
+    }
+    arrays: Dict[str, np.ndarray] = {}
+    if spans:
+        arrays["span_start_ns"] = np.asarray(
+            [s["start_ns"] for s in spans], np.int64)
+        arrays["span_end_ns"] = np.asarray(
+            [s["end_ns"] for s in spans], np.int64)
+    return meta, arrays
+
+
+def telemetry_from_wire(meta: dict, arrays: Dict[str, np.ndarray]) -> dict:
+    """Rebuild and validate a telemetry frame. Version skew and missing
+    or inconsistent columns die here with a structured
+    :class:`WireError` — a malformed frame never reaches a mirror."""
+    version = meta.get("telemetry_version")
+    if version != TELEMETRY_VERSION:
+        raise WireError(
+            "version_skew",
+            f"peer telemetry v{version}, this host v{TELEMETRY_VERSION}")
+    try:
+        base = dict(meta["telemetry"])
+        names = list(meta["span_names"])
+        types = list(meta["span_types"])
+        traces = list(meta["span_traces"])
+        argss = list(meta["span_args"])
+    except (KeyError, TypeError) as e:
+        raise WireError("schema", f"telemetry frame missing {e}")
+    for key in ("host_id", "pid", "seq", "t_ns"):
+        if key not in base:
+            raise WireError("schema", f"telemetry frame missing {key!r}")
+    n = len(names)
+    if not (len(types) == len(traces) == len(argss) == n):
+        raise WireError("schema",
+                        "telemetry span columns disagree on length")
+    spans = []
+    if n:
+        try:
+            starts, ends = arrays["span_start_ns"], arrays["span_end_ns"]
+        except KeyError as e:
+            raise WireError("schema", f"telemetry frame missing {e}")
+        if starts.shape[0] != n or ends.shape[0] != n:
+            raise WireError(
+                "schema", f"{n} spans but timestamp arrays are "
+                f"{starts.shape[0]}/{ends.shape[0]} deep")
+        for i in range(n):
+            spans.append({"name": names[i], "event_type": types[i],
+                          "start_ns": int(starts[i]),
+                          "end_ns": int(ends[i]),
+                          "trace_id": traces[i], "args": argss[i]})
+    base["spans"] = spans
+    return base
+
+
+def encode_telemetry(frame: dict) -> bytes:
+    """One standalone ``telemetry`` wire frame (the command reply embeds
+    the same meta/arrays inside its reply envelope instead)."""
+    meta, arrays = telemetry_to_wire(frame)
+    return encode_message("telemetry", meta, arrays)
+
+
+def decode_telemetry(buf: bytes) -> dict:
+    kind, meta, arrays = decode_message(buf)
+    if kind != "telemetry":
+        raise WireError("schema",
+                        f"expected a telemetry frame, got {kind!r}")
+    return telemetry_from_wire(meta, arrays)
+
+
 # -- compiled grammars ------------------------------------------------------
 
 def grammar_to_wire(dfa) -> Tuple[dict, Dict[str, np.ndarray]]:

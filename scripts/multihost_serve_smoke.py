@@ -32,6 +32,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+from paddle_tpu.observability.format import validate_exposition_text  # noqa: E402
+from paddle_tpu.observability.timeline import span_collector, timeline_armed  # noqa: E402
 from paddle_tpu.resilience import FaultInjector  # noqa: E402
 from paddle_tpu.serving import (  # noqa: E402
     HealthConfig, HostEndpoint, HostFleetRouter, HostHandle, PipeTransport,
@@ -80,18 +82,53 @@ def main() -> int:
         ref = [list(h.stream.tokens) for h in refs]
         assert all(len(t) == MAX_NEW for t in ref)
 
-        # 2. live migration mid-decode, pages included
+        # 2. live migration mid-decode, pages included — under an ARMED
+        # observability federation: every heartbeat ships a telemetry
+        # frame back over the pipe, remote spans are skew-corrected into
+        # the parent collector, and the migration must land in ONE
+        # merged trace tree
+        timeline_armed[0] = True
+        router.federation.arm()
+        router.step(None)         # prime: deliver arm=True to the hosts
         h = router.submit(prompts[0])
         for _ in range(4):
             router.step(None)
         src = h.replica_id
         mig = router.migrate_host(src)
         _drive(router)
+        router.step(None)         # flush the final telemetry frames
         assert list(h.stream.tokens) == ref[0], \
             "migrated continuation diverged from the fault-free run"
         assert mig["requests"] == 1 and mig["failed"] == 0
         assert mig["pages"] >= 1 and mig["bytes"] > 0, mig
         router.undrain(src)
+
+        # federated /metrics: ONE validator-clean exposition document
+        # covering the parent and both engine processes
+        fed_text = router.federation.federated_metrics_text()
+        validate_exposition_text(fed_text)
+        for lbl in ('host="parent"', 'host="h0"', 'host="h1"'):
+            assert lbl in fed_text, f"federated doc is missing {lbl}"
+
+        # merged cross-host trace: both hosts' spans in one tree, with
+        # migration / dcn_transfer segments tiling the root envelope
+        spans = span_collector.spans(h.trace_id)
+        span_hosts = {s.args["host"] for s in spans
+                      if s.args and "host" in s.args}
+        assert span_hosts == {0, 1}, span_hosts
+        tree = span_collector.tree(h.trace_id)
+        assert len(tree) == 1, "expected ONE merged trace tree"
+        att = span_collector.attribute(h.trace_id)
+        segs = att["segments"]
+        assert segs.get("migration", 0) > 0, segs
+        assert segs.get("dcn_transfer", 0) > 0, segs
+        tiling_err = abs(sum(segs.values()) - att["e2e_ms"])
+        assert tiling_err <= 0.01 * att["e2e_ms"], (segs, att["e2e_ms"])
+        mirrors = {hid: router.federation.mirror(hid) for hid in (0, 1)}
+        assert all(m.frames > 0 and m.spans_merged > 0
+                   for m in mirrors.values()), {
+            hid: (m.frames, m.spans_merged) for hid, m in mirrors.items()}
+        reconcile_ms = router.federation.reconcile_error_s() * 1e3
 
         # 3. seeded host death mid-decode (a real SIGKILL). seeded_hosts
         # schedules 1-based steps; rebase onto the router's live counter
@@ -129,6 +166,14 @@ def main() -> int:
             "migration": {"pages": mig["pages"], "bytes": mig["bytes"],
                           "skipped_pages": mig["skipped_pages"],
                           "ms": round(mig["seconds"] * 1e3, 3)},
+            "federation": {
+                "trace_hosts": sorted(span_hosts),
+                "migration_segment_ms": round(segs["migration"], 3),
+                "dcn_transfer_segment_ms": round(segs["dcn_transfer"], 3),
+                "tiling_err_ms": round(tiling_err, 6),
+                "reconcile_error_ms": round(reconcile_ms, 3),
+                "frames": {f"h{hid}": m.frames
+                           for hid, m in mirrors.items()}},
             "seeded_kill": {"host": dead, "step": inj.fired[0][1] - base},
             "failovers": failovers,
             "slo": monitor.health(),

@@ -1,0 +1,520 @@
+"""Fleet observability federation (ISSUE 18): per-host telemetry
+mirrors, clock-offset estimation, merged cross-host surfaces.
+
+Pure-unit tests exercise :class:`ClockSync` math, exposition merging and
+the :class:`FederationHub` mirror lifecycle with fabricated frames
+(hermetic registries + collectors, synthetic clock offsets). Fleet tests
+run real beats over ``LocalTransport`` — every telemetry frame
+round-trips the wire encoder — covering the statusz-staleness satellite,
+the heartbeat RTT histogram, and the dead-host ``host_telemetry.json``
+bundle round-trip."""
+
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability import get_registry
+from paddle_tpu.observability.federation import (ClockSync, FederationHub,
+                                                 collect_telemetry,
+                                                 merge_expositions)
+from paddle_tpu.observability.flight import flight_recorder
+from paddle_tpu.observability.format import validate_exposition_text
+from paddle_tpu.observability.registry import MetricsRegistry
+from paddle_tpu.observability.signals import SignalBus
+from paddle_tpu.observability.timeline import SpanCollector, timeline_armed
+from paddle_tpu.serving import (HealthConfig, HostEndpoint, HostFleetRouter,
+                                HostHandle, HostServer, LocalTransport,
+                                RouterConfig, SchedulerConfig)
+from paddle_tpu.serving.multihost import llama_tiny_host
+
+CFG = L.llama_tiny(num_hidden_layers=2)
+
+#: a pid that is never this process (frames from "real" remote hosts)
+OTHER_PID = os.getpid() + 1
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def armed_timeline():
+    timeline_armed[0] = True
+    yield
+    timeline_armed[0] = False
+
+
+def _frame(host_id, seq, spans=(), pid=OTHER_PID, t_ns=None, gauges=None,
+           metrics_text="", signals=None):
+    return {"host_id": host_id, "pid": pid, "seq": seq,
+            "t_ns": 0 if t_ns is None else t_ns,
+            "metrics_text": metrics_text, "gauges": dict(gauges or {}),
+            "signals": dict(signals or {}), "events": [], "memory": {},
+            "spans": list(spans)}
+
+
+def _span(name, start_ns, end_ns, trace_id="tr", args=None):
+    return {"name": name, "event_type": "UserDefined",
+            "start_ns": int(start_ns), "end_ns": int(end_ns),
+            "trace_id": trace_id, "args": args}
+
+
+def _hub(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("collector", SpanCollector())
+    return FederationHub(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ClockSync: offset from the RPC midpoint, RTT/2 error bound
+# ---------------------------------------------------------------------------
+
+def test_clocksync_estimates_offset_from_midpoint():
+    cs = ClockSync()
+    # remote clock runs 5 ms AHEAD; symmetric 2 ms round-trip
+    cs.observe(t_send_ns=1_000_000, t_recv_ns=3_000_000,
+               t_remote_ns=2_000_000 + 5_000_000)
+    assert cs.offset_ns == pytest.approx(5_000_000)
+    assert cs.error_bound_ns == pytest.approx(1_000_000)   # rtt / 2
+    # corrected = remote - offset: back in the local domain
+    assert cs.correct(10_000_000 + 5_000_000) == pytest.approx(10_000_000)
+
+
+def test_clocksync_ewma_converges_and_discards_negative_rtt():
+    cs = ClockSync(alpha=0.5)
+    for i in range(20):
+        base = i * 10_000_000
+        cs.observe(base, base + 2_000_000, base + 1_000_000 + 7_000_000)
+    assert cs.offset_ns == pytest.approx(7_000_000, rel=1e-6)
+    n = cs.samples
+    cs.observe(5_000_000, 4_000_000, 0)         # clock went backwards
+    assert cs.samples == n                      # discarded
+    snap = cs.snapshot()
+    assert snap["offset_ms"] == pytest.approx(7.0, rel=1e-6)
+    assert snap["rtt_p50_ms"] == pytest.approx(2.0, rel=1e-6)
+
+
+def test_clocksync_rtt_quantiles_over_window():
+    cs = ClockSync()
+    for ms in (1, 2, 3, 4, 5, 6, 7, 8, 9, 10):
+        cs.observe(0, ms * 1_000_000, ms * 500_000)
+    assert cs.rtt_quantile(0.5) == pytest.approx(6_000_000)
+    assert cs.rtt_quantile(0.9) == pytest.approx(10_000_000)
+
+
+# ---------------------------------------------------------------------------
+# exposition merging: one valid doc, deterministic bytes
+# ---------------------------------------------------------------------------
+
+def _exposition(value):
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x").inc(value)
+    reg.gauge("y_gauge", "y", labels=("k",)).set(value, k="a")
+    return reg.prometheus_text()
+
+
+def test_merge_expositions_byte_identical_and_valid():
+    docs = {"parent": _exposition(1), "h0": _exposition(2),
+            "h1": _exposition(3)}
+    merged = merge_expositions(docs)
+    validate_exposition_text(merged)
+    # deterministic: same docs (any insertion order) -> same bytes
+    reordered = {"h1": docs["h1"], "parent": docs["parent"],
+                 "h0": docs["h0"]}
+    assert merge_expositions(reordered) == merged
+    # every sample carries its host, host label FIRST
+    assert 'x_total{host="parent"} 1' in merged
+    assert 'x_total{host="h0"} 2' in merged
+    assert 'y_gauge{host="h1",k="a"} 3' in merged
+    # one TYPE line per family across all hosts
+    assert merged.count("# TYPE x_total counter") == 1
+
+
+def test_merge_expositions_preserves_existing_host_label():
+    doc = ('# TYPE paddle_host_state gauge\n'
+           'paddle_host_state{host="0"} 2\n')
+    merged = merge_expositions({"parent": doc})
+    validate_exposition_text(merged)
+    # the parent's own host-labeled family passes through unchanged
+    assert 'paddle_host_state{host="0"} 2' in merged
+    assert 'host="parent"' not in merged
+
+
+def test_merged_histograms_stay_cumulative_per_host():
+    def doc(n):
+        reg = MetricsRegistry()
+        h = reg.histogram("z_seconds", "z", bounds=(1.0, 2.0),
+                          quantiles=None)
+        for _ in range(n):
+            h.observe(1.5)
+        return reg.prometheus_text()
+    merged = merge_expositions({"h0": doc(1), "h1": doc(3)})
+    # host label sits BEFORE le=, so the validator's bucket-monotonicity
+    # check runs per host, not across hosts
+    validate_exposition_text(merged)
+    assert 'z_seconds_bucket{host="h0",le="2"} 1' in merged
+    assert 'z_seconds_bucket{host="h1",le="2"} 3' in merged
+
+
+# ---------------------------------------------------------------------------
+# FederationHub: mirrors, skew-corrected span merge, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_ingest_merges_remote_spans_skew_corrected(armed_timeline):
+    hub = _hub()
+    offset = 5_000_000_000          # h7's clock runs 5 s ahead
+    hub.observe_rtt(7, 1_000_000, 3_000_000, 2_000_000 + offset)
+    spans = [_span("engine.prefill", offset + 100, offset + 200),
+             _span("engine.decode_chunk", offset + 300, offset + 400)]
+    merged = hub.ingest(7, _frame(7, seq=0, spans=spans))
+    assert merged == 2
+    got = sorted(hub._collector.spans("tr"), key=lambda s: s.start_ns)
+    # timestamps landed back in the LOCAL clock domain
+    assert [s.start_ns for s in got] == [100, 300]
+    assert [s.end_ns for s in got] == [200, 400]
+    # provenance: every merged span is tagged with its host
+    assert all(s.args["host"] == 7 for s in got)
+    m = hub.mirror(7)
+    assert m.frames == 1 and m.spans_merged == 2 and not m.stale
+
+
+def test_skew_correction_restores_cross_host_ordering(armed_timeline):
+    """Property: spans emitted at known TRUE local times, shipped with
+    per-host clock offsets, come back correctly ordered after skew
+    correction — and each corrected timestamp is inside the estimator's
+    error bound."""
+    hub = _hub()
+    offsets = {0: 3_000_000_000, 1: -2_000_000_000}   # +3 s, -2 s
+    rtt = 2_000_000                                   # 2 ms, symmetric
+    for hid, off in offsets.items():
+        for k in range(8):        # converge the EWMA on exact samples
+            base = k * 10_000_000
+            hub.observe_rtt(hid, base, base + rtt,
+                            base + rtt // 2 + off)
+    # interleaved true timeline: (true_start_ns, host)
+    truth = [(1_000, 0), (2_000, 1), (3_000, 0), (4_000, 1), (5_000, 0)]
+    for seq, (t, hid) in enumerate(truth):
+        sp = _span("engine.decode_chunk", offsets[hid] + t,
+                   offsets[hid] + t + 500)
+        assert hub.ingest(hid, _frame(hid, seq=seq, spans=[sp])) == 1
+    got = sorted(hub._collector.spans("tr"), key=lambda s: s.start_ns)
+    assert [s.args["host"] for s in got] == [h for _, h in truth]
+    bound = max(m.clock.error_bound_ns for m in hub._live_mirrors())
+    assert hub.reconcile_error_s() == pytest.approx(bound / 1e9)
+    for s, (t, _) in zip(got, truth):
+        assert abs(s.start_ns - t) <= bound     # within the stated bound
+    assert bound == pytest.approx(rtt / 2)
+
+
+def test_trace_tree_merge_is_deterministic(armed_timeline):
+    """Same frames -> byte-identical merged trace trees."""
+    frames = []
+    for seq in range(3):
+        spans = [_span("paddle_host_h0.request", 1_000, 9_000),
+                 _span("engine.prefill", 2_000 + seq, 4_000 + seq)]
+        frames.append(_frame(0, seq=seq, spans=spans))
+    trees = []
+    for _ in range(2):
+        hub = _hub()
+        for fr in frames:
+            hub.ingest(0, dict(fr))
+        trees.append(json.dumps(hub._collector.tree("tr"),
+                                sort_keys=True))
+    assert trees[0] == trees[1]
+
+
+def test_ingest_dedupes_stale_seq_and_freezes_lost(armed_timeline):
+    hub = _hub()
+    assert hub.ingest(3, _frame(3, seq=5)) == 0     # no spans, ingested
+    assert hub.mirror(3).seq == 5
+    sp = [_span("step", 1, 2)]
+    assert hub.ingest(3, _frame(3, seq=5, spans=sp)) == 0   # duplicate
+    assert hub.mirror(3).frames == 1
+    hub.mark_lost(3)
+    assert hub.ingest(3, _frame(3, seq=9, spans=sp)) == 0   # frozen
+    m = hub.mirror(3)
+    assert m.lost and m.stale and m.seq == 5
+
+
+def test_same_process_frames_skip_span_injection(armed_timeline):
+    """LocalTransport mirrors share this process's collector — their
+    spans are already there, so re-injection would double-count."""
+    hub = _hub()
+    fr = _frame(0, seq=0, pid=os.getpid(), spans=[_span("step", 1, 2)])
+    assert hub.ingest(0, fr) == 0
+    assert hub.mirror(0).frames == 1      # frame still mirrored
+    assert hub._collector.spans("tr") == []
+
+
+def test_mark_stale_keeps_last_frame_and_counts_gauge():
+    reg = MetricsRegistry()
+    hub = _hub(registry=reg)
+    hub.ingest(2, _frame(2, seq=0, gauges={"queue_depth": 4.0}))
+    hub.mark_stale(2, "HostFault('no reply')")
+    m = hub.mirror(2)
+    assert m.stale and m.frame["gauges"]["queue_depth"] == 4.0
+    assert m.stale_error == "HostFault('no reply')"
+    assert reg.get("paddle_federation_stale_mirrors").value() == 1.0
+    hub.ingest(2, _frame(2, seq=1))
+    assert not hub.mirror(2).stale
+    assert reg.get("paddle_federation_stale_mirrors").value() == 0.0
+
+
+def test_federated_metrics_text_is_one_valid_doc():
+    hub = _hub()
+    hub.ingest(0, _frame(0, seq=0, metrics_text=_exposition(2)))
+    hub.ingest(1, _frame(1, seq=0, metrics_text=_exposition(5)))
+    # a same-process mirror's doc is excluded (families already in the
+    # parent text via the shared registry)
+    hub.ingest(2, _frame(2, seq=0, pid=os.getpid(),
+                         metrics_text=_exposition(9)))
+    text = hub.federated_metrics_text()
+    validate_exposition_text(text)
+    assert 'x_total{host="h0"} 2' in text
+    assert 'x_total{host="h1"} 5' in text
+    assert 'x_total{host="h2"} 9' not in text
+    # the parent's own families are in the same doc: host-labeled series
+    # pass through unchanged, unlabeled ones get host="parent"
+    assert 'paddle_federation_frames_total{host="h0"} 1' in text
+    assert 'paddle_federation_stale_mirrors{host="parent"}' in text
+
+
+def test_fleet_signals_aggregate_mirrors():
+    hub = _hub()
+    for hid, (depth, util) in {0: (3.0, 0.5), 1: (5.0, 0.9)}.items():
+        hub.observe_rtt(hid, 0, 4_000_000, 2_000_000)    # 4 ms rtt
+        hub.ingest(hid, _frame(
+            hid, seq=0,
+            gauges={"queue_depth": depth, "page_utilization": util},
+            signals={"serving.slo_burn": {"value": 0.25 * (hid + 1)}}))
+    clock = FakeClock()
+    bus = SignalBus(clock=clock, interval_s=0.0)
+    hub.attach_fleet_signals(bus)
+    bus.arm()
+    try:
+        clock.advance(1.0)
+        bus.tick(clock())
+        vals = bus.values()
+    finally:
+        bus.disarm()
+    assert vals["fleet.queue_depth"]["raw"] == pytest.approx(8.0)
+    assert vals["fleet.pool_pressure"]["raw"] == pytest.approx(0.9)
+    assert vals["fleet.burn_rate"]["raw"] == pytest.approx(0.5)
+    assert vals["host_rtt_p90"]["raw"] == pytest.approx(0.004)
+    assert vals["h0.queue_depth"]["raw"] == pytest.approx(3.0)
+    assert vals["h1.rtt_ms"]["raw"] == pytest.approx(4.0)
+
+
+def test_snapshot_and_fleet_varz_shapes():
+    hub = _hub()
+    hub.ingest(0, _frame(0, seq=2))
+    hub.mark_lost(1)
+    snap = hub.snapshot()
+    assert snap["kind"] == "paddle_tpu.host_telemetry"
+    assert snap["hosts"]["h0"]["seq"] == 2
+    assert snap["hosts"]["h1"]["lost"]
+    json.dumps(snap)                    # bundle member must serialize
+    fv = hub.fleet_varz()
+    assert set(fv) == {"armed", "reconcile_error_ms", "hosts"}
+    assert fv["hosts"]["h0"]["frames"] == 1
+
+
+def test_collect_telemetry_frame_shape():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x").inc()
+    coll = SpanCollector()
+    timeline_armed[0] = True
+    try:
+        from paddle_tpu.profiler.record import HostSpan
+        coll.note_span(HostSpan("engine.prefill", "UserDefined", 1, 2,
+                                0, os.getpid(), "tr", None))
+        marks = {}
+        fr = collect_telemetry(4, marks, seq=0, registry=reg,
+                               collector=coll)
+        assert fr["host_id"] == 4 and fr["pid"] == os.getpid()
+        assert "x_total" in fr["metrics_text"]
+        assert [s["name"] for s in fr["spans"]] == ["engine.prefill"]
+        # watermarks: a second collection exports nothing new
+        fr2 = collect_telemetry(4, marks, seq=1, registry=reg,
+                                collector=coll)
+        assert fr2["spans"] == []
+    finally:
+        timeline_armed[0] = False
+
+
+# ---------------------------------------------------------------------------
+# fleet integration over LocalTransport (wire-framed beats, fake clock)
+# ---------------------------------------------------------------------------
+
+def _local_fleet(n=2, max_new=8, health_kw=None, **fkw):
+    fkw.setdefault("max_new_tokens", max_new)
+    fkw.setdefault("max_seq_len", 48)
+    clock = FakeClock()
+    hosts = []
+    for i in range(n):
+        eng, params = llama_tiny_host(**fkw)
+        server = HostServer(eng, params, host_id=i,
+                            scheduler_config=SchedulerConfig(
+                                max_step_retries=1, retry_backoff_s=0.01))
+        ep = HostEndpoint(LocalTransport(server), clock=clock,
+                          sleep=clock.sleep)
+        hosts.append(HostHandle(
+            i, ep, health_config=HealthConfig(**(health_kw or {})),
+            clock=clock, sleep=clock.sleep))
+    router = HostFleetRouter(
+        hosts, config=RouterConfig(failover_backoff_s=0.0),
+        clock=clock, sleep=clock.sleep)
+    return router, clock, hosts
+
+
+def _prompt(seed=0, n=9):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def test_beats_populate_mirrors_and_rtt_histogram():
+    router, clock, hosts = _local_fleet(n=2)
+    hist = get_registry().get("paddle_host_heartbeat_rtt_seconds")
+    c0 = hist.hist(host="h0").count
+    router.federation.arm()
+    try:
+        h = router.submit(_prompt(), max_new_tokens=6)
+        for _ in range(4):
+            router.step(None)
+            clock.advance(0.05)
+        for hid in (0, 1):
+            m = router.federation.mirror(hid)
+            assert m.frames >= 4 and not m.stale
+            assert m.frame["gauges"].keys() >= {"queue_depth", "inflight"}
+            # the child's namespaced registry families ride along
+            assert f"paddle_host_h{hid}" in m.frame["metrics_text"]
+        # satellite: the RTT histogram is fed from the same beats
+        assert hist.hist(host="h0").count - c0 >= 4
+        while router.pending:
+            router.step(None)
+            clock.advance(0.05)
+        assert h.state == "done"
+    finally:
+        router.federation.disarm()
+
+
+def test_disarmed_federation_does_no_telemetry_rpcs():
+    router, clock, hosts = _local_fleet(n=1)
+    router.submit(_prompt(), max_new_tokens=6)
+    calls0 = hosts[0].endpoint.calls
+    steps = 0
+    while router.pending:
+        router.step(None)
+        clock.advance(0.05)
+        steps += 1
+    # exactly one RPC per heartbeat: no telemetry traffic while disarmed
+    assert hosts[0].endpoint.calls - calls0 == steps
+
+
+def test_statusz_failure_marks_view_stale_with_counter():
+    router, clock, hosts = _local_fleet(n=2)
+    c = get_registry().get("paddle_host_statusz_errors_total")
+    e0 = c.value(host="h0")
+    st = hosts[0].statusz()
+    assert st["host"]["host_id"] == 0 and st["host"]["stale"] is False
+    t_ok = clock()
+    clock.advance(5.0)
+    hosts[0].kill()
+    st = hosts[0].statusz()
+    # unreachable endpoint: cached view, visibly stale, counted
+    assert st["host"]["stale"] is True
+    assert st["host"]["host_id"] == 0            # last good view kept
+    assert "HostFault" in st["host"]["stale_error"]
+    assert st["host"]["last_success_t"] == t_ok
+    assert c.value(host="h0") - e0 == 1.0
+
+
+def test_dead_host_bundle_embeds_last_telemetry_mirror(tmp_path):
+    """Kill -> eject -> the auto-dumped bundle un-tars with a
+    ``host_telemetry.json`` whose dead-host mirror holds the pre-kill
+    frame, frozen at mark_lost."""
+    flight_recorder.clear()   # reset the once-per-reason dump latch
+    flight_recorder.arm(dump_dir=str(tmp_path))
+    router, clock, hosts = _local_fleet(
+        n=2, health_kw={"probe_cooldown_s": 1e9})
+    router.federation.arm()
+    try:
+        h = router.submit(_prompt(), max_new_tokens=8)
+        for _ in range(3):
+            router.step(None)
+            clock.advance(0.05)
+        victim = h.replica_id
+        pre_kill_seq = router.federation.mirror(victim).seq
+        assert pre_kill_seq >= 0
+        hosts[victim].kill()
+        steps = 0
+        while router.pending:
+            router.step(None)
+            clock.advance(0.05)
+            steps += 1
+            assert steps < 500
+        assert h.state == "done"
+        m = router.federation.mirror(victim)
+        assert m.lost and m.seq == pre_kill_seq      # frozen at death
+        bundles = list(tmp_path.glob(
+            f"paddle_debug_replica_ejected_{victim}*"))
+        assert bundles, list(tmp_path.iterdir())
+        with tarfile.open(bundles[0]) as tf:
+            tel = json.loads(tf.extractfile("host_telemetry.json").read())
+        dead = tel["hosts"][f"h{victim}"]
+        assert dead["lost"] and dead["seq"] == pre_kill_seq
+        assert dead["frame"]["gauges"]["inflight"] >= 1   # pre-kill state
+        assert f"paddle_host_h{victim}" in dead["frame"]["metrics_text"]
+    finally:
+        router.federation.disarm()
+        flight_recorder.disarm()
+        flight_recorder.clear()
+
+
+def test_migration_grows_segments_that_tile_the_envelope(tmp_path):
+    """LocalTransport edition of the acceptance arc: a mid-stream
+    migration under an armed timeline grows ``migration`` +
+    ``dcn_transfer`` segments and the exclusive sweep still tiles the
+    root envelope exactly."""
+    from paddle_tpu.observability.timeline import span_collector
+    timeline_armed[0] = True
+    router, clock, hosts = _local_fleet(n=2, max_new=12)
+    router.federation.arm()
+    try:
+        h = router.submit(_prompt(), max_new_tokens=12)
+        for _ in range(4):
+            router.step(None)
+            clock.advance(0.05)
+        assert not h.done
+        summary = router.migrate_host(h.replica_id)
+        assert summary["requests"] == 1
+        steps = 0
+        while router.pending:
+            router.step(None)
+            clock.advance(0.05)
+            steps += 1
+            assert steps < 500
+        att = span_collector.attribute(h.trace_id)
+        segs = att["segments"]
+        assert segs.get("migration", 0) > 0
+        assert segs.get("dcn_transfer", 0) > 0
+        # exclusive segments tile the root envelope exactly
+        assert sum(segs.values()) == pytest.approx(att["e2e_ms"],
+                                                   rel=1e-6)
+    finally:
+        router.federation.disarm()
+        timeline_armed[0] = False

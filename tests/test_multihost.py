@@ -394,6 +394,7 @@ def test_host_die_storm_byte_identical_no_leaks_no_slo_breach(tmp_path):
     refs = [_ref_tokens(p, 8) for p in prompts]
 
     inj = FaultInjector(schedule=[Fault("host_die", 3, host=0)])
+    flight_recorder.clear()   # reset the once-per-reason dump latch
     flight_recorder.arm(dump_dir=str(tmp_path))
     br0 = _counter_total("paddle_slo_breaches_total")
     try:

@@ -12,11 +12,14 @@ import pytest
 
 from paddle_tpu.kvcache.cache import PrefixCache
 from paddle_tpu.kvcache.pool import RefcountedKVCacheManager
-from paddle_tpu.serving.wire import (MAGIC, PREAMBLE_NBYTES, WIRE_VERSION,
+from paddle_tpu.serving.wire import (MAGIC, PREAMBLE_NBYTES,
+                                     TELEMETRY_VERSION, WIRE_VERSION,
                                      WireError, decode_message,
-                                     decode_pages, encode_message,
-                                     encode_pages, grammar_from_wire,
-                                     grammar_to_wire)
+                                     decode_pages, decode_telemetry,
+                                     encode_message, encode_pages,
+                                     encode_telemetry, grammar_from_wire,
+                                     grammar_to_wire, telemetry_from_wire,
+                                     telemetry_to_wire)
 
 
 def _mgr(num_pages=12, page_size=4):
@@ -325,4 +328,90 @@ def test_grammar_frame_missing_array_is_schema_error():
     arrays.pop("grammar_accepting")
     with pytest.raises(WireError) as ei:
         grammar_from_wire(meta, arrays)
+    assert ei.value.code == "schema"
+
+
+# ---------------------------------------------------------------------------
+# telemetry frames (observability federation payload)
+# ---------------------------------------------------------------------------
+
+def _telemetry_frame(n_spans=2):
+    return {
+        "host_id": 3, "pid": 4242, "seq": 7, "t_ns": 123456789,
+        "metrics_text": "# TYPE x_total counter\nx_total 1\n",
+        "gauges": {"queue_depth": 2.0}, "signals": {}, "events": [],
+        "memory": {"kv_live": 4096},
+        "spans": [{"name": "engine.prefill", "event_type": "UserDefined",
+                   "start_ns": 100 + i, "end_ns": 200 + i,
+                   "trace_id": "req-1", "args": {"request_id": i}}
+                  for i in range(n_spans)],
+    }
+
+
+def test_telemetry_roundtrip_through_the_wire():
+    frame = _telemetry_frame()
+    got = decode_telemetry(encode_telemetry(frame))
+    assert got == frame
+
+
+def test_telemetry_truncated_frame_rejected():
+    buf = encode_telemetry(_telemetry_frame())
+    with pytest.raises(WireError) as ei:
+        decode_telemetry(buf[:PREAMBLE_NBYTES - 1])
+    assert ei.value.code == "truncated"
+    with pytest.raises(WireError) as ei:
+        decode_telemetry(buf[:-10])
+    assert ei.value.code in ("truncated", "checksum_mismatch")
+
+
+def test_telemetry_version_skew_refused():
+    meta, arrays = telemetry_to_wire(_telemetry_frame())
+    meta["telemetry_version"] = TELEMETRY_VERSION + 1
+    with pytest.raises(WireError) as ei:
+        telemetry_from_wire(meta, arrays)
+    err = ei.value
+    assert err.code == "version_skew"
+    assert str(TELEMETRY_VERSION + 1) in err.detail
+    # ...and the telemetry version is independent of the envelope's:
+    # a frame with a skewed ENVELOPE dies in decode_message first
+    buf = bytearray(encode_telemetry(_telemetry_frame()))
+    struct.pack_into("<H", buf, 4, WIRE_VERSION + 1)
+    with pytest.raises(WireError) as ei:
+        decode_telemetry(bytes(buf))
+    assert ei.value.code == "version_skew"
+
+
+def test_telemetry_missing_required_field_rejected():
+    for key in ("host_id", "pid", "seq", "t_ns"):
+        meta, arrays = telemetry_to_wire(_telemetry_frame())
+        del meta["telemetry"][key]
+        with pytest.raises(WireError) as ei:
+            telemetry_from_wire(meta, arrays)
+        assert ei.value.code == "schema" and key in ei.value.detail
+
+
+def test_telemetry_span_column_mismatch_rejected():
+    # a frame whose span columns disagree never reaches a mirror
+    meta, arrays = telemetry_to_wire(_telemetry_frame())
+    meta["span_types"] = meta["span_types"][:-1]
+    with pytest.raises(WireError) as ei:
+        telemetry_from_wire(meta, arrays)
+    assert ei.value.code == "schema"
+    # missing timestamp arrays
+    meta, arrays = telemetry_to_wire(_telemetry_frame())
+    arrays.pop("span_end_ns")
+    with pytest.raises(WireError) as ei:
+        telemetry_from_wire(meta, arrays)
+    assert ei.value.code == "schema"
+    # short timestamp arrays
+    meta, arrays = telemetry_to_wire(_telemetry_frame())
+    arrays["span_start_ns"] = arrays["span_start_ns"][:-1]
+    with pytest.raises(WireError) as ei:
+        telemetry_from_wire(meta, arrays)
+    assert ei.value.code == "schema"
+
+
+def test_telemetry_decode_rejects_foreign_kind():
+    with pytest.raises(WireError) as ei:
+        decode_telemetry(encode_message("kv", {"a": 1}))
     assert ei.value.code == "schema"
