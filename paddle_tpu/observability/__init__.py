@@ -113,7 +113,9 @@ from .server import DiagServer  # noqa: F401
 from .slo import (  # noqa: F401
     SLObjective, SLOMonitor, latency_objective, ratio_objective,
 )
-from .signals import SignalBus  # noqa: F401
+from .signals import (  # noqa: F401
+    SIGNAL_SNAPSHOT_VERSION, SignalBus, SignalSnapshot,
+)
 from .step_timer import StepTimer  # noqa: F401
 from .timeline import SpanCollector, span_collector  # noqa: F401
 from .timeseries import MetricHistory  # noqa: F401
@@ -132,7 +134,8 @@ __all__ = [
     "GoodputTracker", "StragglerDetector", "FlightRecorder",
     "flight_recorder", "DiagServer", "SpanCollector", "span_collector",
     "DispatchChainProfiler", "chain_profiler", "MetricHistory",
-    "SignalBus", "AnomalyMonitor", "RobustZScoreDetector",
+    "SignalBus", "SignalSnapshot", "SIGNAL_SNAPSHOT_VERSION",
+    "AnomalyMonitor", "RobustZScoreDetector",
     "CusumDetector", "robust_zscore", "CapacityPlan", "MemoryLedger",
     "memory_ledger", "plan_capacity", "pool_occupancy", "pytree_nbytes",
     "ClockSync", "FederationHub", "HostTelemetryMirror",

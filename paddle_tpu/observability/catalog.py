@@ -61,6 +61,16 @@ METRICS = {
     "paddle_router_replica_state": ("gauge", ("replica",)),
     "paddle_router_failovers_total": ("counter", ()),
     "paddle_router_prefix_affinity_hits_total": ("counter", ()),
+    "paddle_router_parked_age_seconds": ("histogram", ()),
+    # -- disaggregated prefill/decode fleet (serving/roles.py) ---------------
+    "paddle_router_replica_role": ("gauge", ("replica",)),
+    "paddle_handoff_requests_total": ("counter", ("outcome",)),
+    "paddle_handoff_pages_total": ("counter", ()),
+    "paddle_handoff_bytes_total": ("counter", ()),
+    "paddle_handoff_seconds": ("histogram", ()),
+    # -- autoscaling control plane (serving/autoscale.py) --------------------
+    "paddle_autoscale_decisions_total": ("counter", ("action",)),
+    "paddle_autoscale_replicas": ("gauge", ()),
     # -- speculative decoding (inference/speculative.py) -------------------
     "paddle_spec_drafted_tokens_total": ("counter", ("replica",)),
     "paddle_spec_accepted_tokens_total": ("counter", ("replica",)),
@@ -119,6 +129,14 @@ EVENT_KINDS = {
     # multi-host serving: an engine PROCESS died / a live request's KV
     # pages crossed a host boundary (graceful drain or loss recovery)
     "host_lost", "page_migration",
+    # fleet router: an unroutable parked request's deadline lapsed
+    # before any replica healed (the all-down shed scale-up watches)
+    "parked_expired",
+    # disaggregated fleet: a replica changed phase role / a finished
+    # prefill's KV pages handed off to a decode replica
+    "role_changed", "kv_handoff",
+    # autoscaling control plane: the fleet changed shape
+    "scale_up", "scale_down",
     # prefix cache
     "cache_hit", "cache_evict",
     # speculative decoding (draft rejection -> per-row paged rollback)
@@ -166,6 +184,9 @@ SPANS = {
     # segments in cross-host trace trees
     "router.migration": ("request_id", "src", "dst", "pages", "bytes"),
     "router.dcn_transfer": ("request_id", "bytes", "pages"),
+    # disaggregated fleet: one prefill->decode KV handoff (export ->
+    # wire round-trip -> import -> redispatch), serving/roles.py
+    "router.kv_handoff": ("request_id", "src", "dst", "pages", "bytes"),
 }
 
 

@@ -67,6 +67,7 @@ class FlightRecorder:
         self._signals = None
         self._elastic = None
         self._multihost = None
+        self._autoscale = None
         self._auto_dumped: Dict[str, str] = {}   # reason -> bundle path
         self.dumps = 0
 
@@ -131,6 +132,15 @@ class FlightRecorder:
         migration record (``HostFleetRouter.__init__`` wires this; a
         later fleet replaces the earlier one)."""
         self._multihost = router
+
+    def attach_autoscale(self, controller) -> None:
+        """Autoscaling control plane: its ``timeline_snapshot()`` — the
+        fleet's roles, in-flight drain operations and the versioned
+        ``ScaleRecord`` decision ring — lands in ``autoscale.json`` of
+        every bundle, so a scaling postmortem replays the exact signal
+        snapshots each decision saw (``AutoscaleController.__init__``
+        wires this; a later controller replaces the earlier one)."""
+        self._autoscale = controller
 
     def attach_signals(self, bus) -> None:
         """Sensor plane: the SignalBus's ``history_snapshot()`` — metric
@@ -292,6 +302,16 @@ class FlightRecorder:
                     tel = {"error": repr(e)}
                 members["host_telemetry.json"] = json.dumps(
                     tel, default=str, indent=1).encode()
+        if self._autoscale is not None:
+            # the scaling decision ring (records + the signal snapshots
+            # they decided on) — a torn controller must not lose the
+            # bundle
+            try:
+                sc = self._autoscale.timeline_snapshot()
+            except Exception as e:
+                sc = {"error": repr(e)}
+            members["autoscale.json"] = json.dumps(
+                sc, default=str, indent=1).encode()
         if self._signals is not None:
             # the sensor plane's bounded window: series, signal trends
             # and anomalies leading up to this dump (a torn bus must not

@@ -30,6 +30,11 @@ route      serves
            per-request page holders, prefix-cache stats and the last
            OOM — the same document every flight bundle embeds as
            ``memory.json``
+/scalez    the autoscaling control plane (``AutoscaleController.
+           timeline_snapshot`` via :meth:`DiagServer.attach_autoscale`):
+           fleet roles, in-flight drain operations and the versioned
+           ScaleRecord decision ring — each record carries the exact
+           signal snapshot it decided on
 ========== ==============================================================
 
 Providers are callables returning JSON-able data, registered with
@@ -83,6 +88,7 @@ class DiagServer:
         self._health_fns: Dict[str, Callable[[], str]] = {}
         self._signals = None
         self._federation = None
+        self._autoscale = None
         if monitor is not None:
             self.add_health_source("slo", monitor.health)
             self.add_statusz("slo", monitor.states)
@@ -131,6 +137,14 @@ class DiagServer:
 
     def attach_kvcache(self, cache) -> None:
         self.add_statusz("kvcache", cache.statusz)
+
+    def attach_autoscale(self, controller) -> None:
+        """Autoscaling control plane: mounts the controller's
+        ``timeline_snapshot()`` (fleet roles, in-flight operations, the
+        versioned ScaleRecord decision ring with the signal snapshots
+        each decision saw) at ``/scalez`` and a summary on /statusz."""
+        self._autoscale = controller
+        self.add_statusz("autoscale", controller.timeline_snapshot)
 
     def attach_federation(self, hub) -> None:
         """Telemetry federation (:class:`~.federation.FederationHub`):
@@ -238,6 +252,15 @@ class DiagServer:
                             self._send(200, json.dumps(
                                 server._signals.varz(), default=str,
                                 indent=1).encode())
+                    elif route == "/scalez":
+                        if server._autoscale is None:
+                            self._send(404, json.dumps(
+                                {"error": "no autoscaler attached"}
+                            ).encode())
+                        else:
+                            self._send(200, json.dumps(
+                                server._autoscale.timeline_snapshot(),
+                                default=str, indent=1).encode())
                     elif route == "/memz":
                         self._send(200, json.dumps(
                             memory_ledger.snapshot(), default=str,
@@ -256,7 +279,8 @@ class DiagServer:
                         self._send(200, json.dumps({
                             "endpoints": ["/metrics", "/healthz",
                                           "/statusz", "/debugz",
-                                          "/tracez", "/varz", "/memz"],
+                                          "/tracez", "/varz", "/memz",
+                                          "/scalez"],
                         }).encode())
                     else:
                         self._send(404, b'{"error":"not found"}')

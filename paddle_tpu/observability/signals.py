@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from .anomaly import AnomalyMonitor
@@ -106,6 +107,104 @@ def _spec_acceptance(engine) -> float:
     if spec is None:
         return 1.0
     return float(spec.snapshot().get("acceptance_ratio", 1.0))
+
+
+#: bump when :class:`SignalSnapshot` gains/renames a field — the
+#: autoscaler refuses a mismatched document instead of mis-reading it
+SIGNAL_SNAPSHOT_VERSION = 1
+
+
+@dataclass(frozen=True)
+class SignalSnapshot:
+    """The ROADMAP-4 autoscaler input contract, promoted from prose to
+    code: ONE versioned document shared by the :class:`SignalBus`
+    (:meth:`SignalBus.snapshot_contract`), every flight bundle's
+    ``history.json`` (embedded as ``contract``) and
+    ``AutoscalePolicy.decide`` — the three can no longer silently drift.
+
+    Fleet-level fields aggregate whatever signal set is registered:
+    a scheduler-attached bus reports its own ``queue_depth`` /
+    ``page_pressure`` readers directly; a router-attached bus
+    aggregates the per-replica ``r<id>.*`` signals (sum for depths,
+    max for burn/pressure, min for acceptance). ``per_replica`` keeps
+    the unaggregated per-replica values (keyed ``"r<id>"``) for
+    policies that pick WHICH replica to act on."""
+
+    schema_version: int
+    t: float
+    queue_depth: float          # fleet-total queued admissions
+    queue_depth_trend: float    # units/second slope over the bus window
+    queue_wait_share: float     # queue_wait's share of e2e latency
+    page_pressure: float        # worst paged-pool occupancy in [0, 1]
+    slo_fast_burn: float        # worst fast-window burn across objectives
+    spec_acceptance: float      # worst speculation acceptance (1 = off)
+    pending: float              # router pending (routed + parked)
+    parked: float               # requests with NO routable replica
+    per_replica: Dict[str, Dict[str, float]] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "SignalSnapshot":
+        ver = doc.get("schema_version")
+        if ver != SIGNAL_SNAPSHOT_VERSION:
+            raise ValueError(
+                f"SignalSnapshot schema_version {ver!r} != "
+                f"{SIGNAL_SNAPSHOT_VERSION} — refusing to mis-read a "
+                "drifted contract")
+        fields = {k: doc[k] for k in (
+            "schema_version", "t", "queue_depth", "queue_depth_trend",
+            "queue_wait_share", "page_pressure", "slo_fast_burn",
+            "spec_acceptance", "pending", "parked")}
+        per = {str(k): {str(s): float(x) for s, x in v.items()}
+               for k, v in doc.get("per_replica", {}).items()}
+        return cls(per_replica=per, **fields)
+
+    @classmethod
+    def from_bus(cls, bus: "SignalBus") -> "SignalSnapshot":
+        vals = bus.values()
+
+        def val(name: str, default: float = 0.0) -> float:
+            e = vals.get(name)
+            return default if e is None or e["value"] is None \
+                else float(e["value"])
+
+        def trend(name: str) -> float:
+            e = vals.get(name)
+            return 0.0 if e is None else float(e["trend_per_s"])
+
+        per: Dict[str, Dict[str, float]] = {}
+        for name, e in vals.items():
+            head, dot, sig = name.partition(".")
+            if (dot and head.startswith("r") and head[1:].isdigit()
+                    and e["value"] is not None):
+                per.setdefault(head, {})[sig] = float(e["value"])
+        if "queue_depth" in vals:
+            qd = val("queue_depth")
+            qd_trend = trend("queue_depth")
+        else:
+            qd = sum(d.get("queue_depth", 0.0) for d in per.values())
+            qd_trend = sum(trend(f"{r}.queue_depth") for r in per)
+        if "page_pressure" in vals:
+            pressure = val("page_pressure")
+        else:
+            pressure = max((d.get("page_pressure", 0.0)
+                            for d in per.values()), default=0.0)
+        burn = max([val("slo_burn")]
+                   + [d.get("slo_burn", 0.0) for d in per.values()])
+        acc = min([val("spec_acceptance", 1.0)]
+                  + [d.get("spec_acceptance", 1.0)
+                     for d in per.values()])
+        return cls(
+            schema_version=SIGNAL_SNAPSHOT_VERSION,
+            t=round(bus._clock(), 6),
+            queue_depth=qd, queue_depth_trend=qd_trend,
+            queue_wait_share=val("queue_wait_share"),
+            page_pressure=pressure, slo_fast_burn=burn,
+            spec_acceptance=acc,
+            pending=val("fleet.pending"), parked=val("fleet.parked"),
+            per_replica=per)
 
 
 class SignalBus:
@@ -219,6 +318,17 @@ class SignalBus:
                         lambda r=r: _max_fast_burn(r.slo_monitor))
             self.signal(f"r{rid}.spec_acceptance",
                         lambda r=r: _spec_acceptance(r.engine))
+            self.signal(f"r{rid}.page_pressure",
+                        lambda r=r: _pool_pressure(r.engine))
+            # unsmoothed 0/1: can this replica take traffic NOW? The
+            # autoscaler's role-balance math weighs only routable
+            # replicas (a dead prefill replica must not read as "idle
+            # prefill capacity" and mask the backlog)
+            self.signal(f"r{rid}.routable",
+                        lambda r=r: float(r.health.accepting
+                                          and not r.draining
+                                          and not r.degraded),
+                        smooth=1.0, detect=False)
         return self
 
     # -- the hot-path entry (callers gate on history_armed[0]) --------------
@@ -296,6 +406,13 @@ class SignalBus:
             "history": self.history.snapshot_status(),
         }
 
+    def snapshot_contract(self) -> SignalSnapshot:
+        """The versioned autoscaler input document
+        (:class:`SignalSnapshot`) over this bus's current values — what
+        ``AutoscalePolicy.decide`` consumes and ``history.json``
+        embeds."""
+        return SignalSnapshot.from_bus(self)
+
     def history_snapshot(self) -> Dict[str, Any]:
         """The ``history.json`` bundle member: the trailing window of
         every series plus signal values and emitted anomalies — the
@@ -307,6 +424,7 @@ class SignalBus:
             "generated_t": round(self._clock(), 6),
             "window_s": self.window_s,
             "signals": self.values(),
+            "contract": self.snapshot_contract().as_dict(),
             "series": self.history.snapshot(self.window_s),
             "anomalies": self.monitor.recent(),
         }

@@ -29,6 +29,10 @@ Quick start::
     print(sched.metrics.to_prometheus_text())
 """
 
+from .autoscale import (  # noqa: F401
+    AutoscaleConfig, AutoscaleController, AutoscalePolicy, Decision,
+    ScaleRecord,
+)
 from .elastic import (  # noqa: F401
     ElasticServingController, FlightSnapshot, ResizeRecord,
 )
@@ -41,6 +45,7 @@ from .multihost import (  # noqa: F401
     LocalTransport, PipeTransport, RemoteRequest,
 )
 from .replica import ReplicaFault, ReplicaHandle  # noqa: F401
+from .roles import DisaggRouter, ReplicaRole  # noqa: F401
 from .router import FleetRouter, RouterConfig, RouterRequest  # noqa: F401
 from .scheduler import (  # noqa: F401
     RequestState, SchedulerConfig, ServingRequest, ServingScheduler,
@@ -61,4 +66,6 @@ __all__ = [
     "HostServer", "LocalTransport", "PipeTransport", "RemoteRequest",
     "WIRE_VERSION", "WireError", "encode_message", "decode_message",
     "encode_pages", "decode_pages",
+    "DisaggRouter", "ReplicaRole", "AutoscaleConfig", "AutoscaleController",
+    "AutoscalePolicy", "Decision", "ScaleRecord",
 ]

@@ -148,6 +148,9 @@ class RouterRequest:
     redispatched: bool = field(default=False, repr=False)  # any dispatch
     # after the first is remediation (failover / drain handoff) and is
     # exempt from the sibling scheduler's queue-cap shedding
+    _parked_t: float = field(default=0.0, repr=False)  # when the request
+    # last parked (no routable replica); the parked-age histogram and
+    # the parked_expired shed event observe the wait from it
     first_token_t: Optional[float] = None
     failover_t: Optional[float] = None
     finish_t: Optional[float] = None
@@ -223,6 +226,11 @@ class FleetRouter:
             "paddle_router_prefix_affinity_hits_total",
             "requests routed to the replica with the longest cached "
             "prefix overlap")
+        self._h_parked_age = reg.histogram(
+            "paddle_router_parked_age_seconds",
+            "time a request waited parked (no routable replica) before "
+            "a dispatch or its deadline shed — the all-down backlog "
+            "age the autoscaler's scale-up watches")
         # ejection bundles must be self-contained: the flight recorder
         # embeds this fleet's /statusz view (fleet.json) and the active
         # request timelines (timelines.json) in every debug bundle
@@ -372,6 +380,7 @@ class FleetRouter:
                 req.handle = None
                 req.replica_id = None
                 if req not in self._parked:
+                    req._parked_t = self._clock()
                     self._parked.append(req)
                 return
             try:
@@ -440,6 +449,7 @@ class FleetRouter:
         req.replica_id = rid
         if req in self._parked:
             self._parked.remove(req)
+            self._h_parked_age.observe(max(now - req._parked_t, 0.0))
         # index optimistically at dispatch so a burst of same-prefix
         # requests coalesces onto one replica from the first routing
         self._index_insert(rid, [int(t) for t in prompt])
@@ -723,8 +733,13 @@ class FleetRouter:
                                f"replica-side {h.state}")
 
     def _shed_parked(self, req: RouterRequest) -> None:
+        age = max(self._clock() - req._parked_t, 0.0)
         if req in self._parked:
             self._parked.remove(req)
+            self._h_parked_age.observe(age)
+        emit_event("parked_expired", request_id=req.rid,
+                   trace_id=req.trace_id, age_s=round(age, 6),
+                   deadline_t=req.deadline_t)
         self._finish(req, RequestState.SHED, "shed:deadline",
                      ServingError("shed_deadline",
                                   f"request {req.rid} unroutable past "
@@ -847,6 +862,40 @@ class FleetRouter:
         self.replicas[rid] = handle
         self.invalidate_index(rid, page_size=handle.engine.page_size)
         self._probe.pop(rid, None)
+
+    def add_replica(self, handle: ReplicaHandle) -> None:
+        """Grow the fleet: register a NEW replica id with a cold prefix
+        index — the autoscaler's scale-up actuation. The handle must
+        share the fleet's clock; it starts taking traffic on the next
+        routing decision."""
+        rid = handle.replica_id
+        if rid in self.replicas:
+            raise ValueError(f"replica {rid} already in the fleet "
+                             "(use replace_replica to swap engines)")
+        self.replicas[rid] = handle
+        self._index[rid] = RadixTree(handle.engine.page_size)
+        self._g_state.set(self._state_code(handle), replica=str(rid))
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Shrink the fleet: deregister a replica that owns no live
+        requests — the autoscaler's scale-down completion, after a
+        graceful drain emptied it. Raises while anything is still
+        assigned (drain first), and refuses to remove the last
+        replica."""
+        if replica_id not in self.replicas:
+            raise KeyError(f"no replica {replica_id} in the fleet")
+        if len(self.replicas) == 1:
+            raise RuntimeError("cannot remove the last replica")
+        live = [req for req in self._requests.values()
+                if req.replica_id == replica_id and req.handle is not None
+                and not req.done]
+        if live:
+            raise RuntimeError(
+                f"replica {replica_id} still owns {len(live)} live "
+                "requests; drain it first")
+        self.replicas.pop(replica_id)
+        self._index.pop(replica_id, None)
+        self._probe.pop(replica_id, None)
 
     # -- observability ------------------------------------------------------
 

@@ -39,44 +39,47 @@ if [ "$only_sentinel" = "1" ]; then
     exit $?
 fi
 
-echo "== [1/9] tpu-lint (python -m paddle_tpu.analysis; incl. dataflow: page-leak/dtype-flow/cache-key) =="
+echo "== [1/10] tpu-lint (python -m paddle_tpu.analysis; incl. dataflow: page-leak/dtype-flow/cache-key) =="
 s0=$SECONDS
 python -m paddle_tpu.analysis || exit $?
 echo "tpu-lint stage wall: $((SECONDS - s0))s (in-process budget 5s — regressions show here)"
 
-echo "== [2/9] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
+echo "== [2/10] bench_obs_overhead (armed sensor+timeline plane, 3% budget) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_obs_overhead.py || exit $?
 
 if [ "$fast" = "1" ]; then
-    echo "== [3-9/9] fusion + multichip + multihost + sentinel + tier-1 skipped (--fast) =="
+    echo "== [3-10/10] fusion + multichip + multihost + disagg + sentinel + tier-1 skipped (--fast) =="
     exit 0
 fi
 
-echo "== [3/9] fusion pass smoke (profile -> pass -> install, stale skips) =="
+echo "== [3/10] fusion pass smoke (profile -> pass -> install, stale skips) =="
 JAX_PLATFORMS=cpu python scripts/fusion_smoke.py || exit $?
 
-echo "== [4/9] bench_fusion ABBA gates + sentinel fresh-line judgement =="
+echo "== [4/10] bench_fusion ABBA gates + sentinel fresh-line judgement =="
 JAX_PLATFORMS=cpu python benchmarks/bench_fusion.py > /tmp/_fusion_line.json \
     || exit $?
 tail -n 1 /tmp/_fusion_line.json | python scripts/bench_sentinel.py \
     --fresh - --min-history 1 --rel-floor 0.3 || exit $?
 
-echo "== [5/9] multichip serve smoke (mp=2 storm, chip kill, byte-identical rejoin) =="
+echo "== [5/10] multichip serve smoke (mp=2 storm, chip kill, byte-identical rejoin) =="
 JAX_PLATFORMS=cpu python scripts/multichip_serve_smoke.py || exit $?
 
-echo "== [6/9] multihost serve smoke (2 processes, page migration, seeded host kill) =="
+echo "== [6/10] multihost serve smoke (2 processes, page migration, seeded host kill) =="
 JAX_PLATFORMS=cpu python scripts/multihost_serve_smoke.py || exit $?
 
-echo "== [7/9] bench_router resize recovery + sentinel fresh-line judgement =="
+echo "== [7/10] disagg serve smoke (prefill/decode handoff byte-identity, autoscaler vs 10x burst) =="
+JAX_PLATFORMS=cpu python scripts/disagg_serve_smoke.py || exit $?
+
+echo "== [8/10] bench_router resize recovery + sentinel fresh-line judgement =="
 JAX_PLATFORMS=cpu python benchmarks/bench_router.py > /tmp/_router_line.json \
     || exit $?
 tail -n 1 /tmp/_router_line.json | python scripts/bench_sentinel.py \
     --fresh - --min-history 1 --rel-floor 0.4 || exit $?
 
-echo "== [8/9] bench_sentinel (trajectory replay) =="
+echo "== [9/10] bench_sentinel (trajectory replay) =="
 python scripts/bench_sentinel.py --replay || exit $?
 
-echo "== [9/9] tier-1 test suite =="
+echo "== [10/10] tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
