@@ -13,7 +13,8 @@ per-request attribution — ISSUE 12). Contract:
   (measured here with tracemalloc);
 * ARMED (monitor ticking every round, flight ring + span collector
   recording, chain profiler counting, signal bus sampling/detecting,
-  memory ledger accounting) the per-step overhead stays **< 3%**
+  memory ledger accounting, incident-journal ring recording) the
+  per-step overhead stays **< 3%**
   budget (the ISSUE 10/11/12 acceptance bar).
 
 Methodology: fine-grained mode interleaving on ONE live scheduler under
@@ -111,6 +112,7 @@ def main():
     from paddle_tpu.observability import flight_recorder
     from paddle_tpu.observability.events import event_log
     from paddle_tpu.observability.flight import flight_armed
+    from paddle_tpu.observability.journal import journal, journal_armed
     from paddle_tpu.observability.memory import memory_armed, memory_ledger
     from paddle_tpu.observability.profiling import (chain_armed,
                                                     chain_profiler)
@@ -146,6 +148,7 @@ def main():
             span_collector.arm()
             chain_profiler.arm()
             memory_ledger.arm()
+            journal.arm(capacity=256)
             bus.arm()
             sched.slo_monitor = monitor
             sched.signal_bus = bus
@@ -154,6 +157,7 @@ def main():
             span_collector.disarm()
             chain_profiler.disarm()
             memory_ledger.disarm()
+            journal.disarm()
             bus.disarm()
             sched.slo_monitor = None
             sched.signal_bus = None
@@ -277,6 +281,7 @@ def main():
     assert not flight_armed[0] and event_log.path is None
     assert not timeline_armed[0] and not chain_armed[0]
     assert not history_armed[0] and not memory_armed[0]
+    assert not journal_armed[0]
     tracemalloc.start()
     before = tracemalloc.get_traced_memory()[0]
     for _ in range(20_000):
@@ -290,6 +295,7 @@ def main():
         _ = chain_armed[0]
         _ = history_armed[0]
         _ = memory_armed[0]
+        _ = journal_armed[0]
     after = tracemalloc.get_traced_memory()[0]
     tracemalloc.stop()
     disarmed_alloc = max(0, after - before - baseline)

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..flags import flag_value
 from ..observability.events import emit_event
+from ..observability.journal import token_checksum
 from ..observability.memory import memory_armed, memory_ledger
 from ..observability.profiling import chain_armed as _chain_armed
 from ..observability.profiling import note_chain as _note_chain
@@ -456,6 +457,9 @@ class ContinuousBatchingEngine:
         self._queue: list = []                    # pending _Request
         self._live: Dict[int, _Request] = {}      # rid -> request (slotted)
         self._finished: Dict[int, list] = {}
+        self._finished_crc: Dict[int, int] = {}  # rid -> crc32 of the
+        # retired output, stamped in _retire — the engine-side checksum
+        # the postmortem journal pairs against the router's stream crc
         self._next_rid = 0
         # slot tokens stay ON DEVICE (no per-admit readback); positions
         # are host-mirrored analytically
@@ -1042,6 +1046,7 @@ class ContinuousBatchingEngine:
         if not cancelled:
             out = req.tokens[:self._budget(req)]
             self._finished[rid] = out
+            self._finished_crc[rid] = token_checksum(out)
             if self.cache is not None:
                 # index the finished prefix BEFORE release: pages backing
                 # its full token blocks stay resident (refcount 0, cached)
@@ -1947,6 +1952,13 @@ class ContinuousBatchingEngine:
         out = self._finished
         self._finished = {}
         return out
+
+    def finished_checksum(self, rid: int) -> Optional[int]:
+        """crc32 of the tokens ``_retire`` produced for ``rid`` (None if
+        the request never finished, e.g. cancelled). Survives
+        ``collect()`` so serving layers can stamp terminal journal
+        frames after draining the finished map."""
+        return self._finished_crc.get(rid)
 
     def serve(self, params, prompts) -> list:
         """Stream a list of prompts through the fixed slots; returns the

@@ -36,6 +36,15 @@ needs (every future perf PR must be measurable):
 * :mod:`.flight` — flight recorder: last-N events/spans/metric deltas
   in bounded rings, postmortem ``dump_debug_bundle`` tarballs,
   auto-dump hooks on watchdog timeout / NaN rollback / degrade.
+* :mod:`.journal` — black-box incident journal: a bounded, armed-gated
+  ring of versioned, crc-signed frames recording the serving fleet's
+  nondeterminism frontier (arrivals + resolved seeds, injected-clock
+  samples, chaos firings, breaker transitions, stream checksums),
+  embedded in flight bundles as ``journal.jsonl``.
+* :mod:`.replay` — deterministic postmortem replay:
+  ``python -m paddle_tpu.observability.replay bundle.tar.gz`` rebuilds
+  the fleet from the journal head frame, re-drives the incident and
+  reports byte-identical streams or the first divergence.
 * :mod:`.timeline` — request timelines: a bounded :class:`SpanCollector`
   assembles the span stream into per-request span trees (one trace id
   across router → replica → scheduler → engine, failovers included) and
@@ -98,6 +107,9 @@ from .federation import (  # noqa: F401
 )
 from .flight import FlightRecorder, flight_recorder  # noqa: F401
 from .goodput import GoodputTracker, StragglerDetector  # noqa: F401
+from .journal import (  # noqa: F401
+    JournalError, JournalRecorder, journal, journal_armed, token_checksum,
+)
 from .memory import (  # noqa: F401
     CapacityPlan, MemoryLedger, memory_ledger, plan_capacity,
     pool_occupancy, pytree_nbytes,
@@ -140,4 +152,6 @@ __all__ = [
     "memory_ledger", "plan_capacity", "pool_occupancy", "pytree_nbytes",
     "ClockSync", "FederationHub", "HostTelemetryMirror",
     "collect_telemetry", "federation_armed", "merge_expositions",
+    "JournalError", "JournalRecorder", "journal", "journal_armed",
+    "token_checksum",
 ]

@@ -95,6 +95,9 @@ METRICS = {
     "paddle_federation_clock_offset_seconds": ("gauge", ("host",)),
     "paddle_federation_clock_error_bound_seconds": ("gauge", ("host",)),
     "paddle_federation_stale_mirrors": ("gauge", ()),
+    # -- black-box incident journal (observability/journal.py) --------------
+    "paddle_journal_frames_total": ("counter", ("type",)),
+    "paddle_journal_dropped_total": ("counter", ()),
     # -- prefix cache (kvcache/cache.py) -----------------------------------
     "paddle_kvcache_hits_total": ("counter", ()),
     "paddle_kvcache_misses_total": ("counter", ()),
@@ -121,6 +124,9 @@ EVENT_KINDS = {
     "recompile",
     # flight recorder
     "debug_dump",
+    # incident journal: decode hit a torn/empty tail (power-loss
+    # analogue) — the readable prefix is still served, but flagged
+    "journal_truncated",
     # fleet router
     "replica_ejected", "replica_recovered", "replica_draining",
     "replica_drained", "failover",

@@ -36,6 +36,17 @@ postmortem) and ``manifest.json`` (reason, counts, config).
 :meth:`auto_dump` is the hook the runtime calls on watchdog timeouts,
 NaN rollbacks and scheduler degradation — it rate-limits to one bundle
 per reason so a crash loop cannot fill the disk.
+
+Bundle schema hygiene (ISSUE 20): every JSON-object member carries a
+top-level ``schema_version`` and the manifest maps EVERY member to its
+declared version (``schema_versions``) — list/JSONL members
+(``slo.json``, ``events.jsonl``, ``journal.jsonl``) are versioned
+through the manifest alone, since injecting keys/header lines would
+break their consumers. :func:`validate_bundle` is the one shared
+structural validator (postmortem replay refuses through it); when the
+black-box journal is armed, its versioned frame ring is embedded as
+``journal.jsonl`` and ``python -m paddle_tpu.observability.replay``
+can re-execute the bundle.
 """
 
 from __future__ import annotations
@@ -52,6 +63,32 @@ from typing import Any, Deque, Dict, List, Optional
 #: the one cell hot paths check before touching the recorder (mutable
 #: list so callers read a stable module attribute, not a rebindable name)
 flight_armed = [False]
+
+#: declared schema version per bundle member; the manifest's
+#: ``schema_versions`` map and :func:`validate_bundle` enforce these.
+#: Bump a member's entry when its shape changes incompatibly.
+BUNDLE_SCHEMAS = {
+    "metrics.prom": 1, "metrics.json": 1, "events.jsonl": 1,
+    "trace.json": 1, "slo.json": 1, "fleet.json": 1,
+    "timelines.json": 1, "elastic.json": 1, "multihost.json": 1,
+    "host_telemetry.json": 1, "autoscale.json": 1, "history.json": 1,
+    "memory.json": 1, "journal.jsonl": 1, "manifest.json": 1,
+}
+
+
+class BundleError(Exception):
+    """Structural bundle-validation failure; codes mirror
+    ``serving.wire.WireError`` (``truncated`` / ``version_skew`` /
+    ``schema`` / ``checksum_mismatch``)."""
+
+    def __init__(self, code: str, detail: str = ""):
+        self.code = code
+        self.detail = detail
+        super().__init__(f"bundle {code}: {detail}")
+
+    def as_dict(self) -> Dict[str, str]:
+        return {"error": "bundle", "code": self.code,
+                "detail": self.detail}
 
 
 class FlightRecorder:
@@ -240,17 +277,34 @@ class FlightRecorder:
                 os.makedirs(d, exist_ok=True)
         reg = get_registry()
         members: Dict[str, bytes] = {}
+        schema_versions: Dict[str, int] = {}
+
+        def _put_json(name: str, obj) -> None:
+            # every JSON-object member declares its schema_version
+            # inline; list members (slo.json) are versioned through the
+            # manifest's schema_versions map only — their consumers
+            # index them positionally and a header entry would break
+            # them
+            if isinstance(obj, dict):
+                obj = dict(obj)
+                obj.setdefault("schema_version",
+                               BUNDLE_SCHEMAS.get(name, 1))
+                schema_versions[name] = int(obj["schema_version"])
+            else:
+                schema_versions[name] = BUNDLE_SCHEMAS.get(name, 1)
+            members[name] = json.dumps(
+                obj, default=str, indent=1).encode()
+
         members["metrics.prom"] = reg.prometheus_text().encode()
-        members["metrics.json"] = json.dumps(
-            reg.snapshot(), default=str, indent=1).encode()
+        schema_versions["metrics.prom"] = BUNDLE_SCHEMAS["metrics.prom"]
+        _put_json("metrics.json", reg.snapshot())
         members["events.jsonl"] = "".join(
             json.dumps(e, default=str, separators=(",", ":")) + "\n"
             for e in events).encode()
-        members["trace.json"] = json.dumps(
-            self._chrome_trace(spans)).encode()
+        schema_versions["events.jsonl"] = BUNDLE_SCHEMAS["events.jsonl"]
+        _put_json("trace.json", self._chrome_trace(spans))
         if self._slo_monitor is not None:
-            members["slo.json"] = json.dumps(
-                self._slo_monitor.states(), indent=1).encode()
+            _put_json("slo.json", self._slo_monitor.states())
         if self._router is not None:
             # the fleet view at dump time; a torn router (this bundle may
             # BE the ejection postmortem) must not lose the whole bundle
@@ -258,8 +312,7 @@ class FlightRecorder:
                 fleet = self._router.statusz()
             except Exception as e:
                 fleet = {"error": repr(e)}
-            members["fleet.json"] = json.dumps(
-                fleet, default=str, indent=1).encode()
+            _put_json("fleet.json", fleet)
         from .timeline import span_collector, timeline_armed
         if timeline_armed[0] or self._router is not None:
             # request timelines: the slowest-request exemplars (tree +
@@ -269,8 +322,7 @@ class FlightRecorder:
                 tz = span_collector.tracez()
             except Exception as e:
                 tz = {"error": repr(e)}
-            members["timelines.json"] = json.dumps(
-                tz, default=str, indent=1).encode()
+            _put_json("timelines.json", tz)
         if self._elastic is not None:
             # the resize state machine (chip losses, per-phase timeline,
             # checkpointed flight state) — a torn controller must not
@@ -279,8 +331,7 @@ class FlightRecorder:
                 el = self._elastic.timeline_snapshot()
             except Exception as e:
                 el = {"error": repr(e)}
-            members["elastic.json"] = json.dumps(
-                el, default=str, indent=1).encode()
+            _put_json("elastic.json", el)
         if self._multihost is not None:
             # the multi-host fleet view: endpoint health + the page-
             # migration timeline (a torn fleet must not lose the bundle)
@@ -288,8 +339,7 @@ class FlightRecorder:
                 mh = self._multihost.multihost_snapshot()
             except Exception as e:
                 mh = {"error": repr(e)}
-            members["multihost.json"] = json.dumps(
-                mh, default=str, indent=1).encode()
+            _put_json("multihost.json", mh)
             hub = getattr(self._multihost, "federation", None)
             if hub is not None:
                 # every host's last-known telemetry mirror — for a
@@ -300,8 +350,7 @@ class FlightRecorder:
                     tel = hub.snapshot()
                 except Exception as e:
                     tel = {"error": repr(e)}
-                members["host_telemetry.json"] = json.dumps(
-                    tel, default=str, indent=1).encode()
+                _put_json("host_telemetry.json", tel)
         if self._autoscale is not None:
             # the scaling decision ring (records + the signal snapshots
             # they decided on) — a torn controller must not lose the
@@ -310,8 +359,7 @@ class FlightRecorder:
                 sc = self._autoscale.timeline_snapshot()
             except Exception as e:
                 sc = {"error": repr(e)}
-            members["autoscale.json"] = json.dumps(
-                sc, default=str, indent=1).encode()
+            _put_json("autoscale.json", sc)
         if self._signals is not None:
             # the sensor plane's bounded window: series, signal trends
             # and anomalies leading up to this dump (a torn bus must not
@@ -320,8 +368,7 @@ class FlightRecorder:
                 hist = self._signals.history_snapshot()
             except Exception as e:
                 hist = {"error": repr(e)}
-            members["history.json"] = json.dumps(
-                hist, default=str, indent=1).encode()
+            _put_json("history.json", hist)
         from .memory import memory_armed, memory_ledger
         if memory_armed[0]:
             # the memory ledger's books: class bytes + peaks, per-pool
@@ -332,13 +379,23 @@ class FlightRecorder:
                 mem = memory_ledger.snapshot()
             except Exception as e:
                 mem = {"error": repr(e)}
-            members["memory.json"] = json.dumps(
-                mem, default=str, indent=1).encode()
+            _put_json("memory.json", mem)
+        from .journal import journal, journal_armed
+        if journal_armed[0]:
+            # the black-box journal: the run's nondeterminism frontier,
+            # versioned + crc-per-line — this member makes the bundle a
+            # runnable incident (observability/replay.py)
+            members["journal.jsonl"] = journal.encode()
+            schema_versions["journal.jsonl"] = \
+                BUNDLE_SCHEMAS["journal.jsonl"]
+        schema_versions["manifest.json"] = BUNDLE_SCHEMAS["manifest.json"]
         members["manifest.json"] = json.dumps({
+            "schema_version": BUNDLE_SCHEMAS["manifest.json"],
             "reason": reason, "pid": os.getpid(),
             "capacity": self._capacity, "events": len(events),
             "spans": len(spans), "metric_samples": len(metric_samples),
             "metric_deltas": metric_samples,
+            "schema_versions": schema_versions,
         }, default=str, indent=1).encode()
         with tarfile.open(target, "w:gz") as tar:
             for name, data in members.items():
@@ -372,3 +429,74 @@ class FlightRecorder:
 
 #: the process-global recorder the runtime hooks dump into
 flight_recorder = FlightRecorder()
+
+
+def validate_bundle(path: str) -> Dict[str, Any]:
+    """THE shared structural validator for debug bundles: every member
+    accounted for in the manifest's ``schema_versions`` map, every
+    declared version one this tree speaks (:data:`BUNDLE_SCHEMAS`),
+    every JSON member parseable with its inline ``schema_version``
+    agreeing with the manifest, and an embedded ``journal.jsonl``
+    passing its own versioned/checksummed decode. Raises
+    :class:`BundleError` (or ``journal.JournalError`` for a torn
+    journal member); returns ``{"path", "members", "manifest",
+    "journal"}`` with ``journal`` a ``DecodedJournal`` or None."""
+    members: Dict[str, bytes] = {}
+    try:
+        with tarfile.open(path, "r:gz") as tar:
+            for info in tar.getmembers():
+                f = tar.extractfile(info)
+                members[info.name] = f.read() if f is not None else b""
+    except (OSError, tarfile.TarError) as e:
+        raise BundleError("truncated", f"unreadable tarball: {e!r}")
+    if "manifest.json" not in members:
+        raise BundleError("schema", "bundle has no manifest.json")
+    try:
+        manifest = json.loads(members["manifest.json"])
+    except Exception:
+        raise BundleError("schema", "manifest.json is not JSON")
+    svs = manifest.get("schema_versions")
+    if not isinstance(svs, dict):
+        raise BundleError(
+            "schema", "manifest declares no schema_versions map "
+                      "(pre-ISSUE-20 bundle?)")
+    for name in members:
+        if name not in svs:
+            raise BundleError(
+                "schema",
+                f"member {name!r} missing from manifest schema_versions")
+        declared = BUNDLE_SCHEMAS.get(name)
+        if declared is not None and int(svs[name]) != declared:
+            raise BundleError(
+                "version_skew",
+                f"{name}: bundle declares schema_version {svs[name]}, "
+                f"this tree speaks {declared}")
+    for name, data in members.items():
+        if name.endswith(".json"):
+            try:
+                obj = json.loads(data)
+            except Exception:
+                raise BundleError("schema", f"{name} is not valid JSON")
+            if isinstance(obj, dict) \
+                    and obj.get("schema_version") != int(svs[name]):
+                raise BundleError(
+                    "schema",
+                    f"{name}: inline schema_version "
+                    f"{obj.get('schema_version')!r} != manifest "
+                    f"{svs[name]}")
+        elif name == "events.jsonl":
+            lines = data.decode("utf-8", errors="replace").splitlines()
+            for i, line in enumerate(lines):
+                if not line:
+                    continue
+                try:
+                    json.loads(line)
+                except Exception:
+                    raise BundleError(
+                        "schema", f"events.jsonl line {i} is not JSON")
+    decoded = None
+    if "journal.jsonl" in members:
+        from .journal import decode_journal
+        decoded = decode_journal(members["journal.jsonl"])
+    return {"path": path, "members": members, "manifest": manifest,
+            "journal": decoded}

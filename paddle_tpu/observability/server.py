@@ -30,6 +30,10 @@ route      serves
            per-request page holders, prefix-cache stats and the last
            OOM — the same document every flight bundle embeds as
            ``memory.json``
+/journalz  the black-box incident journal (``observability.journal``):
+           armed state, ring occupancy, drop count; ``?tail=N`` adds
+           the last N frames — the live view of what a postmortem
+           replay would re-execute
 /scalez    the autoscaling control plane (``AutoscaleController.
            timeline_snapshot`` via :meth:`DiagServer.attach_autoscale`):
            fleet roles, in-flight drain operations and the versioned
@@ -62,6 +66,7 @@ from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .flight import flight_recorder
+from .journal import journal
 from .memory import memory_ledger
 from .registry import get_registry
 from .timeline import span_collector
@@ -99,6 +104,8 @@ class DiagServer:
         # HBM ledger summary (class bytes + planner verdicts); the full
         # per-request document is /memz
         self.add_statusz("memory", memory_ledger.statusz)
+        # incident-journal ring occupancy; the frame tail is /journalz
+        self.add_statusz("journal", journal.snapshot_status)
 
     # -- wiring -------------------------------------------------------------
 
@@ -261,6 +268,14 @@ class DiagServer:
                             self._send(200, json.dumps(
                                 server._autoscale.timeline_snapshot(),
                                 default=str, indent=1).encode())
+                    elif route == "/journalz":
+                        q = parse_qs(url.query)
+                        body = journal.snapshot_status()
+                        tail = q.get("tail", [None])[0]
+                        if tail:
+                            body["tail"] = journal.tail(int(tail))
+                        self._send(200, json.dumps(
+                            body, default=str, indent=1).encode())
                     elif route == "/memz":
                         self._send(200, json.dumps(
                             memory_ledger.snapshot(), default=str,
@@ -280,7 +295,7 @@ class DiagServer:
                             "endpoints": ["/metrics", "/healthz",
                                           "/statusz", "/debugz",
                                           "/tracez", "/varz", "/memz",
-                                          "/scalez"],
+                                          "/journalz", "/scalez"],
                         }).encode())
                     else:
                         self._send(404, b'{"error":"not found"}')

@@ -85,6 +85,22 @@ class ChaosError(RuntimeError):
     """The injected step exception."""
 
 
+#: JSON schema version for :meth:`FaultInjector.to_json` round-trips
+FAULTS_SCHEMA_VERSION = 1
+
+_FAULT_FIELDS = ("event", "step", "replica", "chip", "host", "delay_s")
+
+
+def _fault_id(event: str, step: int, replica=None, chip=None,
+              host=None) -> str:
+    """Stable, human-greppable id for one firing: scope parts that
+    don't apply render as ``-`` so ids align in logs."""
+    return (f"{event}@s{int(step)}"
+            f":r{replica if replica is not None else '-'}"
+            f":c{chip if chip is not None else '-'}"
+            f":h{host if host is not None else '-'}")
+
+
 @dataclass(frozen=True)
 class Fault:
     """One scheduled fault: ``event`` fires when the runtime reaches
@@ -102,6 +118,15 @@ class Fault:
     #: injected per-call transfer latency (seconds) for ``link_slow``
     delay_s: Optional[float] = None
 
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in _FAULT_FIELDS}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        return cls(event=str(d["event"]), step=int(d["step"]),
+                   replica=d.get("replica"), chip=d.get("chip"),
+                   host=d.get("host"), delay_s=d.get("delay_s"))
+
 
 @dataclass
 class FaultInjector:
@@ -109,6 +134,47 @@ class FaultInjector:
     #: (event, step) for unscoped faults, (event, step, replica) for
     #: replica-scoped ones — unpack accordingly when a schedule mixes both
     fired: List[Tuple] = field(default_factory=list)
+    #: parallel record stream with STABLE ids + fully resolved scope
+    #: (wildcards filled with the consumer that fired them) — the
+    #: black-box journal's ``fault`` frames and :meth:`to_json` carry
+    #: these; the legacy ``fired`` tuples stay unchanged for tests
+    fired_records: List[dict] = field(default_factory=list)
+
+    def _record_fired(self, f: Fault, replica=None, chip=None,
+                      host=None) -> None:
+        rec = {"id": _fault_id(f.event, f.step, replica, chip, host),
+               "event": f.event, "step": int(f.step),
+               "replica": replica, "chip": chip, "host": host,
+               "delay_s": f.delay_s}
+        self.fired_records.append(rec)
+        try:        # chaos fires inside failure paths: a torn journal
+            # tap must never break the injection itself
+            from ..observability.journal import journal, journal_armed
+            if journal_armed[0]:
+                journal.note_fault(rec)
+        except Exception:
+            pass
+
+    # -- JSON round-trip (sharing chaos repros; replay rebuilds) -----------
+
+    def to_json(self) -> dict:
+        """The injector as a JSON-able document: remaining schedule +
+        resolved fired records, versioned for skew rejection."""
+        return {"schema_version": FAULTS_SCHEMA_VERSION,
+                "schedule": [f.as_dict() for f in self.schedule],
+                "fired": [dict(r) for r in self.fired_records]}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FaultInjector":
+        ver = doc.get("schema_version")
+        if ver != FAULTS_SCHEMA_VERSION:
+            raise ValueError(
+                f"fault schedule schema_version={ver!r}, this tree "
+                f"speaks {FAULTS_SCHEMA_VERSION}")
+        inj = cls(schedule=[Fault.from_dict(d)
+                            for d in doc.get("schedule", [])])
+        inj.fired_records = [dict(r) for r in doc.get("fired", [])]
+        return inj
 
     @classmethod
     def seeded(cls, seed: int, num_steps: int,
@@ -241,6 +307,7 @@ class FaultInjector:
             h = f.host if f.host is not None else (
                 int(host) if host is not None else None)
             self.fired.append((event, int(step), h))
+            self._record_fired(f, host=h)
             return f
         return None
 
@@ -277,6 +344,7 @@ class FaultInjector:
         r = f.replica if f.replica is not None else (
             int(replica) if replica is not None else None)
         self.fired.append((event, int(step), r, chip))
+        self._record_fired(f, replica=r, chip=chip)
         return chip
 
     def fire(self, event: str, step: int,
@@ -290,9 +358,11 @@ class FaultInjector:
             return False
         if replica is None and f.replica is None:
             self.fired.append((event, int(step)))
+            self._record_fired(f)
         else:
             r = f.replica if f.replica is not None else int(replica)
             self.fired.append((event, int(step), r))
+            self._record_fired(f, replica=r)
         return True
 
     # -- corruption tools (deliberately non-atomic writes) ------------------

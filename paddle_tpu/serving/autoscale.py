@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
+from ..observability.journal import journal, journal_armed
 from ..observability.registry import get_registry
 from ..observability.signals import SignalBus, SignalSnapshot
 from .roles import ReplicaRole
@@ -379,6 +380,14 @@ class AutoscaleController:
         self.records.append(rec)
         del self.records[:-self._max_records]
         self._c_decisions.inc(action=decision.action)
+        if journal_armed[0]:
+            # a scale frame in the journal is a replay *refusal* marker:
+            # the fleet topology changed mid-incident, so the head frame
+            # alone can no longer rebuild it. The frame carries the
+            # ScaleRecord seq so the operator can pivot to autoscale.json.
+            journal.note_scale(seq=rec.seq, action=rec.action,
+                              reason=rec.reason, replica=rec.replica_id,
+                              role=rec.role)
         try:
             self._apply(decision, rec, t)
         except Exception as e:  # noqa: BLE001 - a torn actuation must

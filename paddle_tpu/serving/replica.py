@@ -179,6 +179,32 @@ class ReplicaHandle:
             out["injected_fault"] = self._fault[0]
         return out
 
+    def journal_spec(self) -> Dict[str, Any]:
+        """This replica's slice of a journal head frame: the exact
+        constructor geometry :mod:`~paddle_tpu.observability.replay`
+        needs to rebuild an identical engine + scheduler + breaker.
+        Lives here (not in replay) so the journal never reaches into
+        ``._scheduler``/``._fault`` from outside ``serving/``."""
+        from dataclasses import asdict
+        eng = self.engine
+        return {
+            "replica_id": self.replica_id,
+            "engine": {
+                "num_slots": eng.num_slots,
+                "page_size": eng.page_size,
+                "chunk": eng.chunk,
+                "max_seq_len": eng.max_seq_len,
+                "num_pages": eng.mgr.num_pages,
+                "prefix_cache": eng.cache is not None,
+                "speculative": eng._speculative,
+                "spec_k": eng.spec_k,
+                "unified": eng._unified,
+            },
+            "generation": asdict(eng.config),
+            "scheduler": asdict(self._scheduler.config),
+            "health": asdict(self.health.config),
+        }
+
     # -- chaos surface (deterministic fault injection) ----------------------
 
     def kill(self) -> None:

@@ -59,6 +59,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 from ..observability.events import emit_event
+from ..observability.journal import journal, journal_armed
 from ..observability.memory import memory_armed, memory_ledger
 from ..observability.registry import get_registry
 from ..profiler.record import emit_span, spans_armed
@@ -313,6 +314,12 @@ class DisaggRouter(FleetRouter):
                        imported_pages=imported["imported_pages"],
                        skipped_pages=imported["skipped_pages"],
                        seconds=round(dt, 6), outcome="ok")
+            if journal_armed[0]:
+                # like scale frames: a disaggregated handoff moved KV
+                # between replicas, which single-fleet replay cannot
+                # re-drive — the frame marks the bundle replay-refused
+                journal.note_handoff(rid=req.rid, src=src, dst=dst,
+                                     pages=len(ks2), outcome="ok")
             return True
         except Exception as e:  # noqa: BLE001 - per-request fallback
             dt = self._clock() - t0
@@ -323,6 +330,9 @@ class DisaggRouter(FleetRouter):
                        trace_id=req.trace_id, src=src, dst=dst,
                        pages=0, bytes=0, seconds=round(dt, 6),
                        outcome="failed", error=repr(e))
+            if journal_armed[0]:
+                journal.note_handoff(rid=req.rid, src=src, dst=dst,
+                                     pages=0, outcome="failed")
             if cancelled:
                 # src already gave the request up: the standard failover
                 # continuation recomputes the prefix somewhere routable

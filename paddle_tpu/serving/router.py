@@ -62,7 +62,7 @@ objective, mirroring the scheduler's "slo"-shed exclusion).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
@@ -71,6 +71,7 @@ from ..kvcache.policy import LRUEvictionPolicy
 from ..kvcache.radix import RadixTree
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
+from ..observability.journal import journal, journal_armed, token_checksum
 from ..observability.registry import get_registry
 from ..observability.timeseries import history_armed
 from ..observability.trace import new_trace_id
@@ -195,6 +196,9 @@ class FleetRouter:
         self._requests: Dict[int, RouterRequest] = {}   # unresolved only
         self._parked: List[RouterRequest] = []  # no routable replica yet
         self._probe: Dict[int, int] = {}        # replica id -> router rid
+        # last health state journaled per replica: the end-of-step diff
+        # that turns breaker walks into journal `health` frames
+        self._journal_health: Dict[int, str] = {}
         self.slo_monitor = None
         self.signal_bus = None                  # see attach_signal_bus
         # router-side prefix index: one tree per replica, synthetic page
@@ -290,6 +294,24 @@ class FleetRouter:
             trace_id=new_trace_id("req"),
             sampler=sampler, grammar=grammar)
         req._submit_ns = time.perf_counter_ns()
+        if journal_armed[0]:
+            # the arrival frame carries EVERYTHING replay needs to
+            # re-submit this request: tokens, budget, priority/deadline,
+            # the seed resolved above, the grammar fingerprint
+            journal.note_arrival(
+                rid=rid, clock=now, prompt=[int(t) for t in prompt],
+                prompt_crc=token_checksum(prompt),
+                priority=int(priority), deadline_ms=deadline_ms,
+                budget=budget,
+                sampler=(None if sampler is None else {
+                    "temperature": sampler.temperature,
+                    "top_k": sampler.top_k, "top_p": sampler.top_p,
+                    "seed": sampler.seed}),
+                grammar=(None if grammar is None else {
+                    "pattern": getattr(grammar, "pattern", None),
+                    "fingerprint": getattr(grammar, "fingerprint", None),
+                    "eos_token_id": getattr(grammar, "eos_token_id",
+                                            None)}))
         # a fatal (non-Exception) router death closes consumer streams
         # via the producer-liveness poll instead of leaving them blocked
         alive = self._alive
@@ -504,6 +526,11 @@ class FleetRouter:
     def _step_inner(self, params) -> None:
         cfg = self.config
         self._steps += 1
+        if journal_armed[0]:
+            # the injected-clock sample is the replay anchor: pinning a
+            # settable clock to it makes deadlines, backoffs and breaker
+            # cooldowns land on the same step they did in production
+            journal.note_step(self._steps, self._clock())
         # 1. scheduled chaos, replica-scoped and one-shot
         if self.injector is not None:
             for rid, r in self.replicas.items():
@@ -579,6 +606,16 @@ class FleetRouter:
         # 5. resolve finished requests / expire parked deadlines
         self._scan_requests()
         # 6. drained latches + state gauge + fleet SLO
+        if journal_armed[0]:
+            # end-of-step health diff: one frame per TRANSITION, never
+            # per step, so a stable fleet journals nothing here
+            for rid in sorted(self.replicas):
+                state = self.replicas[rid].health.state
+                prev = self._journal_health.get(rid)
+                if state != prev:
+                    self._journal_health[rid] = state
+                    journal.note_health(replica=rid, prev=prev,
+                                        state=state)
         for rid, r in self.replicas.items():
             if (r.draining and not r.drained_event_sent
                     and not any(q.replica_id == rid and q.handle is not None
@@ -750,6 +787,17 @@ class FleetRouter:
                 error: Optional[ServingError], outcome: str) -> None:
         req.state = state
         req.finish_t = self._clock()
+        if journal_armed[0]:
+            # terminal frame: the stream checksum is what replay diffs
+            # to prove byte-identical reproduction; the engine-side crc
+            # cross-checks that the stream matched what decode retired
+            toks = [int(t) for t in req.stream.tokens]
+            journal.note_outcome(
+                rid=req.rid, state=state, outcome=outcome,
+                replica=req.replica_id, failovers=req.failovers,
+                tokens=toks, stream_crc=token_checksum(toks),
+                engine_crc=(getattr(req.handle, "token_checksum", None)
+                            if req.handle is not None else None))
         if req._submit_ns and spans_armed():
             # the fleet-level request envelope: the timeline collector's
             # root span, spanning router submit -> terminal outcome
@@ -946,6 +994,20 @@ class FleetRouter:
         if self.signal_bus is not None:
             out["signals"] = self.signal_bus.values()
         return out
+
+    def journal_topology(self) -> Dict[str, Any]:
+        """The fleet half of a journal head frame: everything
+        :mod:`~paddle_tpu.observability.replay` needs to rebuild this
+        router — its config plus each replica's engine geometry,
+        generation defaults, scheduler and breaker configs. Pure
+        configuration, no runtime state: replay reconstructs state by
+        re-driving the journaled frames."""
+        return {
+            "router_kind": type(self).__name__,
+            "config": asdict(self.config),
+            "replicas": [self.replicas[rid].journal_spec()
+                         for rid in sorted(self.replicas)],
+        }
 
     def attach_signal_bus(self, bus=None, **bus_kw):
         """Wire the fleet sensor plane: a :class:`~paddle_tpu.

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..observability.events import emit_event
 from ..observability.flight import flight_recorder
+from ..observability.journal import journal, journal_armed
 from ..observability.memory import (memory_armed, memory_ledger,
                                     pool_occupancy)
 from ..observability.step_timer import StepTimer
@@ -136,6 +137,10 @@ class ServingRequest:
     grammar_prefix: Any = None            # already-emitted tokens to
     # pre-advance the grammar through (failover continuations: the
     # streamed tokens became prompt, so the DFA must resume mid-string)
+    token_checksum: Optional[int] = None  # crc32 of the engine-retired
+    # tokens, stamped at finish — the journal's engine-side twin of the
+    # router's stream checksum (a mismatch localizes divergence to the
+    # stream plumbing rather than the decode loop)
     _span: Any = field(default=None, repr=False)  # request across layers
     _submit_ns: int = field(default=0, repr=False)  # perf-clock twin of
     # submit_t (submit_t may come from an injected/fake scheduler clock;
@@ -702,6 +707,12 @@ class ServingScheduler:
                 grammar=req.grammar, grammar_prefix=req.grammar_prefix)
             req.state = RequestState.RUNNING
             self._by_engine_rid[req.engine_rid] = req
+            if journal_armed[0]:
+                # the scheduler rid <-> engine rid binding: lets replay
+                # correlate outcome frames with engine-side checksums
+                journal.note_admit(srid=req.rid,
+                                   engine_rid=req.engine_rid,
+                                   ns=self.metrics.namespace)
             if armed:
                 # two non-overlapping timeline segments, one batch:
                 # queued until this admission pass picked the request
@@ -860,6 +871,7 @@ class ServingScheduler:
         req = self._by_engine_rid.pop(engine_rid, None)
         if req is None:
             return
+        req.token_checksum = self.engine.finished_checksum(engine_rid)
         self._finish(req, RequestState.DONE, "complete")
         self.metrics.inc("requests_completed_total")
         self.metrics.observe("e2e_ms",

@@ -83,6 +83,14 @@ def encode_message(kind: str, meta: Optional[dict] = None,
                         separators=(",", ":")).encode("utf-8")
     payload = b"".join(chunks)
     crc = zlib.crc32(header + payload) & 0xFFFFFFFF
+    # lazy: this module is importable with nothing but stdlib + numpy
+    # (cross-host receivers), so the journal tap must not promote
+    # observability into a hard import-time dependency
+    from ..observability.journal import journal as _journal
+    from ..observability.journal import journal_armed as _armed
+    if _armed[0]:
+        _journal.note_wire(kind=kind, crc=int(crc),
+                           nbytes=len(header) + len(payload))
     return (_PREAMBLE.pack(MAGIC, WIRE_VERSION, 0, len(header), crc)
             + header + payload)
 
